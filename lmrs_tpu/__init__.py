@@ -1,0 +1,43 @@
+"""lmrs_tpu — TPU-native long-transcript map-reduce summarization framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of
+``consilience-dev/llm-map-reduce-summarizer`` (see /root/repo/SURVEY.md): the
+reference fans transcript chunks out to a remote LLM HTTP API; this framework
+collapses that API boundary and runs the model on-pod — a sharded decoder-only
+LLM lives in HBM, prefill/decode run as Pallas flash-attention kernels, and the
+chunk list becomes a continuously-batched data-parallel workload.
+
+Layer map (SURVEY.md §7.1):
+
+    L1  data plane       lmrs_tpu.data       preprocess / chunk / tokenize
+    L2  engine API       lmrs_tpu.engine     Engine protocol, Mock + JAX engines
+    L3  model zoo        lmrs_tpu.models     Llama-3 / Gemma decoders (pytrees)
+    L4  kernels          lmrs_tpu.ops        Pallas flash attn, paged decode
+    L5  sharding/comms   lmrs_tpu.parallel   mesh, pjit specs, ring attention
+    L6  serving          lmrs_tpu.engine     continuous batching, paged KV
+    L7  reduce tree      lmrs_tpu.reduce     single-pass + hierarchical reduce
+    L8  CLI/API          lmrs_tpu.pipeline   TranscriptSummarizer, CLI, stats
+"""
+
+__version__ = "0.1.0"
+
+from lmrs_tpu.config import (
+    ChunkConfig,
+    DataConfig,
+    EngineConfig,
+    MeshConfig,
+    PipelineConfig,
+    ReduceConfig,
+)
+from lmrs_tpu.pipeline import TranscriptSummarizer
+
+__all__ = [
+    "ChunkConfig",
+    "DataConfig",
+    "EngineConfig",
+    "MeshConfig",
+    "PipelineConfig",
+    "ReduceConfig",
+    "TranscriptSummarizer",
+    "__version__",
+]

@@ -1,0 +1,163 @@
+"""Command-line interface.
+
+Flag surface keeps parity with the reference CLI (main.py:406-474) — same
+spellings where they exist (``--input/-i``, ``--output/-o``, ``--model``,
+``--max-tokens-per-chunk``, ``--max-concurrent-requests``,
+``--max-segment-duration``, ``--no-merge``, ``--no-hierarchical``,
+``--limit-segments``, ``--report``, ``--prompt-file``,
+``--system-prompt-file``, ``--save-chunks``, ``--aggregator-prompt-file``,
+``--quiet/-q``) — plus TPU-era additions (``--backend``, ``--tokenizer``,
+``--mesh``, ``--resume-from``, ``--profile``, ``--time-interval``).
+``--provider`` is accepted as a deprecated alias of ``--backend``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+from pathlib import Path
+
+from lmrs_tpu.config import (
+    ChunkConfig,
+    DataConfig,
+    EngineConfig,
+    MeshConfig,
+    PipelineConfig,
+    ReduceConfig,
+)
+from lmrs_tpu.pipeline import TranscriptSummarizer
+from lmrs_tpu.utils.logging import setup_logging
+from lmrs_tpu.utils.timing import format_duration
+
+logger = logging.getLogger("lmrs.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lmrs",
+        description="TPU-native map-reduce summarization of long transcripts",
+    )
+    p.add_argument("--input", "-i", required=True, help="input transcript JSON")
+    p.add_argument("--output", "-o", help="write final summary to this file")
+    p.add_argument("--backend", "--provider", dest="backend", default=None,
+                   help="engine backend: mock | jax (default: env/config)")
+    p.add_argument("--model", default=None, help="model preset or checkpoint name")
+    p.add_argument("--max-tokens-per-chunk", type=int, default=4000)
+    p.add_argument("--overlap-tokens", type=int, default=200)
+    p.add_argument("--max-concurrent-requests", type=int, default=None)
+    p.add_argument("--max-segment-duration", type=float, default=120.0)
+    p.add_argument("--time-interval", type=float, default=None,
+                   help="re-bucket segments into fixed intervals (seconds)")
+    p.add_argument("--no-merge", action="store_true", help="skip same-speaker merging")
+    p.add_argument("--no-hierarchical", action="store_true", help="single-pass reduce only")
+    p.add_argument("--limit-segments", type=int, default=None)
+    p.add_argument("--report", action="store_true", help="write <output>.report.json stats")
+    p.add_argument("--prompt-file", help="map prompt file ({transcript} placeholder)")
+    p.add_argument("--system-prompt-file", help="system prompt file")
+    p.add_argument("--aggregator-prompt-file", help="reduce prompt file ({summaries})")
+    p.add_argument("--save-chunks", help="dump per-chunk summaries JSON after map stage")
+    p.add_argument("--resume-from", help="reuse summaries from a prior --save-chunks dump")
+    p.add_argument("--summary-type", default="summary")
+    p.add_argument("--tokenizer", default="approx",
+                   help='token-count authority: "approx", "byte", sp model path, HF id')
+    p.add_argument("--mesh", default=None,
+                   help="device mesh axes as dp,tp[,sp[,pp]] e.g. 2,4 or 1,4,2,1")
+    p.add_argument("--profile", action="store_true", help="emit jax.profiler spans")
+    p.add_argument("--quiet", "-q", action="store_true")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> PipelineConfig:
+    mesh = MeshConfig()
+    if args.mesh:
+        dims = [int(x) for x in args.mesh.split(",")]
+        dims += [1] * (4 - len(dims))
+        mesh = MeshConfig(dp=dims[0], tp=dims[1], sp=dims[2], pp=dims[3])
+    engine = EngineConfig()
+    if args.backend:
+        engine = dataclasses.replace(engine, backend=args.backend)
+    if args.model:
+        engine = dataclasses.replace(engine, model=args.model)
+    if args.max_concurrent_requests is not None:
+        engine = dataclasses.replace(engine, max_concurrent_requests=args.max_concurrent_requests)
+    return PipelineConfig(
+        data=DataConfig(
+            merge_same_speaker=not args.no_merge,
+            time_interval_seconds=args.time_interval,
+            max_segment_duration=args.max_segment_duration,
+            limit_segments=args.limit_segments,
+        ),
+        chunk=ChunkConfig(
+            max_tokens_per_chunk=args.max_tokens_per_chunk,
+            overlap_tokens=args.overlap_tokens,
+            tokenizer=args.tokenizer,
+        ),
+        engine=engine,
+        mesh=mesh,
+        reduce=ReduceConfig(hierarchical=not args.no_hierarchical),
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(quiet=args.quiet)
+
+    try:
+        transcript = json.loads(Path(args.input).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        logger.error("could not read transcript %s: %s", args.input, e)
+        return 1
+
+    summarizer = TranscriptSummarizer(config_from_args(args), profile=args.profile)
+    try:
+        stats = summarizer.summarize(
+            transcript,
+            prompt_file=args.prompt_file,
+            system_prompt_file=args.system_prompt_file,
+            aggregator_prompt_file=args.aggregator_prompt_file,
+            summary_type=args.summary_type,
+            save_chunks=args.save_chunks,
+            resume_from=args.resume_from,
+        )
+    except ValueError as e:
+        logger.error("pipeline configuration error: %s", e)
+        return 1
+    summarizer.shutdown()
+
+    summary = stats["summary"]
+    if not args.quiet:
+        # final stats banner (main.py:370-379)
+        print("\n" + "=" * 60)
+        print("SUMMARY")
+        print("=" * 60)
+        print(summary)
+        print("=" * 60)
+        print(
+            f"segments: {stats['num_input_segments']} -> {stats['num_segments']}  "
+            f"chunks: {stats['num_chunks']}  "
+            f"duration: {stats['transcript_duration_str']}  "
+            f"tokens: {stats['total_tokens_used']}  "
+            f"device-s: {stats['total_device_seconds']}  "
+            f"wall: {format_duration(stats['processing_time'])}"
+        )
+
+    if args.output:
+        try:
+            Path(args.output).write_text(summary, encoding="utf-8")
+        except OSError as e:  # degraded, not fatal (main.py:400-402)
+            logger.error("could not write output %s: %s", args.output, e)
+        if args.report:
+            report_path = str(args.output) + ".report.json"
+            report = {k: v for k, v in stats.items() if k != "summary"}
+            try:
+                Path(report_path).write_text(json.dumps(report, indent=2), encoding="utf-8")
+            except OSError as e:
+                logger.error("could not write report %s: %s", report_path, e)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

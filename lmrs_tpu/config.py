@@ -1,0 +1,204 @@
+"""Typed configuration tree for the whole framework.
+
+Replaces the reference's three ad-hoc config layers (env via ``LLMConfig``
+at llm_executor.py:31-52, argparse flags at main.py:412-472, ctor kwargs on
+every component) with one dataclass tree and the same precedence:
+explicit kwargs > CLI flags > environment > defaults  (SURVEY.md §5.6).
+
+Reference-compatible environment variables (MAX_CONCURRENT_REQUESTS,
+TEMPERATURE, MAX_TOKENS, REQUEST_TIMEOUT, RETRY_ATTEMPTS, RETRY_DELAY,
+DEFAULT_PROVIDER; .env.template:1-22) are honored so a reference user's
+``.env`` keeps working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _env(name: str, default: Any, cast: type = str) -> Any:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        if cast is bool:
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return cast(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclass
+class DataConfig:
+    """Preprocessing stage knobs (reference: preprocessor.py:15-67)."""
+
+    merge_same_speaker: bool = True
+    time_interval_seconds: float | None = None
+    max_segment_duration: float = 120.0
+    preserve_timestamps: bool = True
+    limit_segments: int | None = None  # reference --limit-segments (main.py:450-452)
+
+
+@dataclass
+class ChunkConfig:
+    """Chunker knobs (reference: big_chunkeroosky.py:23-44).
+
+    Unlike the reference, ``overlap_tokens`` is actually implemented
+    (reference accepts-but-ignores it; SURVEY.md §2.3 quirk 1).
+    ``tokenizer`` names the token-count authority — in the TPU build this is
+    the *serving model's* tokenizer, not cl100k_base (SURVEY.md §7.4 item 4).
+    """
+
+    max_tokens_per_chunk: int = 4000
+    overlap_tokens: int = 200
+    context_tokens: int = 150
+    tokenizer: str = "approx"  # "approx" | "byte" | HF repo id / sp model path
+
+    @property
+    def effective_max_tokens(self) -> int:
+        return self.max_tokens_per_chunk - self.context_tokens
+
+
+@dataclass
+class ModelConfig:
+    """Decoder-only transformer hyperparameters (lmrs_tpu.models)."""
+
+    name: str = "tiny"
+    vocab_size: int = 512
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    hidden_dim: int = 688
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+    # Gemma-style differences
+    logit_softcap: float | None = None
+    embed_scale: bool = False  # Gemma multiplies embeddings by sqrt(dim)
+
+
+@dataclass
+class MeshConfig:
+    """Device mesh axes: data, tensor (ICI), sequence/context, pipeline.
+
+    The reference has no device parallelism at all (SURVEY.md §2.2); these
+    axes are the TPU-native replacement for its asyncio request fan-out.
+    """
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    axis_names: tuple[str, ...] = ("dp", "tp", "sp", "pp")
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.tp * self.sp * self.pp
+
+
+@dataclass
+class EngineConfig:
+    """Generation engine knobs.
+
+    Mirrors the reference's ``LLMConfig`` env surface (llm_executor.py:31-52)
+    but the "provider" is an in-tree backend, not an HTTP vendor:
+    ``backend`` ∈ {"mock", "jax"}.  ``max_concurrent_requests`` maps to the
+    continuous-batching decode slot count (admission control; SURVEY.md §2.2).
+    """
+
+    backend: str = field(default_factory=lambda: _env("LMRS_BACKEND", _env("DEFAULT_PROVIDER", "mock")))
+    model: str = field(default_factory=lambda: _env("LMRS_MODEL", "tiny"))
+    temperature: float = field(default_factory=lambda: _env("TEMPERATURE", 0.3, float))
+    max_tokens: int = field(default_factory=lambda: _env("MAX_TOKENS", 1000, int))
+    max_concurrent_requests: int = field(
+        default_factory=lambda: _env("MAX_CONCURRENT_REQUESTS", 5, int)
+    )
+    request_timeout: float = field(default_factory=lambda: _env("REQUEST_TIMEOUT", 60.0, float))
+    retry_attempts: int = field(default_factory=lambda: _env("RETRY_ATTEMPTS", 3, int))
+    retry_delay: float = field(default_factory=lambda: _env("RETRY_DELAY", 5.0, float))
+    seed: int = 0
+    # serving-side knobs (no reference counterpart — SURVEY.md §7.4 item 1)
+    max_batch_slots: int = 8
+    page_size: int = 128
+    num_pages: int = 512
+    prefill_chunk: int = 512
+    checkpoint_path: str | None = None
+
+    def __post_init__(self) -> None:
+        # Reference DEFAULT_PROVIDER values name HTTP vendors; both map to
+        # the local engine choice "mock" when no backend is explicitly set.
+        if self.backend in ("openai", "anthropic"):
+            self.backend = "mock"
+
+
+@dataclass
+class ReduceConfig:
+    """Reduce-tree knobs (reference: result_aggregator.py:32-53,357-380).
+
+    The reference tree is capped at exactly two levels (quirk 11); here
+    ``max_levels`` allows true recursion until the batch fits.
+    """
+
+    max_tokens_per_batch: int = 6000
+    hierarchical: bool = True
+    reserve_tokens: int = 1000
+    max_summaries_per_batch: int = 10
+    max_levels: int = 4
+    temperature: float = 0.2  # reference hardcodes 0.2 (result_aggregator.py:238)
+
+
+@dataclass
+class PipelineConfig:
+    """Top-level config: one object wires the whole pipeline."""
+
+    data: DataConfig = field(default_factory=DataConfig)
+    chunk: ChunkConfig = field(default_factory=ChunkConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    reduce: ReduceConfig = field(default_factory=ReduceConfig)
+
+    def replace(self, **kw: Any) -> "PipelineConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def model_preset(name: str) -> ModelConfig:
+    """Named model configurations (L3 model zoo presets)."""
+    presets: dict[str, dict] = {
+        "tiny": {},
+        "tiny-gemma": dict(
+            logit_softcap=30.0, embed_scale=True, rope_theta=10000.0, tie_embeddings=True
+        ),
+        "llama3-8b": dict(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            hidden_dim=14336, max_seq_len=8192, rope_theta=500000.0,
+            tie_embeddings=False,
+        ),
+        "llama3-70b": dict(
+            vocab_size=128256, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+            hidden_dim=28672, max_seq_len=8192, rope_theta=500000.0,
+            tie_embeddings=False,
+        ),
+        "gemma-2b": dict(
+            vocab_size=256128, dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
+            hidden_dim=16384, max_seq_len=8192, rope_theta=10000.0,
+            tie_embeddings=True, embed_scale=True,
+        ),
+        "gemma-7b": dict(
+            vocab_size=256128, dim=3072, n_layers=28, n_heads=16, n_kv_heads=16,
+            hidden_dim=24576, max_seq_len=8192, rope_theta=10000.0,
+            tie_embeddings=True, embed_scale=True,
+        ),
+    }
+    if name not in presets:
+        raise ValueError(f"unknown model preset {name!r}; have {sorted(presets)}")
+    return ModelConfig(name=name, **presets[name])

@@ -1,0 +1,22 @@
+"""L1 data plane: transcript preprocessing, chunking, tokenization."""
+
+from lmrs_tpu.data.chunker import Chunk, TranscriptChunker
+from lmrs_tpu.data.preprocessor import (
+    clean_text,
+    extract_speakers,
+    format_timestamp,
+    get_transcript_duration,
+    preprocess_transcript,
+)
+from lmrs_tpu.data.tokenizer import get_tokenizer
+
+__all__ = [
+    "Chunk",
+    "TranscriptChunker",
+    "clean_text",
+    "extract_speakers",
+    "format_timestamp",
+    "get_transcript_duration",
+    "get_tokenizer",
+    "preprocess_transcript",
+]

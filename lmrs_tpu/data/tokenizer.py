@@ -1,0 +1,143 @@
+"""Pluggable tokenization authority.
+
+The reference hardwires tiktoken ``cl100k_base`` as the token-count authority
+(big_chunkeroosky.py:27,43; result_aggregator.py:36,50).  In the TPU build the
+authority must be the *serving model's* tokenizer (SURVEY.md §7.4 item 4), so
+everything downstream takes a ``Tokenizer`` object:
+
+* ``ApproxTokenizer`` — deterministic ~4 chars/token estimator for offline
+  counting parity with the reference's stubbed baseline run (BASELINE.md).
+* ``ByteTokenizer`` — real reversible text<->ids mapping (UTF-8 bytes + special
+  tokens).  Default vocabulary for randomly-initialized in-tree models; lets
+  the full TPU engine run end-to-end with zero downloaded assets.
+* ``SentencePieceTokenizer`` / ``HFTokenizer`` — adapters for real Gemma/Llama
+  vocabularies when checkpoint assets are present on disk (gated import; this
+  environment has no egress).
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    """Minimal surface every stage relies on."""
+
+    bos_id: int
+    eos_id: int
+    pad_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def count(self, text: str) -> int: ...
+
+
+class ApproxTokenizer:
+    """Heuristic counter: ~4 chars/token with a word-boundary floor.
+
+    Matches the stub used to measure the reference baseline offline
+    (BASELINE.md: "tiktoken stubbed at 4 chars/token").  ``encode`` maps each
+    whitespace-delimited piece to a hashed id so code paths that need ids
+    still work; ``decode`` is not faithful and only used in tests.
+    """
+
+    bos_id = 1
+    eos_id = 2
+    pad_id = 0
+    vocab_size = 32768
+
+    _word_re = re.compile(r"\S+")
+
+    def count(self, text: str) -> int:
+        if not text:
+            return 0
+        return max(len(text) // 4, len(self._word_re.findall(text)) // 2, 1)
+
+    def encode(self, text: str) -> list[int]:
+        return [
+            3 + (zlib.crc32(w.encode("utf-8")) % (self.vocab_size - 3))
+            for w in self._word_re.findall(text)
+        ]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return " ".join(f"<{i}>" for i in ids)
+
+
+class ByteTokenizer:
+    """Reversible UTF-8 byte-level tokenizer.
+
+    ids 0..2 are pad/bos/eos; byte b maps to id b+3.  vocab_size=259 rounds up
+    to 512 in model configs for MXU-friendly embedding shapes.
+    """
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    vocab_size = 259
+
+    def encode(self, text: str) -> list[int]:
+        return [b + 3 for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i - 3 for i in ids if i >= 3).decode("utf-8", errors="replace")
+
+    def count(self, text: str) -> int:
+        return len(text.encode("utf-8"))
+
+
+class SentencePieceTokenizer:
+    """Adapter over a local ``.model`` SentencePiece file (Gemma/Llama-2)."""
+
+    def __init__(self, model_path: str):
+        import sentencepiece as spm  # gated: not guaranteed in image
+
+        self._sp = spm.SentencePieceProcessor(model_file=model_path)
+        self.bos_id = self._sp.bos_id()
+        self.eos_id = self._sp.eos_id()
+        self.pad_id = max(self._sp.pad_id(), 0)
+        self.vocab_size = self._sp.vocab_size()
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._sp.encode(text))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._sp.decode(list(ids))
+
+    def count(self, text: str) -> int:
+        return len(self.encode(text))
+
+
+class HFTokenizer:
+    """Adapter over a locally-cached HuggingFace tokenizer (Llama-3 BPE)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer  # local files only; no egress
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id or 1
+        self.eos_id = self._tok.eos_token_id or 2
+        self.pad_id = self._tok.pad_token_id or 0
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def count(self, text: str) -> int:
+        return len(self.encode(text))
+
+
+def get_tokenizer(name: str = "approx") -> Tokenizer:
+    """Resolve a tokenizer spec: "approx", "byte", ``*.model`` path, or HF id."""
+    if name == "approx":
+        return ApproxTokenizer()
+    if name == "byte":
+        return ByteTokenizer()
+    if name.endswith(".model"):
+        return SentencePieceTokenizer(name)
+    return HFTokenizer(name)

@@ -1,0 +1,14 @@
+"""L2/L6 engine layer: generation API, mock + JAX backends, map executor."""
+
+from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult, make_engine
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.engine.mock import MockEngine
+
+__all__ = [
+    "Engine",
+    "GenerationRequest",
+    "GenerationResult",
+    "MapExecutor",
+    "MockEngine",
+    "make_engine",
+]

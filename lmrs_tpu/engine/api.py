@@ -1,0 +1,93 @@
+"""Engine protocol: the boundary that replaces the reference's HTTP clients.
+
+In the reference, L2's ``_call_llm_api`` dispatches to OpenAI/Anthropic HTTPS
+clients (llm_executor.py:232-409) — the model lives on the far side of a
+network boundary.  Here the boundary is a Python protocol and both sides live
+in-tree: ``MockEngine`` (the no-device CPU test path, successor of the
+reference's mock backend at llm_executor.py:411-432) and ``JaxEngine`` (the
+TPU serving engine, SURVEY.md §7.1 L2/L6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Protocol, runtime_checkable
+
+if TYPE_CHECKING:
+    from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
+
+
+@dataclass
+class GenerationRequest:
+    """One unit of generation work (≙ one reference API call)."""
+
+    prompt: str
+    request_id: int = 0
+    system_prompt: str | None = None
+    max_new_tokens: int = 1000
+    temperature: float = 0.3
+    top_p: float = 1.0
+    top_k: int = 0
+    stop: tuple[str, ...] = ()
+    seed: int | None = None
+
+
+@dataclass
+class GenerationResult:
+    """Completion + accounting (≙ the usage block the reference reads at
+    llm_executor.py:304-317)."""
+
+    request_id: int
+    text: str = ""
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    finish_reason: str = "stop"  # stop | length | error
+    device_seconds: float = 0.0
+    error: str | None = None
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+
+@runtime_checkable
+class Engine(Protocol):
+    """Batch generation backend.
+
+    ``generate_batch`` is synchronous from the caller's perspective; backends
+    batch internally (continuous batching in JaxEngine).  Failures surface as
+    per-result ``error`` fields, never exceptions — the map stage's
+    degrade-and-continue contract (llm_executor.py:219-225) depends on it.
+    """
+
+    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]: ...
+
+    def shutdown(self) -> None: ...
+
+
+def make_engine(
+    engine_cfg: "EngineConfig",
+    model_cfg: "ModelConfig | None" = None,
+    mesh_cfg: "MeshConfig | None" = None,
+) -> Engine:
+    """Engine factory keyed on ``EngineConfig.backend``."""
+    if engine_cfg.backend == "mock":
+        from lmrs_tpu.engine.mock import MockEngine
+
+        return MockEngine(seed=engine_cfg.seed)
+    if engine_cfg.backend == "jax":
+        from lmrs_tpu.config import ModelConfig, model_preset
+
+        try:
+            from lmrs_tpu.engine.jax_engine import JaxEngine
+        except ImportError as e:
+            raise ValueError(f"jax backend unavailable: {e}") from e
+
+        # EngineConfig.model (the --model flag) names a preset; an explicitly
+        # customized ModelConfig wins over the preset lookup.
+        if model_cfg is None or (
+            model_cfg == ModelConfig() and engine_cfg.model != model_cfg.name
+        ):
+            model_cfg = model_preset(engine_cfg.model)
+        return JaxEngine(engine_cfg, model_cfg, mesh_cfg)
+    raise ValueError(f"unknown engine backend {engine_cfg.backend!r}")

@@ -1,0 +1,156 @@
+"""Map-stage executor: fan chunks into the engine with the reference's
+scheduling contract.
+
+Successor of ``LLMExecutor.process_chunks`` (llm_executor.py:110-228).  The
+reference's semantics are preserved exactly, re-based onto a local engine:
+
+* concurrency cap        — ``asyncio.Semaphore(max_concurrent_requests)``
+                           (llm_executor.py:133) becomes wave-sized batch
+                           admission into the engine;
+* per-chunk retry loop   — RETRY_ATTEMPTS × RETRY_DELAY
+                           (llm_executor.py:198-228) becomes requeue waves;
+* degrade-and-continue   — an exhausted chunk gets the inline
+                           ``"[Error processing chunk: …]"`` summary + error
+                           field, never an exception (llm_executor.py:219-225);
+* order restoration      — results sorted by chunk_index
+                           (llm_executor.py:157);
+* accounting             — total_tokens_used / total_requests /
+                           failed_requests counters (llm_executor.py:86-90);
+                           dollar cost becomes device-seconds.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Sequence
+
+from lmrs_tpu.config import EngineConfig
+from lmrs_tpu.data.chunker import Chunk
+from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+from lmrs_tpu.prompts import safe_format
+
+logger = logging.getLogger("lmrs.executor")
+
+
+class MapExecutor:
+    """Runs the map stage (and, for the reduce tree, ad-hoc request lists)."""
+
+    def __init__(self, engine: Engine, config: EngineConfig | None = None):
+        self.engine = engine
+        self.config = config or EngineConfig()
+        # running totals (llm_executor.py:86-90)
+        self.total_tokens_used = 0
+        self.total_device_seconds = 0.0
+        self.total_requests = 0
+        self.failed_requests = 0
+
+    # ------------------------------------------------------------------ map
+
+    def process_chunks(
+        self,
+        chunks: Sequence[Chunk],
+        prompt_template: str,
+        summary_type: str = "summary",
+        system_prompt: str | None = None,
+    ) -> list[Chunk]:
+        """Summarize every chunk; returns chunks ordered by chunk_index."""
+        t0 = time.time()
+        requests = []
+        for chunk in chunks:
+            # safe_format, not str.format: user prompt files may contain
+            # literal braces (JSON examples) that str.format would choke on
+            prompt = safe_format(
+                prompt_template,
+                transcript=chunk.text_with_context,
+                summary_type=summary_type,
+            )
+            requests.append(
+                GenerationRequest(
+                    prompt=prompt,
+                    request_id=chunk.chunk_index,
+                    system_prompt=chunk.system_prompt or system_prompt,
+                    max_new_tokens=self.config.max_tokens,
+                    temperature=self.config.temperature,
+                    seed=self.config.seed,
+                )
+            )
+
+        results = self.run_requests(requests)
+        by_id = {r.request_id: r for r in results}
+        out = sorted(chunks, key=lambda c: c.chunk_index)  # llm_executor.py:157
+        for chunk in out:
+            res = by_id[chunk.chunk_index]
+            if res.error is not None:
+                chunk.summary = f"[Error processing chunk: {res.error}]"
+                chunk.error = res.error
+            else:
+                chunk.summary = res.text
+            chunk.tokens_used = res.total_tokens
+            chunk.device_seconds = res.device_seconds
+        logger.info(
+            "map stage: %d chunks in %.2fs (%d failed)",
+            len(out), time.time() - t0, sum(1 for c in out if c.error),
+        )
+        return list(out)
+
+    # ----------------------------------------------------- request plumbing
+
+    def run_requests(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+        """Admission-controlled waves + retry/requeue + accounting."""
+        wave = max(1, self.config.max_concurrent_requests)
+        done: dict[int, GenerationResult] = {}
+        pending = list(requests)
+        attempt = 1
+        while pending:
+            failed: list[GenerationRequest] = []
+            for i in range(0, len(pending), wave):
+                batch = pending[i : i + wave]
+                try:
+                    results = self.engine.generate_batch(batch)
+                except Exception as e:  # engine-level fault: fail the batch
+                    logger.exception("engine batch failure")
+                    results = [
+                        GenerationResult(request_id=r.request_id, finish_reason="error", error=str(e))
+                        for r in batch
+                    ]
+                for req, res in zip(batch, results):
+                    self.total_requests += 1
+                    if res.error is not None:
+                        failed.append(req)
+                    else:
+                        done[res.request_id] = res
+                        self.total_tokens_used += res.total_tokens
+                        self.total_device_seconds += res.device_seconds
+            if not failed:
+                break
+            if attempt >= self.config.retry_attempts:
+                for req in failed:
+                    self.failed_requests += 1
+                    done.setdefault(
+                        req.request_id,
+                        GenerationResult(
+                            request_id=req.request_id,
+                            finish_reason="error",
+                            error=f"failed after {attempt} attempts",
+                        ),
+                    )
+                break
+            logger.warning(
+                "retrying %d failed requests (attempt %d/%d) after %.1fs",
+                len(failed), attempt + 1, self.config.retry_attempts, self.config.retry_delay,
+            )
+            time.sleep(self.config.retry_delay)
+            pending = failed
+            attempt += 1
+        return [done[r.request_id] for r in requests]
+
+    # ------------------------------------------------------------ reporting
+
+    def stats(self) -> dict:
+        return {
+            "total_tokens_used": self.total_tokens_used,
+            "total_device_seconds": round(self.total_device_seconds, 4),
+            "total_requests": self.total_requests,
+            "failed_requests": self.failed_requests,
+        }

@@ -1,0 +1,260 @@
+"""L8 pipeline orchestrator: the ``TranscriptSummarizer`` public API.
+
+Successor of the reference ``TranscriptSummarizer`` (main.py:45-332): wires
+preprocess → chunk → map → reduce with the same knob surface and stats
+contract, driven by one typed ``PipelineConfig``.  Both a sync ``summarize``
+and an ``asummarize`` coroutine are provided (the reference API is async,
+main.py:82-95; here the engine is local so sync is the natural form).
+
+New over the reference:
+* resumable chunk dumps — ``--save-chunks`` output can be fed back via
+  ``resume_from`` to skip already-summarized chunks (SURVEY.md §5.4 suggests
+  exactly this);
+* stage timing spans with optional jax.profiler traces (§5.1);
+* device-seconds accounting in place of dollar cost (§5.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+from lmrs_tpu.config import ChunkConfig, DataConfig, EngineConfig, PipelineConfig
+from lmrs_tpu.data.chunker import Chunk, TranscriptChunker
+from lmrs_tpu.data.preprocessor import (
+    extract_speakers,
+    get_transcript_duration,
+    preprocess_transcript,
+)
+from lmrs_tpu.engine.api import make_engine
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.prompts import (
+    resolve_map_prompt,
+    resolve_reduce_prompt,
+    resolve_system_prompt,
+)
+from lmrs_tpu.reduce.aggregator import ResultAggregator
+from lmrs_tpu.utils.timing import StageTimer, format_duration
+
+logger = logging.getLogger("lmrs.pipeline")
+
+
+class TranscriptSummarizer:
+    """End-to-end map-reduce transcript summarizer.
+
+    Ctor knobs mirror the reference's (main.py:51-58): backend (née provider),
+    model, max_tokens_per_chunk, max_concurrent_requests,
+    hierarchical_aggregation — all overlaid onto a ``PipelineConfig``.
+    """
+
+    def __init__(
+        self,
+        config: PipelineConfig | None = None,
+        *,
+        backend: str | None = None,
+        model: str | None = None,
+        max_tokens_per_chunk: int | None = None,
+        max_concurrent_requests: int | None = None,
+        hierarchical_aggregation: bool | None = None,
+        profile: bool = False,
+    ):
+        cfg = config or PipelineConfig()
+        if backend is not None:
+            cfg = dataclasses.replace(cfg, engine=dataclasses.replace(cfg.engine, backend=backend))
+        if model is not None:
+            cfg = dataclasses.replace(cfg, engine=dataclasses.replace(cfg.engine, model=model))
+        if max_concurrent_requests is not None:
+            cfg = dataclasses.replace(
+                cfg, engine=dataclasses.replace(cfg.engine, max_concurrent_requests=max_concurrent_requests)
+            )
+        if max_tokens_per_chunk is not None:
+            cfg = dataclasses.replace(
+                cfg, chunk=dataclasses.replace(cfg.chunk, max_tokens_per_chunk=max_tokens_per_chunk)
+            )
+        if hierarchical_aggregation is not None:
+            cfg = dataclasses.replace(
+                cfg, reduce=dataclasses.replace(cfg.reduce, hierarchical=hierarchical_aggregation)
+            )
+        self.config = cfg
+        self.profile = profile
+        # Lazily constructed on first summarize() (main.py:113-127).
+        self._executor: MapExecutor | None = None
+        self._chunker: TranscriptChunker | None = None
+        self._aggregator: ResultAggregator | None = None
+
+    # ----------------------------------------------------------- components
+
+    @property
+    def executor(self) -> MapExecutor:
+        if self._executor is None:
+            engine = make_engine(self.config.engine, self.config.model, self.config.mesh)
+            self._executor = MapExecutor(engine, self.config.engine)
+        return self._executor
+
+    @property
+    def chunker(self) -> TranscriptChunker:
+        if self._chunker is None:
+            self._chunker = TranscriptChunker(
+                max_tokens_per_chunk=self.config.chunk.max_tokens_per_chunk,
+                overlap_tokens=self.config.chunk.overlap_tokens,
+                context_tokens=self.config.chunk.context_tokens,
+                tokenizer=self.config.chunk.tokenizer,
+            )
+        return self._chunker
+
+    @property
+    def aggregator(self) -> ResultAggregator:
+        if self._aggregator is None:
+            self._aggregator = ResultAggregator(
+                self.executor, self.config.reduce, tokenizer=self.chunker.tokenizer
+            )
+        return self._aggregator
+
+    # ------------------------------------------------------------------ API
+
+    def summarize(
+        self,
+        transcript_data: dict[str, Any],
+        *,
+        prompt_template: str | None = None,
+        prompt_file: str | None = None,
+        system_prompt: str | None = None,
+        system_prompt_file: str | None = None,
+        aggregator_prompt: str | None = None,
+        aggregator_prompt_file: str | None = None,
+        summary_type: str = "summary",
+        save_chunks: str | None = None,
+        resume_from: str | None = None,
+    ) -> dict[str, Any]:
+        """Run the full pipeline; returns the stats dict (main.py:248-257)."""
+        timer = StageTimer(profile=self.profile)
+        t_start = time.time()
+
+        segments = transcript_data.get("segments", [])
+        if self.config.data.limit_segments:
+            segments = segments[: self.config.data.limit_segments]
+        n_input_segments = len(segments)
+
+        with timer.stage("preprocess"):
+            processed = preprocess_transcript(
+                segments,
+                merge_same_speaker=self.config.data.merge_same_speaker,
+                time_interval_seconds=self.config.data.time_interval_seconds,
+                max_segment_duration=self.config.data.max_segment_duration,
+                preserve_timestamps=self.config.data.preserve_timestamps,
+            )
+        duration = get_transcript_duration(processed)
+        speakers = extract_speakers(processed)
+
+        with timer.stage("chunk"):
+            chunks = self.chunker.chunk_transcript(processed)
+
+        map_prompt = resolve_map_prompt(prompt_template, prompt_file)
+        sys_prompt = resolve_system_prompt(system_prompt, system_prompt_file)
+
+        resumed = 0
+        todo = chunks
+        if resume_from:
+            resumed_chunks, todo = _load_resume(resume_from, chunks)
+            resumed = len(resumed_chunks)
+
+        with timer.stage("map"):
+            if todo:
+                self.executor.process_chunks(todo, map_prompt, summary_type, sys_prompt)
+        processed_chunks = sorted(chunks, key=lambda c: c.chunk_index)
+
+        if save_chunks:
+            _dump_chunks(save_chunks, processed_chunks)
+
+        reduce_prompt = resolve_reduce_prompt(aggregator_prompt, aggregator_prompt_file)
+        metadata = {
+            "duration": format_duration(duration),
+            "speakers": ", ".join(speakers),
+            "num_chunks": len(chunks),
+        }
+        with timer.stage("reduce"):
+            agg = self.aggregator.aggregate(processed_chunks, reduce_prompt, metadata)
+
+        stats = {
+            "summary": agg["final_summary"],
+            "processing_time": time.time() - t_start,
+            "num_input_segments": n_input_segments,
+            "num_segments": len(processed),
+            "num_chunks": len(chunks),
+            "num_resumed_chunks": resumed,
+            "transcript_duration": duration,
+            "transcript_duration_str": format_duration(duration),
+            "speakers": speakers,
+            "hierarchical": agg["hierarchical"],
+            "reduce_levels": agg["levels"],
+            "stage_times": timer.report(),
+            **self.executor.stats(),
+        }
+        logger.info(
+            "pipeline done: %d chunks, %.2fs total", len(chunks), stats["processing_time"]
+        )
+        return stats
+
+    async def asummarize(self, transcript_data: dict[str, Any], **kw: Any) -> dict[str, Any]:
+        """Async facade for reference-API parity (main.py:82 is async)."""
+        return self.summarize(transcript_data, **kw)
+
+    def shutdown(self) -> None:
+        if self._executor is not None:
+            self._executor.engine.shutdown()
+
+
+# ---------------------------------------------------------------- artifacts
+
+
+def _dump_chunks(path: str, chunks: list[Chunk]) -> None:
+    """Intermediate chunk-summary dump (main.py:178-201; README.md:145-158)."""
+    payload = {
+        "timestamp": time.time(),
+        "chunks": [
+            {
+                "chunk_index": c.chunk_index,
+                "start_time": c.start_time,
+                "end_time": c.end_time,
+                "summary": c.summary,
+                "tokens_used": c.tokens_used,
+                "error": c.error,
+            }
+            for c in chunks
+        ],
+    }
+    try:
+        Path(path).write_text(json.dumps(payload, indent=2), encoding="utf-8")
+        logger.info("saved %d chunk summaries to %s", len(chunks), path)
+    except OSError as e:  # never fatal (main.py:200-201)
+        logger.error("could not save chunks to %s: %s", path, e)
+
+
+def _load_resume(path: str, chunks: list[Chunk]) -> tuple[list[Chunk], list[Chunk]]:
+    """Rehydrate summaries from a prior --save-chunks dump; returns
+    (resumed, still_todo).  Chunks match on (chunk_index, start, end)."""
+    try:
+        payload = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        logger.error("could not resume from %s: %s", path, e)
+        return [], chunks
+    saved = {
+        (d["chunk_index"], round(d["start_time"], 3), round(d["end_time"], 3)): d
+        for d in payload.get("chunks", [])
+        if d.get("summary") and not d.get("error")
+    }
+    resumed, todo = [], []
+    for c in chunks:
+        d = saved.get((c.chunk_index, round(c.start_time, 3), round(c.end_time, 3)))
+        if d:
+            c.summary = d["summary"]
+            c.tokens_used = d.get("tokens_used", 0)
+            resumed.append(c)
+        else:
+            todo.append(c)
+    logger.info("resumed %d/%d chunk summaries from %s", len(resumed), len(chunks), path)
+    return resumed, todo

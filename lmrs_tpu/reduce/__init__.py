@@ -1,0 +1,5 @@
+"""L7 reduce: single-pass + hierarchical aggregation of chunk summaries."""
+
+from lmrs_tpu.reduce.aggregator import ResultAggregator, SimpleAggregator
+
+__all__ = ["ResultAggregator", "SimpleAggregator"]

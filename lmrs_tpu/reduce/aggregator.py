@@ -1,0 +1,222 @@
+"""Reduce stage: combine per-chunk summaries into one final summary.
+
+Successor of ``ResultAggregator`` (result_aggregator.py:26-524) and
+``SimpleAggregator`` (simple_aggregator.py:26-189), with the reference's
+defects deliberately fixed (SURVEY.md §2.3):
+
+* provider-agnostic — every reduce call routes through the same engine as the
+  map stage instead of a hardwired OpenAI POST (quirk 5);
+* the custom reduce prompt is always honored and its ``{summaries}`` /
+  ``{metadata}`` / ``{num_summaries}`` placeholders are really substituted
+  (quirk 6 — the reference only honors templates containing the magic string
+  "TIMELINE SUMMARY" and never formats placeholders);
+* the hierarchical tree recurses until the batch fits the token budget
+  (bounded by ``max_levels``) instead of stopping at exactly two levels
+  (quirk 11);
+* reduce token usage lands in the same executor counters
+  (result_aggregator.py:266-278 behavior, kept).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Sequence
+
+from lmrs_tpu.config import ReduceConfig
+from lmrs_tpu.data.chunker import Chunk
+from lmrs_tpu.data.preprocessor import format_timestamp
+from lmrs_tpu.data.tokenizer import Tokenizer, get_tokenizer
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.prompts import (
+    DEFAULT_BATCH_REDUCE_PROMPT,
+    DEFAULT_FINAL_REDUCE_PROMPT,
+    DEFAULT_REDUCE_PROMPT,
+    safe_format,
+)
+
+logger = logging.getLogger("lmrs.reduce")
+
+
+class ResultAggregator:
+    """Single-pass or hierarchical reduce over chunk summaries."""
+
+    def __init__(
+        self,
+        executor: MapExecutor,
+        config: ReduceConfig | None = None,
+        tokenizer: Tokenizer | str = "approx",
+    ):
+        self.executor = executor
+        self.config = config or ReduceConfig()
+        self.tokenizer = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+
+    # ------------------------------------------------------------------ API
+
+    def aggregate(
+        self,
+        processed_chunks: Sequence[Chunk],
+        prompt_template: str | None = None,
+        metadata: dict[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        """Reduce chunk summaries to one final summary.
+
+        Mirrors ``ResultAggregator.aggregate`` (result_aggregator.py:55-109):
+        time-tags each summary, then picks single-pass vs hierarchical by
+        total token count against ``max_tokens_per_batch``.
+        """
+        t0 = time.time()
+        chunks = sorted(processed_chunks, key=lambda c: c.chunk_index)
+        summaries = [
+            f"[Time: {format_timestamp(c.start_time)} - {format_timestamp(c.end_time)}]\n"
+            f"{c.summary or ''}"
+            for c in chunks
+        ]
+        total_tokens = self._total_tokens(summaries)
+        hierarchical = (
+            self.config.hierarchical and total_tokens > self.config.max_tokens_per_batch
+        )
+        logger.info(
+            "reduce: %d summaries, %d tokens -> %s",
+            len(summaries), total_tokens, "hierarchical" if hierarchical else "single-pass",
+        )
+        if hierarchical:
+            summary, levels = self._hierarchical(summaries, prompt_template, metadata)
+        else:
+            summary = self._reduce_once(
+                summaries, prompt_template or DEFAULT_REDUCE_PROMPT, metadata
+            )
+            levels = 1
+        return {
+            "final_summary": summary,
+            "num_chunk_summaries": len(summaries),
+            "hierarchical": hierarchical,
+            "levels": levels,
+            "aggregation_time": time.time() - t0,
+        }
+
+    # ------------------------------------------------------------ internals
+
+    def _reduce_once(
+        self,
+        summaries: list[str],
+        template: str,
+        metadata: dict[str, Any] | None,
+    ) -> str:
+        """One reduce call through the engine (reference _single_aggregation,
+        result_aggregator.py:111-286, minus its OpenAI hardwiring)."""
+        blocks = [
+            f"SUMMARY {i + 1}:\n{'=' * 20}\n{s}" for i, s in enumerate(summaries)
+        ]
+        meta_str = ", ".join(f"{k}: {v}" for k, v in (metadata or {}).items()) or "n/a"
+        prompt = safe_format(
+            template,
+            summaries="\n\n".join(blocks),
+            metadata=meta_str,
+            num_summaries=len(summaries),
+        )
+        req = GenerationRequest(
+            prompt=prompt,
+            request_id=0,
+            max_new_tokens=self.executor.config.max_tokens,
+            temperature=self.config.temperature,  # reference hardcodes 0.2
+            seed=self.executor.config.seed,
+        )
+        res = self.executor.run_requests([req])[0]
+        if res.error is not None:
+            # degrade to an error string, never raise
+            # (result_aggregator.py:256-259,284-286)
+            return f"[Error aggregating summaries: {res.error}]"
+        return res.text
+
+    def _hierarchical(
+        self,
+        summaries: list[str],
+        prompt_template: str | None,
+        metadata: dict[str, Any] | None,
+    ) -> tuple[str, int]:
+        """Recursive batch tree (reference _hierarchical_aggregation,
+        result_aggregator.py:288-355, generalized past two levels)."""
+        level = 0
+        current = summaries
+        while (
+            len(current) > 1
+            and self._total_tokens(current) > self.config.max_tokens_per_batch
+            and level < self.config.max_levels
+        ):
+            level += 1
+            batch_size = self._calculate_batch_size(current)
+            batches = [current[i : i + batch_size] for i in range(0, len(current), batch_size)]
+            logger.info(
+                "reduce level %d: %d summaries in %d batches of <=%d",
+                level, len(current), len(batches), batch_size,
+            )
+            nxt = []
+            n = len(batches)
+            for i, batch in enumerate(batches):
+                # Positional metadata per batch (result_aggregator.py:326-339)
+                lo = 100.0 * i / n
+                hi = 100.0 * (i + 1) / n
+                batch_meta = dict(metadata or {})
+                batch_meta.update(
+                    {"batch": f"{i + 1}/{n}", "position": f"{lo:.0f}%-{hi:.0f}% of the transcript"}
+                )
+                nxt.append(
+                    self._reduce_once(
+                        batch, prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, batch_meta
+                    )
+                )
+            current = nxt
+        if len(current) == 1:
+            return current[0], level
+        final = self._reduce_once(
+            current, prompt_template or DEFAULT_FINAL_REDUCE_PROMPT, metadata
+        )
+        return final, level + 1
+
+    def _calculate_batch_size(self, summaries: list[str]) -> int:
+        """Token-budgeted batch size, capped (result_aggregator.py:357-380)."""
+        total = self._total_tokens(summaries)
+        avg = max(total // max(len(summaries), 1), 1)
+        budget = self.config.max_tokens_per_batch - self.config.reserve_tokens
+        return max(1, min(self.config.max_summaries_per_batch, budget // avg))
+
+    def _total_tokens(self, summaries: list[str]) -> int:
+        return sum(self.tokenizer.count(s) for s in summaries)
+
+
+class SimpleAggregator:
+    """Minimal single-pass reduce (reference simple_aggregator.py:26-189).
+
+    Kept for debugging/reliability comparisons; always single-pass, strict
+    no-preamble system prompt."""
+
+    SYSTEM = (
+        "You combine partial summaries into one final summary. Respond with "
+        "the summary content only: no greeting, no introduction, no closing."
+    )
+
+    def __init__(self, executor: MapExecutor):
+        self.executor = executor
+
+    def aggregate(self, summaries: list[str], metadata: dict | None = None) -> str:
+        blocks = "\n\n".join(
+            f"SUMMARY {i + 1}:\n{s}" for i, s in enumerate(summaries)
+        )
+        prompt = safe_format(
+            DEFAULT_REDUCE_PROMPT,
+            summaries=blocks,
+            metadata=", ".join(f"{k}: {v}" for k, v in (metadata or {}).items()) or "n/a",
+            num_summaries=len(summaries),
+        )
+        req = GenerationRequest(
+            prompt=prompt, system_prompt=self.SYSTEM, temperature=0.2,
+            max_new_tokens=self.executor.config.max_tokens,
+        )
+        res = self.executor.run_requests([req])[0]
+        return res.text if res.error is None else f"[Error aggregating summaries: {res.error}]"
+
+
+# backwards-compat alias (tests import it from here)
+_safe_format = safe_format

@@ -1,0 +1,6 @@
+"""Shared utilities: logging, stage timing, metrics, profiling hooks."""
+
+from lmrs_tpu.utils.timing import StageTimer, format_duration
+from lmrs_tpu.utils.logging import setup_logging
+
+__all__ = ["StageTimer", "format_duration", "setup_logging"]

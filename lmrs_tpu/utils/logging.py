@@ -1,0 +1,20 @@
+"""Logging setup: one root config instead of the reference's per-module
+copy-pasted ``basicConfig`` blocks (main.py:32-40, llm_executor.py:22-26, …).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+
+
+def setup_logging(quiet: bool = False, level: int | None = None) -> None:
+    """Configure the ``lmrs`` logger tree.  quiet → WARNING (main.py --quiet)."""
+    root = logging.getLogger("lmrs")
+    if not root.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root.addHandler(handler)
+    root.setLevel(level if level is not None else (logging.WARNING if quiet else logging.INFO))

@@ -1,0 +1,127 @@
+"""Unit tests for the token-budget chunker."""
+
+import pytest
+
+from lmrs_tpu.data.chunker import Chunk, TranscriptChunker, split_sentences
+from lmrs_tpu.data.preprocessor import preprocess_transcript
+from lmrs_tpu.data.tokenizer import ApproxTokenizer, ByteTokenizer
+
+
+def test_split_sentences_basic():
+    out = split_sentences("First point. Second point! Third? Done.")
+    assert out == ["First point.", "Second point!", "Third?", "Done."]
+
+
+def test_split_sentences_protects_abbreviations():
+    out = split_sentences("Dr. Smith arrived. He spoke.")
+    assert out == ["Dr. Smith arrived.", "He spoke."]
+
+
+def test_split_sentences_empty():
+    assert split_sentences("") == []
+
+
+def _chunker(**kw):
+    defaults = dict(max_tokens_per_chunk=120, overlap_tokens=0, context_tokens=20,
+                    tokenizer="approx")
+    defaults.update(kw)
+    return TranscriptChunker(**defaults)
+
+
+def test_budget_respected(segments):
+    processed = preprocess_transcript(segments)
+    ck = _chunker()
+    chunks = ck.chunk_transcript(processed)
+    assert len(chunks) > 1
+    for c in chunks:
+        # packed token total must respect the effective budget (oversized
+        # single segments are split, so no chunk's packed content exceeds it)
+        packed = sum(ck.tokenizer.count(s["text"]) for s in c.segments)
+        assert packed <= ck.effective_max_tokens
+
+
+def test_chunk_metadata(segments):
+    processed = preprocess_transcript(segments)
+    chunks = _chunker().chunk_transcript(processed)
+    total = len(chunks)
+    for i, c in enumerate(chunks):
+        assert c.chunk_index == i
+        assert c.total_chunks == total
+        assert c.start_time <= c.end_time
+        assert c.speakers
+        assert 0.0 <= c.position_percentage <= 100.0
+    # position percentage measured on the WHOLE transcript: monotone increasing
+    pos = [c.position_percentage for c in chunks]
+    assert pos == sorted(pos)
+    assert pos[0] == pytest.approx(0.0)
+    assert pos[-1] > 50.0
+
+
+def test_context_header_contents(segments):
+    processed = preprocess_transcript(segments)
+    chunks = _chunker().chunk_transcript(processed)
+    c = chunks[1]
+    head = c.text_with_context
+    assert f"[TRANSCRIPT SECTION {c.chunk_index + 1} of {c.total_chunks}]" in head
+    assert "[TIME RANGE:" in head
+    assert "[SPEAKERS:" in head
+    assert "% through the transcript]" in head
+    assert head.endswith(c.text)
+
+
+def test_oversized_segment_is_sentence_split():
+    long_text = " ".join(f"Sentence number {i} has several words in it." for i in range(200))
+    seg = {"start": 0.0, "end": 400.0, "text": long_text, "speaker": "A"}
+    ck = _chunker(max_tokens_per_chunk=150, context_tokens=30)
+    chunks = ck.chunk_transcript([seg])
+    assert len(chunks) > 1
+    # interpolated timestamps: monotone, within the segment span
+    starts = [c.start_time for c in chunks]
+    assert starts == sorted(starts)
+    assert all(0.0 <= c.start_time <= 400.0 for c in chunks)
+    assert chunks[-1].end_time == pytest.approx(400.0, abs=1.0)
+
+
+def test_pathological_sentence_clause_split():
+    mono = "word " * 800  # one 800-word "sentence", no punctuation
+    seg = {"start": 0.0, "end": 100.0, "text": mono.strip(), "speaker": "A"}
+    ck = _chunker(max_tokens_per_chunk=120, context_tokens=20)
+    chunks = ck.chunk_transcript([seg])
+    assert len(chunks) >= 2
+    assert all(c.token_count > 0 for c in chunks)
+
+
+def test_overlap_is_real():
+    segs = [
+        {"start": float(i), "end": float(i + 1),
+         "text": f"Unique sentence number {i} with recognizable content here.",
+         "speaker": "A"}
+        for i in range(40)
+    ]
+    no_overlap = _chunker(overlap_tokens=0).chunk_transcript([dict(s) for s in segs])
+    with_overlap = _chunker(overlap_tokens=30).chunk_transcript([dict(s) for s in segs])
+    assert len(no_overlap) > 1
+    # overlapped chunks must carry context from the previous chunk
+    assert any("context from previous chunk" in c.text for c in with_overlap[1:])
+
+
+def test_empty_input():
+    assert _chunker().chunk_transcript([]) == []
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    s = "Hello, TPU world! é世界"
+    assert tok.decode(tok.encode(s)) == s
+    assert tok.count(s) == len(s.encode("utf-8"))
+
+
+def test_approx_tokenizer_count_scales():
+    tok = ApproxTokenizer()
+    assert tok.count("") == 0
+    assert tok.count("word " * 100) > tok.count("word " * 10)
+
+
+def test_chunker_rejects_bad_budget():
+    with pytest.raises(ValueError):
+        TranscriptChunker(max_tokens_per_chunk=100, context_tokens=150)

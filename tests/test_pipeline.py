@@ -1,0 +1,152 @@
+"""End-to-end pipeline + CLI tests (mock engine, CPU-only) — BASELINE.json
+config #1."""
+
+import asyncio
+import json
+
+import pytest
+
+from lmrs_tpu.cli import main as cli_main
+from lmrs_tpu.config import (
+    ChunkConfig,
+    DataConfig,
+    EngineConfig,
+    PipelineConfig,
+    ReduceConfig,
+)
+from lmrs_tpu.pipeline import TranscriptSummarizer
+
+
+def _cfg(**over):
+    base = dict(
+        chunk=ChunkConfig(max_tokens_per_chunk=200, overlap_tokens=0, context_tokens=40),
+        engine=EngineConfig(backend="mock", retry_delay=0.0),
+        reduce=ReduceConfig(max_tokens_per_batch=400, reserve_tokens=50),
+    )
+    base.update(over)
+    return PipelineConfig(**base)
+
+
+def test_end_to_end_mock(transcript):
+    s = TranscriptSummarizer(_cfg())
+    stats = s.summarize(transcript)
+    assert stats["summary"]
+    assert stats["num_chunks"] > 1
+    assert stats["num_segments"] <= stats["num_input_segments"]
+    assert stats["total_tokens_used"] > 0
+    assert stats["failed_requests"] == 0
+    assert set(stats["stage_times"]) >= {"preprocess", "chunk", "map", "reduce", "total"}
+
+
+def test_async_facade(transcript):
+    s = TranscriptSummarizer(_cfg())
+    stats = asyncio.run(s.asummarize(transcript))
+    assert stats["summary"]
+
+
+def test_ctor_overrides():
+    s = TranscriptSummarizer(
+        backend="mock", model="tiny", max_tokens_per_chunk=512,
+        max_concurrent_requests=3, hierarchical_aggregation=False,
+    )
+    assert s.config.engine.backend == "mock"
+    assert s.config.chunk.max_tokens_per_chunk == 512
+    assert s.config.engine.max_concurrent_requests == 3
+    assert s.config.reduce.hierarchical is False
+
+
+def test_limit_segments(transcript):
+    cfg = _cfg(data=DataConfig(limit_segments=20))
+    stats = TranscriptSummarizer(cfg).summarize(transcript)
+    assert stats["num_input_segments"] == 20
+
+
+def test_save_chunks_and_resume(transcript, tmp_path):
+    dump = tmp_path / "chunks.json"
+    cfg = _cfg()
+    s1 = TranscriptSummarizer(cfg)
+    stats1 = s1.summarize(transcript, save_chunks=str(dump))
+    payload = json.loads(dump.read_text())
+    assert len(payload["chunks"]) == stats1["num_chunks"]
+    assert all(c["summary"] for c in payload["chunks"])
+
+    # resume: all chunks rehydrated, no new map work
+    s2 = TranscriptSummarizer(cfg)
+    stats2 = s2.summarize(transcript, resume_from=str(dump))
+    assert stats2["num_resumed_chunks"] == stats1["num_chunks"]
+    # only reduce-stage requests were issued (num map requests == 0)
+    assert stats2["total_requests"] < stats1["total_requests"]
+    assert stats2["summary"]
+
+
+def test_custom_prompts_flow_through(transcript, tmp_path):
+    pf = tmp_path / "map.txt"
+    pf.write_text("MYMAP {transcript}")
+    sf = tmp_path / "sys.txt"
+    sf.write_text("You are terse.")
+    af = tmp_path / "agg.txt"
+    af.write_text("MYREDUCE {summaries}")
+    stats = TranscriptSummarizer(_cfg()).summarize(
+        transcript,
+        prompt_file=str(pf),
+        system_prompt_file=str(sf),
+        aggregator_prompt_file=str(af),
+    )
+    assert stats["summary"]
+
+
+def test_prompt_missing_placeholder_is_fixed(transcript, tmp_path):
+    pf = tmp_path / "map.txt"
+    pf.write_text("No placeholder at all")
+    stats = TranscriptSummarizer(_cfg()).summarize(transcript, prompt_file=str(pf))
+    assert stats["summary"]
+
+
+def test_cli_end_to_end(transcript, tmp_path, capsys):
+    inp = tmp_path / "t.json"
+    inp.write_text(json.dumps(transcript))
+    out = tmp_path / "summary.txt"
+    rc = cli_main([
+        "--input", str(inp), "--output", str(out), "--backend", "mock",
+        "--max-tokens-per-chunk", "300", "--report", "--quiet",
+    ])
+    assert rc == 0
+    assert out.read_text()
+    report = json.loads((tmp_path / "summary.txt.report.json").read_text())
+    assert report["num_chunks"] >= 1
+    assert "summary" not in report
+
+
+def test_cli_missing_input(tmp_path):
+    assert cli_main(["--input", str(tmp_path / "nope.json"), "--quiet"]) == 1
+
+
+def test_reference_example_end_to_end(example_transcript):
+    """Full 7.4h reference fixture through the mock pipeline (parity with the
+    reference's offline mock run, BASELINE.md)."""
+    cfg = PipelineConfig(
+        engine=EngineConfig(backend="mock", retry_delay=0.0),
+        chunk=ChunkConfig(max_tokens_per_chunk=4000, context_tokens=150),
+    )
+    stats = TranscriptSummarizer(cfg).summarize(example_transcript)
+    assert stats["num_input_segments"] == 4778
+    # reference baseline: 4778 -> ~171 merged segments, ~23 chunks (BASELINE.md)
+    assert 50 <= stats["num_segments"] <= 400
+    assert 10 <= stats["num_chunks"] <= 60
+    assert stats["summary"]
+
+
+def test_prompt_file_with_literal_braces(transcript, tmp_path):
+    """User prompt files may embed JSON examples; literal braces must not
+    crash formatting (safe_format, not str.format)."""
+    pf = tmp_path / "map.txt"
+    pf.write_text('Return JSON like {"topic": "..."}\n\n{transcript}')
+    stats = TranscriptSummarizer(_cfg()).summarize(transcript, prompt_file=str(pf))
+    assert stats["summary"]
+
+
+def test_unknown_backend_is_value_error(transcript):
+    from lmrs_tpu.config import EngineConfig as EC
+    cfg = _cfg(engine=EC(backend="nope"))
+    with pytest.raises(ValueError):
+        TranscriptSummarizer(cfg).summarize(transcript)

