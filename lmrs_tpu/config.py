@@ -124,6 +124,7 @@ class EngineConfig:
     retry_delay: float = field(default_factory=lambda: _env("RETRY_DELAY", 5.0, float))
     seed: int = 0
     # serving-side knobs (no reference counterpart — SURVEY.md §7.4 item 1)
+    scheduler: str = "continuous"  # "continuous" (slot-based) | "static" (lockstep waves)
     max_batch_slots: int = 8
     page_size: int = 128
     num_pages: int = 512

@@ -153,6 +153,10 @@ class TranscriptChunker:
                 continue
             if current_tokens + n > self.effective_max_tokens:
                 flush()
+                if current_tokens + n > self.effective_max_tokens:
+                    # overlap seeding left no room for this segment — drop
+                    # the overlap rather than exceed the budget
+                    current, current_tokens = [], 0
             current.append(seg)
             current_tokens += n
         if current:
@@ -294,13 +298,15 @@ class TranscriptChunker:
             n = self._count(sent)
             if n > self.effective_max_tokens:
                 flush_buf(cursor)
+                # advance the char cursor per fragment so interior flushes
+                # interpolate distinct timestamps (not the sentence start)
                 for frag in self._split_long_sentence(sent):
                     fn = self._count(frag)
                     if buf_tokens + fn > self.effective_max_tokens:
                         flush_buf(cursor)
                     buf.append(frag)
                     buf_tokens += fn
-                cursor += len(sent) + 1
+                    cursor += len(frag) + 1
                 flush_buf(cursor)
                 continue
             if buf_tokens + n > self.effective_max_tokens:

@@ -82,7 +82,9 @@ class ByteTokenizer:
         return [b + 3 for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int]) -> str:
-        return bytes(i - 3 for i in ids if i >= 3).decode("utf-8", errors="replace")
+        # ids outside [3, 258] (specials, or vocab rounded up for MXU-friendly
+        # embedding shapes) are skipped
+        return bytes(i - 3 for i in ids if 3 <= i <= 258).decode("utf-8", errors="replace")
 
     def count(self, text: str) -> int:
         return len(text.encode("utf-8"))

@@ -1,0 +1,255 @@
+"""JAX/TPU generation engine — the in-tree replacement for the reference's
+remote LLM API (SURVEY.md: "L0 and L2 fuse").
+
+Serving shape (v1 — dense KV cache; paged/continuous batching evolves in
+engine/scheduler.py):
+
+* requests are sorted by prompt length and packed into fixed-size batches of
+  ``max_batch_slots`` (the reference's ``max_concurrent_requests`` analog);
+* prompt lengths bucket to powers of two → one XLA compilation per
+  (batch, bucket) pair, cached across calls;
+* prefill runs the whole padded batch in one [B, S] forward (MXU-sized
+  matmuls), decode runs an on-device ``lax.while_loop`` — zero host↔device
+  round-trips inside a generation, early-exits when every row hits EOS;
+* sampler params (temperature/top-k/top-p) are arrays, so mixed greedy +
+  sampled batches share one compiled function.
+
+Everything here is single-program; multi-chip sharding comes from the mesh
+passed in (params placed via parallel.sharding; XLA lowers the same code to
+per-device programs with ICI collectives).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from functools import partial
+
+import jax
+
+# Environments whose sitecustomize force-registers an accelerator backend
+# (jax.config.update("jax_platforms", ...)) silently override the standard
+# JAX_PLATFORMS env var; honor an explicit cpu request here.
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
+from lmrs_tpu.data.tokenizer import ByteTokenizer, get_tokenizer
+from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+from lmrs_tpu.models.transformer import forward, init_kv_cache, init_params, param_count
+from lmrs_tpu.ops.sampling import sample_logits
+
+logger = logging.getLogger("lmrs.jax_engine")
+
+
+def _bucket(n: int, lo: int = 64) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class JaxEngine:
+    """Single-host JAX engine over a dense KV cache."""
+
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        model_cfg: ModelConfig,
+        mesh_cfg: MeshConfig | None = None,
+        params=None,
+        tokenizer=None,
+    ):
+        self.cfg = engine_cfg
+        self.model_cfg = model_cfg
+        self.mesh_cfg = mesh_cfg
+        self.tokenizer = tokenizer or self._default_tokenizer()
+        key = jax.random.PRNGKey(engine_cfg.seed)
+        t0 = time.time()
+        if params is None:
+            if engine_cfg.checkpoint_path:
+                from lmrs_tpu.models.loader import load_checkpoint
+
+                params = load_checkpoint(engine_cfg.checkpoint_path, model_cfg)
+            else:
+                logger.warning(
+                    "no checkpoint for %s: using random-init weights "
+                    "(throughput-correct, content-free)", model_cfg.name,
+                )
+                params = init_params(model_cfg, key)
+        self.params = self._place(params)
+        logger.info("model %s: %.1fM params ready in %.1fs", model_cfg.name,
+                    param_count(self.params) / 1e6, time.time() - t0)
+        self._key = jax.random.PRNGKey(engine_cfg.seed + 1)
+        self._gen_fns: dict[tuple, object] = {}  # (B, S_bucket, max_new) -> jitted
+        self._scheduler = None
+        if engine_cfg.scheduler == "continuous":
+            from lmrs_tpu.engine.scheduler import ContinuousScheduler
+
+            self._scheduler = ContinuousScheduler(
+                engine_cfg, model_cfg, self.params, self.tokenizer
+            )
+
+    # -------------------------------------------------------------- plumbing
+
+    def _default_tokenizer(self):
+        # Model-vocab authority (SURVEY.md §7.4 item 4). Byte tokenizer covers
+        # random-init models; real checkpoints name their tokenizer.
+        return ByteTokenizer() if self.model_cfg.vocab_size < 100000 else get_tokenizer("approx")
+
+    def _place(self, params):
+        """Put params on device(s); with a >1-device mesh, use TP layout."""
+        if self.mesh_cfg is not None and self.mesh_cfg.n_devices > 1:
+            from lmrs_tpu.parallel.mesh import build_mesh
+            from lmrs_tpu.parallel.sharding import shard_params
+
+            self._mesh = build_mesh(self.mesh_cfg)
+            return shard_params(params, self._mesh, self.model_cfg.tie_embeddings)
+        self._mesh = None
+        return jax.device_put(params)
+
+    def shutdown(self) -> None:
+        self._gen_fns.clear()
+
+    # -------------------------------------------------------------- generate
+
+    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+        if not requests:
+            return []
+        if self._scheduler is not None:
+            return self._scheduler.run(requests)
+        t0 = time.time()
+        # Sort by tokenized length to minimize padding waste per bucket.
+        encoded = []
+        for req in requests:
+            text = (req.system_prompt + "\n\n" if req.system_prompt else "") + req.prompt
+            ids = [self.tokenizer.bos_id] + self.tokenizer.encode(text)
+            limit = self.model_cfg.max_seq_len - self._max_new(req)
+            if len(ids) > limit:
+                # middle truncation: instructions usually bracket the content
+                head, tail = limit // 2, limit - limit // 2
+                ids = ids[:head] + ids[-tail:]
+            encoded.append((req, ids))
+        encoded.sort(key=lambda e: len(e[1]))
+
+        results: dict[int, GenerationResult] = {}
+        B = max(1, self.cfg.max_batch_slots)
+        for i in range(0, len(encoded), B):
+            group = encoded[i : i + B]
+            for req, res in self._run_group(group):
+                results[id(req)] = (req, res)[1]
+        out = [results[id(r)] for r in requests]
+        logger.info("generated %d requests in %.2fs", len(requests), time.time() - t0)
+        return out
+
+    def _max_new(self, req: GenerationRequest) -> int:
+        # one decode-length bucket per engine (single compile); respect the
+        # smaller of request/config
+        return min(req.max_new_tokens, self.cfg.max_tokens)
+
+    def _run_group(self, group):
+        B = max(1, self.cfg.max_batch_slots)
+        n = len(group)
+        s_bucket = _bucket(max(len(ids) for _, ids in group))
+        s_bucket = min(s_bucket, self.model_cfg.max_seq_len)
+        max_new = max(self._max_new(req) for req, _ in group)
+
+        tokens = np.full((B, s_bucket), self.tokenizer.pad_id, dtype=np.int32)
+        lengths = np.ones((B,), dtype=np.int32)  # dummy rows: length 1
+        temps = np.zeros((B,), dtype=np.float32)
+        top_k = np.zeros((B,), dtype=np.int32)
+        top_p = np.ones((B,), dtype=np.float32)
+        for j, (req, ids) in enumerate(group):
+            tokens[j, : len(ids)] = ids
+            lengths[j] = len(ids)
+            temps[j] = req.temperature
+            top_k[j] = req.top_k
+            top_p[j] = min(max(req.top_p, 0.0), 1.0)
+
+        fn = self._get_gen_fn(B, s_bucket, max_new)
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.time()
+        out_tokens, n_generated = fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths), sub,
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        out_tokens = np.asarray(jax.device_get(out_tokens))
+        n_generated = np.asarray(jax.device_get(n_generated))
+        dt = time.time() - t0
+
+        results = []
+        per_req_dt = dt / max(n, 1)
+        for j, (req, ids) in enumerate(group):
+            gen = out_tokens[j, : int(n_generated[j])].tolist()
+            finish = "stop"
+            if self.tokenizer.eos_id in gen:
+                gen = gen[: gen.index(self.tokenizer.eos_id)]
+            elif len(gen) >= max_new:
+                finish = "length"
+            text = self.tokenizer.decode(gen)
+            for stop in req.stop:
+                if stop in text:
+                    text = text.split(stop, 1)[0]
+                    finish = "stop"
+            results.append(
+                (req, GenerationResult(
+                    request_id=req.request_id,
+                    text=text,
+                    prompt_tokens=len(ids),
+                    completion_tokens=len(gen),
+                    finish_reason=finish,
+                    device_seconds=per_req_dt,
+                ))
+            )
+        return results
+
+    # ------------------------------------------------------------- compiled
+
+    def _get_gen_fn(self, B: int, s_bucket: int, max_new: int):
+        sig = (B, s_bucket, max_new)
+        if sig in self._gen_fns:
+            return self._gen_fns[sig]
+        cfg = self.model_cfg
+        eos_id = self.tokenizer.eos_id
+
+        @partial(jax.jit, static_argnums=())
+        def gen(params, tokens, lengths, key, temps, top_k, top_p):
+            b = tokens.shape[0]
+            cache = init_kv_cache(cfg, b, s_bucket + max_new)
+            positions = jnp.broadcast_to(jnp.arange(s_bucket)[None, :], (b, s_bucket))
+            logits, cache = forward(params, cfg, tokens, positions, cache, lengths)
+            last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+
+            out_buf = jnp.zeros((b, max_new), jnp.int32)
+            done = jnp.zeros((b,), bool)
+
+            def cond(state):
+                step, _, _, _, _, done, _ = state
+                return jnp.logical_and(step < max_new, ~jnp.all(done))
+
+            def body(state):
+                step, key, last, cache, out_buf, done, n_gen = state
+                key, sub = jax.random.split(key)
+                tok = sample_logits(last, sub, temps, top_k, top_p)
+                tok = jnp.where(done, eos_id, tok)
+                out_buf = out_buf.at[:, step].set(tok)
+                n_gen = jnp.where(done, n_gen, step + 1)
+                done = jnp.logical_or(done, tok == eos_id)
+                pos = (lengths + step)[:, None]
+                logits, cache = forward(
+                    params, cfg, tok[:, None], pos, cache, lengths + step + 1
+                )
+                return (step + 1, key, logits[:, 0], cache, out_buf, done, n_gen)
+
+            state = (0, key, last, cache, out_buf, done, jnp.zeros((b,), jnp.int32))
+            state = jax.lax.while_loop(cond, body, state)
+            _, _, _, _, out_buf, _, n_gen = state
+            return out_buf, n_gen
+
+        logger.info("compiling generate fn: batch=%d, prompt_bucket=%d, max_new=%d",
+                    B, s_bucket, max_new)
+        self._gen_fns[sig] = gen
+        return gen

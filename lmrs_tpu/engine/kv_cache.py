@@ -1,0 +1,134 @@
+"""Paged KV cache: fixed page pool + host-side page allocator.
+
+The serving-memory design SURVEY.md §7.4 ranks as hard part #1: a fixed-size
+page pool in HBM ([L, num_pages, page_size, K, hd]) with per-slot page tables,
+so KV memory is allocated in O(page) quanta instead of one max_seq_len region
+per slot.  Admission control = free pages (the reference's semaphore analog,
+SURVEY.md §2.2).
+
+The allocator is deliberately tiny and host-side (free-list); a C++
+implementation with the same interface lives in runtime/native (used when
+built — see lmrs_tpu.runtime.native) since allocator churn sits on the
+scheduler's critical path.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from lmrs_tpu.config import ModelConfig
+
+logger = logging.getLogger("lmrs.kv_cache")
+
+
+class OutOfPages(RuntimeError):
+    """Page pool exhausted — callers treat this as back-pressure, not error."""
+
+
+class PageAllocator:
+    """Free-list page allocator (python reference implementation).
+
+    Page 0 is RESERVED as the null page and never handed out: inactive batch
+    rows carry all-zero page tables, and their masked-out dummy writes must
+    land somewhere no live sequence owns (the vLLM null-block trick).
+    """
+
+    RESERVED = 1  # page 0
+
+    def __init__(self, num_pages: int):
+        if num_pages <= self.RESERVED:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, self.RESERVED - 1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfPages(f"need {n} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n)]
+        return pages
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not self.RESERVED <= p < self.num_pages:
+                raise ValueError(f"bad page id {p}")
+            self._free.append(p)
+
+
+@dataclass
+class SequencePages:
+    """Page table of one active sequence."""
+
+    pages: list[int]
+    length: int = 0  # tokens written
+
+    def capacity(self, page_size: int) -> int:
+        return len(self.pages) * page_size
+
+
+class PagedKVCache:
+    """Device page pool + per-slot host page tables.
+
+    Layout [L, K, P, page_size, hd] — kv-head-major, so one (kv head, page)
+    pair is a contiguous [page_size, hd] block (a single DMA in the ragged
+    decode kernel).  A slot's logical KV position maps to
+    (page_table[pos // ps], pos % ps).
+    """
+
+    def __init__(self, model_cfg: ModelConfig, num_pages: int, page_size: int,
+                 max_pages_per_slot: int, allocator: PageAllocator | None = None):
+        hd = model_cfg.dim // model_cfg.n_heads
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_pages_per_slot = max_pages_per_slot
+        dt = jnp.dtype(model_cfg.dtype)
+        shape = (model_cfg.n_layers, model_cfg.n_kv_heads, num_pages, page_size, hd)
+        self.k = jnp.zeros(shape, dt)
+        self.v = jnp.zeros(shape, dt)
+        self.allocator = allocator or PageAllocator(num_pages)
+        logger.info(
+            "paged KV cache: %d pages x %d tokens (%.1f MiB)",
+            num_pages, page_size,
+            2 * np.prod(shape) * dt.itemsize / 2**20,
+        )
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= self.allocator.free_count
+
+    def open_sequence(self, n_tokens: int) -> SequencePages:
+        """Allocate pages for a sequence expected to reach n_tokens (capped
+        at max_pages_per_slot — callers clamp write positions accordingly)."""
+        n = min(self.pages_needed(n_tokens), self.max_pages_per_slot)
+        return SequencePages(pages=self.allocator.alloc(n))
+
+    def grow(self, seq: SequencePages, n_tokens: int) -> None:
+        """Ensure capacity for n_tokens, allocating more pages as needed."""
+        need = self.pages_needed(n_tokens) - len(seq.pages)
+        if need > 0:
+            if len(seq.pages) + need > self.max_pages_per_slot:
+                raise OutOfPages("sequence exceeds max_pages_per_slot")
+            seq.pages.extend(self.allocator.alloc(need))
+
+    def close_sequence(self, seq: SequencePages) -> None:
+        self.allocator.free(seq.pages)
+        seq.pages = []
+        seq.length = 0
+
+    def page_table_array(self, seqs: list[SequencePages | None]) -> np.ndarray:
+        """[B, max_pages_per_slot] int32 table; unused entries point at page 0
+        (masked out by per-row lengths)."""
+        out = np.zeros((len(seqs), self.max_pages_per_slot), np.int32)
+        for i, s in enumerate(seqs):
+            if s is not None:
+                out[i, : len(s.pages)] = s.pages
+        return out

@@ -1,0 +1,344 @@
+"""Continuous-batching scheduler over a paged KV cache.
+
+The chunk list becomes a prefill/decode work queue over fixed decode slots
+(SURVEY.md §2.2: the reference's asyncio-semaphore fan-out,
+llm_executor.py:133-147, re-based onto batch-slot + page admission control):
+
+* a request is admitted when a slot is free AND the page pool can hold its
+  prompt + token budget (admission = free KV pages, the semaphore analog);
+* prefill runs one bucketed [1, S] forward writing K/V straight into the
+  sequence's pages and samples the first token on device;
+* all active slots decode together in blocks of ``decode_block`` steps per
+  dispatch (one ``lax.scan`` on device; the host syncs once per block);
+* decode attention cost is proportional to LIVE context: the page window
+  passed to the decode program is bucketed to the widest active sequence
+  (compile-per-bucket), and on TPU the ragged Pallas kernel walks only each
+  row's real pages (ops/paged_attention.py);
+* a finished slot frees its pages and the next queued request is admitted —
+  prefill and decode interleave across requests.
+
+Static shapes throughout: prompt buckets and page-window buckets are powers
+of two, the decode block is fixed — a handful of XLA compilations total,
+reused for the whole run.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+from lmrs_tpu.engine.kv_cache import PagedKVCache, SequencePages
+from lmrs_tpu.models.transformer import forward_paged
+from lmrs_tpu.ops.sampling import sample_logits
+
+logger = logging.getLogger("lmrs.scheduler")
+
+
+def _pow2_bucket(n: int, lo: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _SlotState:
+    req: GenerationRequest
+    prompt_ids: list[int]
+    max_new: int
+    seq: SequencePages
+    generated: list[int] = field(default_factory=list)
+    kv_len: int = 0
+    done: bool = False
+    t_start: float = 0.0
+
+
+class ContinuousScheduler:
+    """Host-side scheduling loop over device-side prefill/decode programs."""
+
+    def __init__(self, engine_cfg: EngineConfig, model_cfg: ModelConfig,
+                 params, tokenizer):
+        self.cfg = engine_cfg
+        self.model_cfg = model_cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.B = max(1, engine_cfg.max_batch_slots)
+        self.max_len = model_cfg.max_seq_len
+        self.decode_block = 8
+        ps = engine_cfg.page_size
+        max_pages_per_slot = -(-self.max_len // ps)
+        # pool sized so every slot can hold a full-length sequence, or the
+        # configured pool size if larger (+1: page 0 is the reserved null page)
+        num_pages = max(engine_cfg.num_pages, self.B * max_pages_per_slot + 1)
+        self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot)
+        self._use_ragged = self._pick_kernel()
+        self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
+        self._prefill_fns: dict[int, object] = {}
+        self._decode_fns: dict[int, object] = {}
+        # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
+        self.metrics = {
+            "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
+            "occupancy_sum": 0.0, "peak_pages_in_use": 0,
+        }
+
+    def _pick_kernel(self) -> bool:
+        if self.cfg.scheduler == "continuous":
+            try:
+                platform = jax.devices()[0].platform
+            except Exception:
+                platform = "cpu"
+            hd = self.model_cfg.dim // self.model_cfg.n_heads
+            # ragged kernel wants MXU-friendly head_dim and a TPU backend
+            return platform not in ("cpu", "gpu") and hd % 128 == 0
+        return False
+
+    # ----------------------------------------------------------- public API
+
+    def run(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+        queue: deque[tuple[GenerationRequest, list[int], int]] = deque()
+        for req in requests:
+            ids, max_new = self._encode(req)
+            queue.append((req, ids, max_new))
+
+        slots: list[_SlotState | None] = [None] * self.B
+        last_tok = np.zeros((self.B,), np.int32)
+        kv_lens = np.zeros((self.B,), np.int32)
+        active = np.zeros((self.B,), bool)
+        temps = np.zeros((self.B,), np.float32)
+        top_k = np.zeros((self.B,), np.int32)
+        top_p = np.ones((self.B,), np.float32)
+        results: dict[int, GenerationResult] = {}
+
+        usable_pages = self.cache.num_pages - 1  # minus reserved null page
+
+        def admit():
+            for b in range(self.B):
+                if slots[b] is not None or not queue:
+                    continue
+                req, ids, max_new = queue[0]
+                # + decode_block: decode overshoots the budget by up to a
+                # block between host syncs; those writes need real pages.
+                # Need is capped at max_pages_per_slot (decode write positions
+                # are clamped below max_seq_len, so a capped allocation is
+                # never written past).
+                budget = len(ids) + max_new + self.decode_block
+                need = min(self.cache.pages_needed(budget),
+                           self.cache.max_pages_per_slot)
+                if need > usable_pages:
+                    # can NEVER be admitted: fail the request instead of
+                    # busy-waiting forever (degrade-and-continue contract)
+                    queue.popleft()
+                    results[req.request_id] = GenerationResult(
+                        request_id=req.request_id, finish_reason="error",
+                        error=f"request needs {need} KV pages; pool has "
+                              f"{usable_pages}",
+                    )
+                    continue
+                if need > self.cache.allocator.free_count:
+                    break  # back-pressure: wait for pages to free up
+                queue.popleft()
+                seq = self.cache.open_sequence(budget)
+                st = _SlotState(req=req, prompt_ids=ids, max_new=max_new,
+                                seq=seq, t_start=time.time())
+                tok0 = self._prefill(st)
+                st.kv_len = len(ids)
+                st.generated.append(tok0)
+                slots[b] = st
+                last_tok[b] = tok0
+                kv_lens[b] = st.kv_len
+                active[b] = True
+                temps[b] = req.temperature
+                top_k[b] = req.top_k
+                top_p[b] = min(max(req.top_p, 0.0), 1.0)
+                self.metrics["prefill_tokens"] += len(ids)
+                in_use = self.cache.num_pages - self.cache.allocator.free_count
+                self.metrics["peak_pages_in_use"] = max(
+                    self.metrics["peak_pages_in_use"], in_use)
+                self._maybe_finish(b, slots, results, active)
+
+        admit()
+        while queue or any(s is not None for s in slots):
+            admit()
+            if not any(s is not None for s in slots):
+                continue
+            self.metrics["occupancy_sum"] += float(np.mean(active))
+            self.metrics["decode_dispatches"] += 1
+            toks, n_valid = self._decode_block(slots, last_tok, kv_lens, active,
+                                               temps, top_k, top_p)
+            for b in range(self.B):
+                st = slots[b]
+                if st is None or not active[b]:
+                    continue
+                valid = int(n_valid[b])
+                st.generated.extend(toks[b, :valid].tolist())
+                st.kv_len += valid
+                kv_lens[b] = st.kv_len
+                last_tok[b] = st.generated[-1] if st.generated else 0
+                self.metrics["decode_tokens"] += valid
+                self._maybe_finish(b, slots, results, active)
+
+        return [results[r.request_id] for r in requests]
+
+    # ------------------------------------------------------------ internals
+
+    def _encode(self, req: GenerationRequest) -> tuple[list[int], int]:
+        text = (req.system_prompt + "\n\n" if req.system_prompt else "") + req.prompt
+        ids = [self.tokenizer.bos_id] + self.tokenizer.encode(text)
+        max_new = min(req.max_new_tokens, self.cfg.max_tokens)
+        limit = self.max_len - max_new
+        if len(ids) > limit:
+            head, tail = limit // 2, limit - limit // 2
+            ids = ids[:head] + ids[-tail:]
+        return ids, max_new
+
+    def _maybe_finish(self, b, slots, results, active):
+        st = slots[b]
+        # decode runs in fixed blocks, so a slot can overshoot its budget by
+        # up to decode_block-1 tokens between host syncs — trim to budget
+        gen = st.generated[: st.max_new]
+        eos = self.tokenizer.eos_id
+        hit_eos = eos in gen
+        if hit_eos:
+            gen = gen[: gen.index(eos)]
+        text = self.tokenizer.decode(gen)
+        stop_hit = None
+        for stop in st.req.stop:
+            if stop in text:
+                stop_hit = stop
+                break
+        if hit_eos or stop_hit or len(gen) >= st.max_new:
+            if stop_hit:
+                text = text.split(stop_hit, 1)[0]
+            finish = "stop" if (hit_eos or stop_hit) else "length"
+            results[st.req.request_id] = GenerationResult(
+                request_id=st.req.request_id,
+                text=text,
+                prompt_tokens=len(st.prompt_ids),
+                completion_tokens=len(gen),
+                finish_reason=finish,
+                device_seconds=time.time() - st.t_start,
+            )
+            self.cache.close_sequence(st.seq)
+            slots[b] = None
+            active[b] = False
+
+    # ------------------------------------------------------------- prefill
+
+    def _prefill(self, st: _SlotState) -> int:
+        ids = st.prompt_ids
+        s_bucket = min(_pow2_bucket(len(ids), 64), self.max_len)
+        fn = self._get_prefill_fn(s_bucket)
+        tokens = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        tokens[0, : len(ids)] = ids
+        table = self.cache.page_table_array([st.seq])  # [1, W]
+        alloc_tokens = st.seq.capacity(self.cache.page_size)
+        self._key, sub = jax.random.split(self._key)
+        tok0, self.cache.k, self.cache.v = fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray([len(ids)], jnp.int32),
+            jnp.asarray([alloc_tokens], jnp.int32),
+            jnp.asarray(table), sub,
+            jnp.asarray([st.req.temperature], np.float32),
+            jnp.asarray([st.req.top_k], np.int32),
+            jnp.asarray([min(max(st.req.top_p, 0.0), 1.0)], np.float32),
+        )
+        return int(tok0[0])
+
+    def _get_prefill_fn(self, s_bucket: int):
+        if s_bucket in self._prefill_fns:
+            return self._prefill_fns[s_bucket]
+        cfg = self.model_cfg
+        rope_max = self.max_len
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, k_pages, v_pages, tokens, length, alloc_tokens,
+                    table, key, temp, tk, tp):
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None], tokens.shape)
+            # Padded tail positions can exceed this sequence's allocated
+            # pages (prompt bucket > budget); clamp their page writes INTO
+            # the owned region — garbage there is masked by kv_lens, whereas
+            # an out-of-table write would corrupt another sequence's page.
+            write_pos = jnp.minimum(positions, alloc_tokens[:, None] - 1)
+            logits, k_pages, v_pages = forward_paged(
+                params, cfg, tokens, write_pos, k_pages, v_pages, table,
+                length, rope_max, use_ragged_kernel=False,
+            )
+            last = jnp.take_along_axis(logits, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = sample_logits(last, key, temp, tk, tp)
+            return tok0, k_pages, v_pages
+
+        logger.info("compiling paged prefill: bucket=%d", s_bucket)
+        self._prefill_fns[s_bucket] = prefill
+        return prefill
+
+    # -------------------------------------------------------------- decode
+
+    def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k, top_p):
+        # page window bucketed to the widest active sequence (+ block growth)
+        max_pages = 1
+        for b, st in enumerate(slots):
+            if st is not None:
+                need = self.cache.pages_needed(st.kv_len + self.decode_block)
+                max_pages = max(max_pages, need)
+        w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
+        fn = self._get_decode_fn(w)
+        table = self.cache.page_table_array([s.seq if s else None for s in slots])
+        self._key, sub = jax.random.split(self._key)
+        toks, n_valid, self.cache.k, self.cache.v = fn(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(last_tok), jnp.asarray(kv_lens),
+            jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        return (np.asarray(jax.device_get(toks)),
+                np.asarray(jax.device_get(n_valid)))
+
+    def _get_decode_fn(self, w: int):
+        if w in self._decode_fns:
+            return self._decode_fns[w]
+        cfg = self.model_cfg
+        n_steps = self.decode_block
+        eos_id = self.tokenizer.eos_id
+        max_len = self.max_len
+        rope_max = self.max_len
+        use_ragged = self._use_ragged
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode(params, k_pages, v_pages, last_tok, kv_lens, table, active,
+                   key, temps, tk, tp):
+            def step(carry, _):
+                k_pages, v_pages, tok, lens, done, key = carry
+                pos = jnp.minimum(lens, max_len - 1)[:, None]
+                logits, k_pages, v_pages = forward_paged(
+                    params, cfg, tok[:, None], pos, k_pages, v_pages, table,
+                    jnp.minimum(lens + 1, max_len), rope_max,
+                    use_ragged_kernel=use_ragged,
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample_logits(logits[:, 0], sub, temps, tk, tp)
+                nxt = jnp.where(done, eos_id, nxt)
+                newly_done = jnp.logical_or(done, nxt == eos_id)
+                lens = jnp.where(done, lens, lens + 1)
+                return (k_pages, v_pages, nxt, lens, newly_done, key), (nxt, ~done)
+
+            carry = (k_pages, v_pages, last_tok, kv_lens, ~active, key)
+            (k_pages, v_pages, _, _, _, _), (toks, valid) = jax.lax.scan(
+                step, carry, None, length=n_steps)
+            toks = jnp.transpose(toks)
+            valid = jnp.transpose(valid)
+            return toks, jnp.sum(valid, axis=1), k_pages, v_pages
+
+        logger.info("compiling paged decode: B=%d steps=%d window=%d pages "
+                    "(ragged_kernel=%s)", self.B, n_steps, w, use_ragged)
+        self._decode_fns[w] = decode
+        return decode

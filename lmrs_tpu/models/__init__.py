@@ -1,0 +1,10 @@
+"""L3 model zoo: decoder-only transformers as functional pytrees."""
+
+from lmrs_tpu.models.transformer import (
+    forward,
+    init_kv_cache,
+    init_params,
+    param_count,
+)
+
+__all__ = ["forward", "init_kv_cache", "init_params", "param_count"]

@@ -1,0 +1,254 @@
+"""Decoder-only transformer (Llama-3 / Gemma family) as a functional pytree.
+
+Design choices (TPU-first, not a torch translation):
+
+* **Pure functions over pytrees** — params are nested dicts of arrays; no
+  module classes.  Plays directly with jit/shard_map/optax.
+* **Stacked layers + ``lax.scan``** — all layer weights carry a leading
+  ``n_layers`` axis and the layer loop is a scan, so compile time and HLO
+  size are O(1) in depth (32-layer 8B compiles as fast as the 4-layer tiny).
+* **Single forward for prefill AND decode** — the same traced function
+  handles [B, S] prefill and [B, 1] decode against a KV cache; masking is
+  driven by absolute positions + valid-length arrays (static shapes only, no
+  data-dependent Python control flow).
+* **GQA + RoPE + RMSNorm + SwiGLU**, optional Gemma quirks (embedding scale,
+  logit softcap, tied embeddings).
+
+The reference has no model code at all — the LLM lives behind OpenAI's API
+(SURVEY.md L0, llm_executor.py:292).  This module is the heart of what the
+TPU build internalizes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from lmrs_tpu.config import ModelConfig
+from lmrs_tpu.ops.attention import attention
+from lmrs_tpu.ops.norms import rms_norm
+from lmrs_tpu.ops.rope import apply_rope, rope_table
+
+Params = dict[str, Any]
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random-init params (truncated-normal fan-in scaling), stacked layers."""
+    dt = _dtype(cfg)
+    hd = cfg.dim // cfg.n_heads
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def tn(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    L = cfg.n_layers
+    lk = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": {"weight": tn(k_embed, (cfg.vocab_size, cfg.dim), cfg.dim)},
+        "layers": {
+            "ln_attn": {"scale": jnp.zeros((L, cfg.dim), dt)},
+            "ln_mlp": {"scale": jnp.zeros((L, cfg.dim), dt)},
+            "attn": {
+                "wq": tn(lk[0], (L, cfg.dim, cfg.n_heads, hd), cfg.dim),
+                "wk": tn(lk[1], (L, cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
+                "wv": tn(lk[2], (L, cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
+                "wo": tn(lk[3], (L, cfg.n_heads, hd, cfg.dim), cfg.n_heads * hd),
+            },
+            "mlp": {
+                "w_gate": tn(lk[4], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_up": tn(lk[5], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_down": tn(lk[6], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            },
+        },
+        "final_norm": {"scale": jnp.zeros((cfg.dim,), dt)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"weight": tn(k_head, (cfg.dim, cfg.vocab_size), cfg.dim)}
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, jnp.ndarray]:
+    """Dense per-slot KV cache [L, B, S, K, hd] (paged cache: engine/kv_cache)."""
+    hd = cfg.dim // cfg.n_heads
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, hd)
+    dt = _dtype(cfg)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [B, S] int32
+    positions: jnp.ndarray,   # [B, S] absolute positions
+    cache: dict[str, jnp.ndarray] | None = None,  # dense KV cache or None
+    kv_length: jnp.ndarray | None = None,         # [B] valid KV len AFTER this call's writes
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray] | None]:
+    """Forward pass; returns (logits [B,S,V] f32, updated cache).
+
+    With a cache: K/V for `tokens` are scattered into it at `positions` and
+    attention reads the cache (prefill S>1 or decode S=1 both work).
+    Without a cache: plain causal self-attention over the sequence.
+    """
+    dt = _dtype(cfg)
+    b, s = tokens.shape
+    hd = cfg.dim // cfg.n_heads
+    x = params["embed"]["weight"][tokens]  # [B,S,D] gather
+    if cfg.embed_scale:  # Gemma multiplies by sqrt(dim)
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(dt)
+
+    max_pos = cache["k"].shape[2] if cache is not None else s
+    sin, cos = rope_table(max_pos, hd, cfg.rope_theta)
+    batch_idx = jnp.arange(b)[:, None]  # [B,1] for cache scatter
+
+    def layer_fn(x, xs):
+        lp, ck, cv = xs  # layer params, cache slices [B, Smax, K, hd]
+        h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+        q = apply_rope(q, positions, sin, cos)
+        k = apply_rope(k, positions, sin, cos)
+        if ck is not None:
+            ck = ck.at[batch_idx, positions].set(k)
+            cv = cv.at[batch_idx, positions].set(v)
+            attn_out = attention(q, ck, cv, positions, kv_length,
+                                 logit_softcap=None)
+        else:
+            attn_out = attention(q, k, v, positions, kv_length, logit_softcap=None)
+        o = jnp.einsum("bshk,hkd->bsd", attn_out,
+                       lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
+        x = x + o
+
+        h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
+        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
+        x = x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+        return x, (ck, cv)
+
+    if cache is not None:
+        xs = (params["layers"], cache["k"], cache["v"])
+    else:
+        xs = (params["layers"], None, None)
+
+    # lax.scan over stacked layers: wq etc. are [L, ...]; cache [L, B, ...]
+    if cache is not None:
+        x, (new_k, new_v) = jax.lax.scan(layer_fn, x, xs)
+        new_cache = {"k": new_k, "v": new_v}
+    else:
+        def layer_fn_nocache(x, lp):
+            x, _ = layer_fn(x, (lp, None, None))
+            return x, None
+        x, _ = jax.lax.scan(layer_fn_nocache, x, params["layers"])
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["weight"])
+    logits = logits.astype(jnp.float32)
+    if cfg.logit_softcap:
+        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    return logits, new_cache
+
+
+def forward_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,       # [B, S] int32
+    positions: jnp.ndarray,    # [B, S] absolute positions
+    k_pages: jnp.ndarray,      # [L, K, P, ps, hd]
+    v_pages: jnp.ndarray,      # [L, K, P, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] page ids
+    kv_lens: jnp.ndarray,      # [B] valid tokens AFTER this call's writes
+    rope_max: int,
+    use_ragged_kernel: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
+
+    Returns (logits [B,S,V] f32, k_pages, v_pages).  K/V of `tokens` are
+    scattered into the pages named by ``page_tables`` at
+    (page_tables[b, pos//ps], pos%ps).
+
+    Prefill (S>1, fresh sequence starting at position 0) attends the current
+    tokens directly (flash path eligible); decode (S==1) attends the paged
+    pool — via the ragged Pallas kernel on TPU or the gather fallback.
+    """
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas, paged_decode_xla
+
+    dt = _dtype(cfg)
+    b, s = tokens.shape
+    hd = cfg.dim // cfg.n_heads
+    ps = k_pages.shape[3]
+    x = params["embed"]["weight"][tokens]
+    if cfg.embed_scale:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(dt)
+
+    sin, cos = rope_table(rope_max, hd, cfg.rope_theta)
+    is_decode = s == 1
+
+    page_idx = jnp.take_along_axis(
+        page_tables, jnp.clip(positions // ps, 0, page_tables.shape[1] - 1), axis=1
+    )  # [B, S] physical page per token
+    offsets = positions % ps
+    batch_r = jnp.arange(b)[:, None]
+
+    def layer_fn(x, xs):
+        lp, kp, vp = xs  # kp/vp: [K, P, ps, hd]
+        h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+        q = apply_rope(q, positions, sin, cos)
+        k = apply_rope(k, positions, sin, cos)
+
+        # scatter current K/V into the page pool: [K, P, ps, hd] at
+        # [kh, page_idx[b,s], offsets[b,s]]
+        kp = kp.at[:, page_idx, offsets].set(k.transpose(2, 0, 1, 3))
+        vp = vp.at[:, page_idx, offsets].set(v.transpose(2, 0, 1, 3))
+
+        if is_decode:
+            if use_ragged_kernel:
+                attn = paged_decode_pallas(q[:, 0], kp, vp, page_tables, kv_lens)
+            else:
+                attn = paged_decode_xla(q[:, 0], kp, vp, page_tables, kv_lens)
+            attn_out = attn[:, None]  # [B, 1, H, hd]
+        else:
+            # fresh prefill: current tokens ARE the whole context
+            attn_out = attention(q, k, v, positions, kv_lens)
+        o = jnp.einsum("bshk,hkd->bsd", attn_out,
+                       lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
+        x = x + o
+
+        h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
+        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
+        x = x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_pages, v_pages)
+    )
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["weight"])
+    logits = logits.astype(jnp.float32)
+    if cfg.logit_softcap:
+        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    return logits, new_k, new_v

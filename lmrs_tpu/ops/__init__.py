@@ -1,0 +1,8 @@
+"""L4 ops: core numerical kernels (XLA-fused reference paths + Pallas)."""
+
+from lmrs_tpu.ops.norms import rms_norm
+from lmrs_tpu.ops.rope import apply_rope, rope_table
+from lmrs_tpu.ops.attention import attention
+from lmrs_tpu.ops.sampling import sample_logits
+
+__all__ = ["apply_rope", "attention", "rms_norm", "rope_table", "sample_logits"]

@@ -1,0 +1,174 @@
+"""Pallas flash-attention (prefill) kernel for TPU.
+
+Online-softmax tiling (flash-attention v2 schedule): grid over
+(batch, q_head, q_block, kv_block) with f32 running max / sum / accumulator
+in VMEM scratch; KV blocks stream through VMEM, so memory is O(blocks) not
+O(S²) and the matmuls are MXU-shaped.  GQA is handled in the index maps — a
+query head reads its kv-head's blocks directly, no materialized repeat.
+
+Causal + ragged masking: blocks entirely above the diagonal are skipped
+(predicated off), the diagonal block is masked elementwise, and a per-row
+valid-length (`lengths`, from SMEM) masks padded KV — the kernel equivalent
+of ops.attention's (causal & kv_length) rule.
+
+Correctness contract: must match ops.attention.attention() to f32 tolerance —
+see tests/test_kernels.py.  Falls back to interpret mode off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    lengths_ref,  # SMEM [1] valid kv length for this batch row
+    q_ref,        # VMEM [1, 1, QB, hd]
+    k_ref,        # VMEM [1, 1, KB, hd]
+    v_ref,        # VMEM [1, 1, KB, hd]
+    o_ref,        # VMEM [1, 1, QB, hd]
+    m_scr,        # VMEM [QB, 128] f32 running max
+    l_scr,        # VMEM [QB, 128] f32 running sum
+    acc_scr,      # VMEM [QB, hd] f32 accumulator
+    *,
+    q_block: int,
+    kv_block: int,
+    sm_scale: float,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * q_block
+    k_start = ki * kv_block
+    length = lengths_ref[0]
+
+    # A KV block is live iff some query row can see it: k_start <= last query
+    # position, and it intersects the valid prefix.
+    live = jnp.logical_and(
+        k_start <= q_start + q_block - 1, k_start < length
+    )
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [QB, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [KB, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [QB, KB]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, kv_block), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, kv_block), 1)
+        mask = jnp.logical_and(k_pos <= q_pos, k_pos < length)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [QB, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [QB, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)            # [QB, 1]
+        p = jnp.exp(s - m_new)                     # [QB, KB]
+        # fully-masked rows: m_new == NEG_INF -> p == exp(0) == 1; zero them
+        p = jnp.where(m_new > NEG_INF * 0.5, p, 0.0)
+
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)        # [KB, hd]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("q_block", "kv_block", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,          # [B, Sq, H, hd]
+    k: jnp.ndarray,          # [B, Skv, K, hd]
+    v: jnp.ndarray,          # [B, Skv, K, hd]
+    lengths: jnp.ndarray | None = None,  # [B] valid kv length
+    q_block: int = 256,
+    kv_block: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal flash attention over fresh (position-0-based) sequences.
+
+    Requires Sq == Skv (self-attention prefill / training).  Returns
+    [B, Sq, H, hd] in q.dtype.
+    """
+    b, sq, h, hd = q.shape
+    skv, kh = k.shape[1], k.shape[2]
+    assert sq == skv, "flash_attention is for self-attention prefill"
+    n_rep = h // kh
+    if lengths is None:
+        lengths = jnp.full((b,), sq, jnp.int32)
+
+    q_block = min(q_block, sq)
+    kv_block = min(kv_block, skv)
+    pad_q = (-sq) % q_block
+    pad_kv = (-skv) % kv_block
+    if pad_q or pad_kv:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+    sq_p, skv_p = q.shape[1], k.shape[1]
+
+    # head-major layout for blocking
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
+    kt = k.transpose(0, 2, 1, 3)  # [B, K, S, hd]
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, h, sq_p // q_block, skv_p // kv_block)
+    kernel = functools.partial(
+        _flash_kernel, q_block=q_block, kv_block=kv_block, sm_scale=hd ** -0.5
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda bi, hi, qi, ki: (bi,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, q_block, hd),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, kv_block, hd),
+                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, kv_block, hd),
+                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, q_block, hd),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((q_block, 128), jnp.float32),
+            pltpu.VMEM((q_block, 128), jnp.float32),
+            pltpu.VMEM((q_block, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qt, kt, vt)
+
+    out = out.transpose(0, 2, 1, 3)  # back to [B, S, H, hd]
+    if pad_q:
+        out = out[:, :sq]
+    return out

@@ -1,0 +1,22 @@
+"""Normalization ops.
+
+RMSNorm in f32 accumulation regardless of input dtype — the standard TPU
+recipe (bf16 inputs, f32 statistics) so XLA fuses it into the surrounding
+matmuls without precision loss."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm: x * rsqrt(mean(x^2)) * (1 + scale) computed in f32.
+
+    Uses the (1 + scale) parameterization (Gemma/Llama-3 style) so a
+    zero-initialized scale is the identity transform.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * (1.0 + scale.astype(jnp.float32))).astype(dtype)

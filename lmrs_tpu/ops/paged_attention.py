@@ -1,0 +1,180 @@
+"""Paged decode attention: single-token queries against a paged KV pool.
+
+Two implementations with one contract:
+
+* ``paged_decode_xla`` — gather-based fallback (any platform): gathers the
+  slot's pages into a contiguous [B, W, K, hd] window and runs masked
+  attention.  Cost ∝ the (bucketed) window, independent of real lengths.
+* ``paged_decode_pallas`` — ragged Pallas kernel (TPU): grid over
+  (batch, kv_head); each program walks ONLY its row's live pages — a dynamic
+  ``fori_loop`` bound from SMEM — DMA-ing K/V pages HBM→VMEM and folding them
+  into an online softmax.  Decode cost is proportional to the tokens actually
+  in the cache (the Ragged Paged Attention idea, PAPERS.md), which is the
+  whole point of paging: decode is HBM-bandwidth-bound and the bandwidth
+  spent is exactly the live KV bytes.
+
+Cache layout: [K, P, page_size, hd] per layer (kv-head-major so one page of
+one kv head is a contiguous [page_size, hd] DMA).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------ XLA fallback
+
+
+def paged_decode_xla(
+    q: jnp.ndarray,            # [B, H, hd]
+    k_pages: jnp.ndarray,      # [K, P, ps, hd]
+    v_pages: jnp.ndarray,      # [K, P, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] page ids (live window)
+    kv_lens: jnp.ndarray,      # [B] tokens in cache (incl. current)
+) -> jnp.ndarray:
+    b, h, hd = q.shape
+    kh, _, ps, _ = k_pages.shape
+    n_rep = h // kh
+    w = page_tables.shape[1]
+    # gather pages: [K, B, W, ps, hd] -> [B, W*ps, K, hd]
+    k = k_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(b, w * ps, kh, hd)
+    v = v_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(b, w * ps, kh, hd)
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    logits = jnp.einsum("bhd,bkhd->bhk", q, k).astype(jnp.float32) * hd**-0.5
+    pos = jnp.arange(w * ps)[None, None, :]
+    mask = pos < kv_lens[:, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
+
+
+# ------------------------------------------------------------ Pallas kernel
+
+
+def _ragged_decode_kernel(
+    # scalar prefetch
+    page_tables_ref,  # SMEM [B, W]
+    kv_lens_ref,      # SMEM [B]
+    # inputs
+    q_ref,            # VMEM [1, n_rep, hd] (this batch row, this kv head's group)
+    k_hbm,            # ANY  [P, ps, hd] (this kv head's page pool)
+    v_hbm,            # ANY  [P, ps, hd]
+    # output
+    o_ref,            # VMEM [1, n_rep, hd]
+    # scratch
+    k_scr,            # VMEM [ps, hd]
+    v_scr,            # VMEM [ps, hd]
+    acc_scr,          # VMEM [n_rep, hd] f32
+    m_scr,            # VMEM [n_rep, 128] f32
+    l_scr,            # VMEM [n_rep, 128] f32
+    sem,              # DMA semaphores (2,)
+    *,
+    page_size: int,
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    length = kv_lens_ref[b]
+    n_pages = jax.lax.div(length + page_size - 1, page_size)
+
+    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+    q = q_ref[0].astype(jnp.float32)  # [n_rep, hd]
+
+    def body(p, _):
+        page = page_tables_ref[b, p]
+        ck = pltpu.make_async_copy(k_hbm.at[page], k_scr, sem.at[0])
+        cv = pltpu.make_async_copy(v_hbm.at[page], v_scr, sem.at[1])
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+        k = k_scr[:].astype(jnp.float32)  # [ps, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [n_rep, ps]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], page_size), 1
+        )
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pw = jnp.exp(s - m_new)
+        pw = jnp.where(m_new > NEG_INF * 0.5, pw, 0.0)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(pw, axis=1, keepdims=True), l_scr.shape
+        )
+        vv = v_scr[:].astype(jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pw, vv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        return _
+
+    jax.lax.fori_loop(0, n_pages, body, None)
+    l = l_scr[:, :1]
+    o_ref[0] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_pallas(
+    q: jnp.ndarray,            # [B, H, hd]
+    k_pages: jnp.ndarray,      # [K, P, ps, hd]
+    v_pages: jnp.ndarray,      # [K, P, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W]
+    kv_lens: jnp.ndarray,      # [B]
+    interpret: bool = False,
+) -> jnp.ndarray:
+    b, h, hd = q.shape
+    kh, _, ps, _ = k_pages.shape
+    n_rep = h // kh
+    # group query heads by kv head: [B, K, n_rep, hd]
+    qg = q.reshape(b, kh, n_rep, hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kh),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_rep, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, n_rep, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((ps, hd), k_pages.dtype),
+            pltpu.VMEM((ps, hd), v_pages.dtype),
+            pltpu.VMEM((n_rep, hd), jnp.float32),
+            pltpu.VMEM((n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_rep, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    def kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
+               k_scr, v_scr, acc_scr, m_scr, l_scr, sem):
+        ki = pl.program_id(1)
+        _ragged_decode_kernel(
+            pt_ref, len_ref,
+            q_ref.at[0], k_hbm.at[ki], v_hbm.at[ki], o_ref.at[0],
+            k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+            page_size=ps, sm_scale=hd**-0.5,
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, n_rep, hd), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(b, h, hd)

@@ -1,0 +1,39 @@
+"""Rotary position embeddings.
+
+Precomputed sin/cos tables (static shapes, computed once per compile) applied
+to query/key heads.  Table layout [S, head_dim/2] keeps the apply step a pure
+elementwise op that XLA fuses into the attention projections."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(max_seq_len: int, head_dim: int, theta: float = 10000.0):
+    """Returns (sin, cos) tables of shape [max_seq_len, head_dim // 2]."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [S, half]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., S, n_heads, head_dim]
+    positions: jnp.ndarray,  # [..., S] absolute positions
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate pairs (x[..2i], x[..2i+1]) by the position angle.
+
+    Uses the "split halves" convention (first half paired with second half),
+    matching Llama's reference formulation.
+    """
+    half = x.shape[-1] // 2
+    s = sin[positions]  # [..., S, half]
+    c = cos[positions]
+    s = s[..., None, :]  # broadcast over heads: [..., S, 1, half]
+    c = c[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

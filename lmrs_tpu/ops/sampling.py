@@ -1,0 +1,52 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+All branches are trace-friendly (lax.cond-free formulations using where-masks)
+so one compiled function serves every request's sampler config — the sampler
+parameters arrive as arrays, not Python values, keeping the decode step's
+compilation cache to a single entry.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] 0.0 => greedy
+    top_k: jnp.ndarray,  # [B] int32, 0 => disabled
+    top_p: jnp.ndarray,  # [B] f32, 1.0 => disabled
+) -> jnp.ndarray:
+    """Returns sampled token ids [B].
+
+    Greedy is expressed as temperature==0 (the categorical draw is replaced by
+    argmax via where), so batches can mix greedy and sampled requests.
+    """
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(logits, axis=-1)
+
+    # temperature scaling (guard divide-by-zero; greedy rows overridden below)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+
+    # top-k mask: keep the k largest per row (k==0 disables)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    k_mask = jnp.where((top_k > 0)[:, None], scaled >= kth, True)
+
+    # top-p (nucleus) mask over the sorted distribution
+    probs_desc = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_desc, axis=-1)
+    # keep tokens whose cumulative prob (exclusive) < top_p
+    cutoff_count = jnp.sum(cum - probs_desc < top_p[:, None], axis=-1)  # [B]
+    p_idx = jnp.clip(cutoff_count - 1, 0, v - 1)
+    pth = jnp.take_along_axis(sorted_desc, p_idx[:, None], axis=-1)
+    p_mask = jnp.where((top_p < 1.0)[:, None], scaled >= pth, True)
+
+    masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy_ids)

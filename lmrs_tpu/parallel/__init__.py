@@ -1,0 +1,24 @@
+"""L5 parallelism: device mesh, sharding specs, collectives, multi-host init.
+
+The reference has NO device parallelism — its "distributed" layer is asyncio
+HTTP fan-out (SURVEY.md §2.2/§5.8).  This package is the TPU-native
+replacement: mesh axes (dp, tp, sp, pp), pjit/NamedSharding param layouts,
+XLA collectives over ICI, and jax.distributed for multi-host DCN.
+"""
+
+from lmrs_tpu.parallel.mesh import build_mesh, local_mesh_config
+from lmrs_tpu.parallel.sharding import (
+    batch_spec,
+    param_shardings,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "batch_spec",
+    "build_mesh",
+    "local_mesh_config",
+    "param_shardings",
+    "param_specs",
+    "shard_params",
+]

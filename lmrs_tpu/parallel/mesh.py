@@ -1,0 +1,68 @@
+"""Device mesh construction.
+
+Axes (SURVEY.md §2.2 "TPU-native equivalent to build"):
+
+* ``dp`` — data parallel: independent chunk streams (the successor of the
+  reference's asyncio request fan-out, llm_executor.py:133-147).  Crosses DCN
+  in multi-slice deployments.
+* ``tp`` — tensor parallel: attention heads + FFN sharded over ICI.
+* ``sp`` — sequence/context parallel: ring attention for single chunks whose
+  KV exceeds one chip (SURVEY.md §5.7 tier b).
+* ``pp`` — pipeline parallel: layer stages for the 70B tier.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from lmrs_tpu.config import MeshConfig
+
+logger = logging.getLogger("lmrs.mesh")
+
+
+def build_mesh(cfg: MeshConfig | None = None, devices: list | None = None) -> Mesh:
+    """Build a Mesh with axes (dp, tp, sp, pp) from available devices.
+
+    With no config, all local devices land on the ``dp`` axis.  Axis sizes
+    must multiply to the device count used.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if cfg is None:
+        cfg = MeshConfig(dp=n)
+    want = cfg.n_devices
+    if want > n:
+        raise ValueError(f"mesh needs {want} devices ({cfg}), only {n} available")
+    arr = np.array(devices[:want]).reshape(cfg.dp, cfg.tp, cfg.sp, cfg.pp)
+    mesh = Mesh(arr, axis_names=cfg.axis_names)
+    logger.info("mesh: dp=%d tp=%d sp=%d pp=%d over %d %s device(s)",
+                cfg.dp, cfg.tp, cfg.sp, cfg.pp, want, devices[0].platform)
+    return mesh
+
+
+def local_mesh_config() -> MeshConfig:
+    """All local devices on dp — the zero-config default."""
+    return MeshConfig(dp=len(jax.devices()))
+
+
+def initialize_distributed(coordinator: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bring-up over DCN (jax.distributed).
+
+    The reference's closest analog is... nothing: its multi-machine story is
+    HTTPS to a vendor (SURVEY.md §5.8).  On TPU pods each host calls this
+    before building a global mesh; with no arguments JAX infers the topology
+    from the TPU environment.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info("jax.distributed initialized: process %d/%d",
+                jax.process_index(), jax.process_count())

@@ -1,0 +1,76 @@
+"""Parameter & activation sharding layouts.
+
+The scaling-book recipe: pick a mesh, annotate shardings on params and
+activations, let XLA insert the collectives (all-gather/reduce-scatter ride
+ICI on the ``tp`` axis; ``dp`` replicates params and shards the batch).
+
+Layer params are stacked along a leading ``n_layers`` axis (scanned in the
+model), so every spec below leads with None for that axis.
+
+Layout (Megatron-style, collective-minimal for decoders):
+* attention QKV projections: shard the HEAD axis over tp  → column parallel
+* attention output:          shard the input-head axis    → row parallel
+  (XLA inserts one psum per attention block)
+* MLP gate/up: column parallel; MLP down: row parallel    → one psum per MLP
+* embedding/lm_head: vocab axis over tp (logits all-gathered once per step)
+* KV cache: batch over dp, kv-heads over tp
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.transformer.init_params layout."""
+    specs = {
+        "embed": {"weight": P("tp", None)},  # vocab sharded
+        "layers": {
+            "ln_attn": {"scale": P(None, None)},
+            "ln_mlp": {"scale": P(None, None)},
+            "attn": {
+                "wq": P(None, None, "tp", None),  # [L, D, H, hd] heads sharded
+                "wk": P(None, None, "tp", None),  # [L, D, K, hd]
+                "wv": P(None, None, "tp", None),
+                "wo": P(None, "tp", None, None),  # [L, H, hd, D] row parallel
+            },
+            "mlp": {
+                "w_gate": P(None, None, "tp"),  # [L, D, F] column
+                "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),  # [L, F, D] row
+            },
+        },
+        "final_norm": {"scale": P(None)},
+    }
+    if not tie_embeddings:
+        specs["lm_head"] = {"weight": P(None, "tp")}  # [D, V] vocab sharded
+    return specs
+
+
+def param_shardings(mesh: Mesh, tie_embeddings: bool = True):
+    """NamedSharding pytree for jit in_shardings / device_put."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(tie_embeddings),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
+    """Place a host-side param pytree onto the mesh with the TP layout."""
+    shardings = param_shardings(mesh, tie_embeddings)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def batch_spec(seq_sharded: bool = False) -> P:
+    """Activation sharding for [B, S, ...] tensors: batch over dp, optionally
+    sequence over sp (context parallelism)."""
+    return P("dp", "sp") if seq_sharded else P("dp")
+
+
+def kv_cache_spec() -> P:
+    """[L, B, S, K, hd]: batch over dp, kv heads over tp."""
+    return P(None, "dp", None, "tp", None)

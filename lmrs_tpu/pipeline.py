@@ -98,11 +98,21 @@ class TranscriptSummarizer:
     @property
     def chunker(self) -> TranscriptChunker:
         if self._chunker is None:
+            # Token-count authority is the SERVING MODEL's tokenizer
+            # (SURVEY.md §7.4 item 4): when the chunker tokenizer is left at
+            # its default and the engine has a real tokenizer, use that one —
+            # otherwise chunk budgets (approx ~4 chars/tok) and engine limits
+            # (e.g. byte-level) disagree by ~4x and chunks get truncated.
+            tokenizer = self.config.chunk.tokenizer
+            if tokenizer == "approx":
+                engine_tok = getattr(self.executor.engine, "tokenizer", None)
+                if engine_tok is not None:
+                    tokenizer = engine_tok
             self._chunker = TranscriptChunker(
                 max_tokens_per_chunk=self.config.chunk.max_tokens_per_chunk,
                 overlap_tokens=self.config.chunk.overlap_tokens,
                 context_tokens=self.config.chunk.context_tokens,
-                tokenizer=self.config.chunk.tokenizer,
+                tokenizer=tokenizer,
             )
         return self._chunker
 

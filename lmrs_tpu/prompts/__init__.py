@@ -143,8 +143,15 @@ def safe_format(template: str, **kw) -> str:
     """Substitute only known ``{placeholder}`` names; leave every other brace
     untouched.  ``str.format`` would crash on literal braces in user prompt
     files (e.g. JSON examples), so all prompt substitution routes through
-    this."""
-    out = template
-    for k, v in kw.items():
-        out = out.replace("{" + k + "}", str(v))
-    return out
+    this.
+
+    Single-pass over the TEMPLATE only: substituted values are never
+    re-scanned, so transcript/summary content containing a literal
+    ``{placeholder}`` cannot trigger a second expansion (template injection).
+    """
+    import re as _re
+
+    if not kw:
+        return template
+    pattern = _re.compile("|".join("\\{" + _re.escape(k) + "\\}" for k in kw))
+    return pattern.sub(lambda m: str(kw[m.group(0)[1:-1]]), template)

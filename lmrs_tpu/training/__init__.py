@@ -1,0 +1,7 @@
+"""Training/fine-tuning: sharded causal-LM train step (no reference
+counterpart — the reference's model is a rented API; here the model is ours
+to tune)."""
+
+from lmrs_tpu.training.train import make_train_step, causal_lm_loss
+
+__all__ = ["causal_lm_loss", "make_train_step"]

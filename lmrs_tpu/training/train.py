@@ -1,0 +1,69 @@
+"""Sharded causal-LM training step.
+
+The full SPMD recipe: params laid out tensor-parallel (parallel.sharding),
+batch sharded data-parallel (and optionally sequence-parallel), one jitted
+step — XLA inserts the tp collectives inside the model and the dp gradient
+all-reduce at the boundary.  Used for fine-tuning and as the multi-chip
+dry-run workload (__graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lmrs_tpu.config import ModelConfig
+from lmrs_tpu.models.transformer import forward
+from lmrs_tpu.parallel.sharding import param_shardings
+
+
+def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
+                   loss_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Next-token cross-entropy in f32.  tokens [B, S]; predicts tokens[:,1:]."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    logits, _ = forward(params, cfg, tokens, positions)  # [B,S,V] f32
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        m = loss_mask[:, 1:].astype(jnp.float32)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return nll.mean()
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    seq_sharded: bool = False,
+):
+    """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
+    step.  With a mesh: params tensor-parallel, batch over dp (and sequence
+    over sp when seq_sharded)."""
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(causal_lm_loss)(params, cfg, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step)
+
+    pspecs = param_shardings(mesh, cfg.tie_embeddings)
+    batch_sh = NamedSharding(mesh, P("dp", "sp") if seq_sharded else P("dp"))
+    # opt_state sharding left unconstrained: XLA propagates the param layout
+    # into the optimizer tree (adam mu/nu mirror the params).
+    return jax.jit(
+        step,
+        in_shardings=(pspecs, None, batch_sh),
+        out_shardings=(pspecs, None, None),
+        donate_argnums=(0, 1),
+    )

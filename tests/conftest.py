@@ -7,10 +7,17 @@ jax is imported anywhere (SURVEY.md §4: emulate TP/DP without TPUs via
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")
+# in EVERY interpreter, overriding the env var — force it back to cpu before
+# any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import json
 import math

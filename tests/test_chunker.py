@@ -125,3 +125,39 @@ def test_approx_tokenizer_count_scales():
 def test_chunker_rejects_bad_budget():
     with pytest.raises(ValueError):
         TranscriptChunker(max_tokens_per_chunk=100, context_tokens=150)
+
+
+def test_overlap_never_exceeds_budget():
+    """Budget invariant with overlap enabled (review finding)."""
+    segs = [{"start": float(i), "end": float(i + 1),
+             "text": ("Sentence %d has words. " % i) * 6, "speaker": "A"}
+            for i in range(60)]
+    ck = TranscriptChunker(max_tokens_per_chunk=400, overlap_tokens=100,
+                           context_tokens=150)
+    chunks = ck.chunk_transcript(segs)
+    assert len(chunks) > 2
+    for c in chunks:
+        packed = sum(ck.tokenizer.count(s["text"]) for s in c.segments)
+        assert packed <= ck.effective_max_tokens
+
+
+def test_long_sentence_pieces_get_distinct_timestamps():
+    """Interior flushes of a mega-sentence must interpolate by char position
+    (review finding: stale cursor gave every piece start=end=0)."""
+    long_sentence = "word " * 2500  # no sentence boundaries
+    seg = {"start": 0.0, "end": 100.0, "text": long_sentence.strip(), "speaker": "A"}
+    ck = TranscriptChunker(max_tokens_per_chunk=150, overlap_tokens=0,
+                           context_tokens=30)
+    chunks = ck.chunk_transcript([seg])
+    assert len(chunks) > 3
+    starts = [c.start_time for c in chunks]
+    assert starts == sorted(starts)
+    assert len(set(starts)) == len(starts)  # all distinct
+    assert all(c.end_time > c.start_time for c in chunks)
+
+
+def test_safe_format_single_pass_no_injection():
+    from lmrs_tpu.prompts import safe_format
+    out = safe_format("A {transcript} B", transcript="evil {summary_type} text",
+                      summary_type="SHOULD NOT APPEAR")
+    assert out == "A evil {summary_type} text B"

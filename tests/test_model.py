@@ -1,0 +1,148 @@
+"""Model-zoo unit tests (CPU, f32 for numerical checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lmrs_tpu.config import ModelConfig, model_preset
+from lmrs_tpu.models.transformer import forward, init_kv_cache, init_params, param_count
+from lmrs_tpu.ops.attention import attention
+from lmrs_tpu.ops.norms import rms_norm
+from lmrs_tpu.ops.rope import apply_rope, rope_table
+from lmrs_tpu.ops.sampling import sample_logits
+
+
+def tiny_cfg(**kw):
+    d = dict(vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+             hidden_dim=64, max_seq_len=128, dtype="float32")
+    d.update(kw)
+    return ModelConfig(**d)
+
+
+def test_param_shapes_and_count():
+    cfg = tiny_cfg()
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    assert p["embed"]["weight"].shape == (64, 32)
+    assert p["layers"]["attn"]["wq"].shape == (2, 32, 4, 8)
+    assert p["layers"]["mlp"]["w_down"].shape == (2, 64, 32)
+    assert param_count(p) > 0
+
+
+def test_forward_shapes():
+    cfg = tiny_cfg()
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.ones((3, 16), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16)[None], (3, 16))
+    logits, cache = forward(p, cfg, tokens, pos)
+    assert logits.shape == (3, 16, 64)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    cfg = tiny_cfg()
+    p = init_params(cfg, jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(2)
+    t1 = jax.random.randint(key, (1, 12), 0, 64)
+    t2 = t1.at[0, 8].set((t1[0, 8] + 1) % 64)
+    pos = jnp.arange(12)[None]
+    l1, _ = forward(p, cfg, t1, pos)
+    l2, _ = forward(p, cfg, t2, pos)
+    np.testing.assert_allclose(l1[0, :8], l2[0, :8], rtol=1e-5)
+    assert not np.allclose(l1[0, 8:], l2[0, 8:])
+
+
+def test_prefill_decode_equals_full_forward():
+    """Prefill + stepwise decode through the KV cache must reproduce the
+    no-cache forward logits (the correctness contract of the cache path)."""
+    cfg = tiny_cfg()
+    p = init_params(cfg, jax.random.PRNGKey(3))
+    S = 10
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (1, S), 0, 64)
+    pos = jnp.arange(S)[None]
+    full_logits, _ = forward(p, cfg, tokens, pos)
+
+    # prefill first 6, then decode 4 one-by-one
+    cache = init_kv_cache(cfg, 1, S)
+    pre = 6
+    logits_p, cache = forward(p, cfg, tokens[:, :pre], pos[:, :pre], cache,
+                              jnp.array([pre]))
+    np.testing.assert_allclose(full_logits[:, :pre], logits_p, rtol=2e-4, atol=2e-5)
+    for i in range(pre, S):
+        li, cache = forward(p, cfg, tokens[:, i:i + 1], jnp.array([[i]]), cache,
+                            jnp.array([i + 1]))
+        np.testing.assert_allclose(full_logits[:, i], li[:, 0], rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_repeat_matches_mha_when_equal_heads():
+    """attention with n_kv == n_heads is plain MHA; reference numerics check
+    against an explicit softmax."""
+    b, s, h, hd = 1, 5, 2, 4
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, s, h, hd))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, h, hd))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, hd))
+    pos = jnp.arange(s)[None]
+    out = attention(q, k, v, pos)
+    # manual reference
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    mask = np.tril(np.ones((s, s), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1), v)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rope_rotation_preserves_norm():
+    sin, cos = rope_table(32, 8, 10000.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 2, 8))
+    pos = jnp.arange(4)[None]
+    y = apply_rope(x, pos, sin, cos)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(x, axis=-1), jnp.linalg.norm(y, axis=-1), rtol=1e-5
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(x[:, 0], y[:, 0], rtol=1e-6)
+
+
+def test_rms_norm_unit_scale():
+    x = jnp.array([[3.0, 4.0]])
+    out = rms_norm(x, jnp.zeros(2), eps=0.0)
+    np.testing.assert_allclose(jnp.mean(out**2), 1.0, rtol=1e-5)
+
+
+def test_sampling_greedy_and_temperature():
+    logits = jnp.array([[0.0, 5.0, 1.0], [9.0, 0.0, 0.0]])
+    key = jax.random.PRNGKey(0)
+    ids = sample_logits(logits, key, jnp.zeros(2), jnp.zeros(2, jnp.int32), jnp.ones(2))
+    assert ids.tolist() == [1, 0]
+    # top_k=1 forces argmax even at high temperature
+    ids = sample_logits(logits, key, jnp.full((2,), 5.0), jnp.ones(2, jnp.int32), jnp.ones(2))
+    assert ids.tolist() == [1, 0]
+
+
+def test_sampling_top_p_restricts_support():
+    # one dominant token (p≈0.95): top_p=0.5 must always pick it
+    logits = jnp.array([[6.0, 0.0, 0.0, 0.0]])
+    for i in range(5):
+        ids = sample_logits(logits, jax.random.PRNGKey(i), jnp.ones(1),
+                            jnp.zeros(1, jnp.int32), jnp.array([0.5]))
+        assert ids[0] == 0
+
+
+def test_model_presets_exist():
+    for name in ["tiny", "llama3-8b", "llama3-70b", "gemma-2b", "gemma-7b"]:
+        cfg = model_preset(name)
+        assert cfg.dim % cfg.n_heads == 0
+    with pytest.raises(ValueError):
+        model_preset("nope")
+
+
+def test_gemma_quirks_apply():
+    cfg = tiny_cfg(embed_scale=True, logit_softcap=5.0)
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.ones((1, 4), jnp.int32)
+    pos = jnp.arange(4)[None]
+    logits, _ = forward(p, cfg, tokens, pos)
+    assert float(jnp.max(jnp.abs(logits))) <= 5.0 + 1e-4
