@@ -94,13 +94,19 @@ def forward(
     positions: jnp.ndarray,   # [B, S] absolute positions
     cache: dict[str, jnp.ndarray] | None = None,  # dense KV cache or None
     kv_length: jnp.ndarray | None = None,         # [B] valid KV len AFTER this call's writes
+    attn_fn=None,  # optional (q, k, v, positions) -> out override (e.g. ring
+                   # attention for sequence-parallel training; cache-less only)
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray] | None]:
     """Forward pass; returns (logits [B,S,V] f32, updated cache).
 
     With a cache: K/V for `tokens` are scattered into it at `positions` and
     attention reads the cache (prefill S>1 or decode S=1 both work).
-    Without a cache: plain causal self-attention over the sequence.
+    Without a cache: plain causal self-attention over the sequence — or
+    ``attn_fn`` when given (context-parallel ring attention over ``sp``).
     """
+    if cache is not None and attn_fn is not None:
+        raise ValueError("attn_fn (ring attention) is cache-less only; "
+                         "decode against a KV cache uses dense/paged attention")
     dt = _dtype(cfg)
     b, s = tokens.shape
     hd = cfg.dim // cfg.n_heads
@@ -125,6 +131,8 @@ def forward(
             cv = cv.at[batch_idx, positions].set(v)
             attn_out = attention(q, ck, cv, positions, kv_length,
                                  logit_softcap=None)
+        elif attn_fn is not None:
+            attn_out = attn_fn(q, k, v, positions)
         else:
             attn_out = attention(q, k, v, positions, kv_length, logit_softcap=None)
         o = jnp.einsum("bshk,hkd->bsd", attn_out,

@@ -1,0 +1,118 @@
+"""Ring attention: causal context parallelism over the ``sp`` mesh axis.
+
+The reference handles long context purely algorithmically — it splits the
+transcript *before* the model and map-reduces (SURVEY.md §5.7); there is no
+device-level sequence parallelism anywhere in it.  This module is the
+device-level tier the TPU build adds underneath: when a single chunk's
+sequence (or a fine-tuning batch) is too long for one chip's HBM/FLOPs, the
+sequence axis is sharded over ``sp`` and attention runs as a ring —
+
+* every device holds its local Q, K, V sequence block;
+* K/V blocks (with their absolute positions) rotate around the ring via
+  ``lax.ppermute`` over ICI, one hop per step, ``sp`` steps total;
+* each device folds every visiting K/V block into a running flash-style
+  online softmax (running max ``m``, running denominator ``l``, accumulator
+  ``o``) — numerics identical to dense causal attention, O(S_local) memory;
+* masking is positional (block positions travel with the block), so ragged /
+  shifted position arrays work unchanged.
+
+XLA overlaps the ppermute with the current block's matmuls (the permuted
+block isn't needed until the next iteration), so the ring rides ICI behind
+the MXU work.
+
+Composable: the head axis stays shardable over ``tp`` (pass ``head_axis``),
+batch over ``dp``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from lmrs_tpu.ops.attention import NEG_INF, _repeat_kv
+
+
+def ring_attention(
+    q: jnp.ndarray,       # [B, Sq_loc, H_loc, hd] local query block
+    k: jnp.ndarray,       # [B, Skv_loc, K_loc, hd] local key block
+    v: jnp.ndarray,       # [B, Skv_loc, K_loc, hd]
+    q_pos: jnp.ndarray,   # [B, Sq_loc] absolute positions of local queries
+    kv_pos: jnp.ndarray,  # [B, Skv_loc] absolute positions of local keys
+    axis_name: str = "sp",
+    logit_softcap: float | None = None,
+) -> jnp.ndarray:
+    """Per-shard causal ring attention — call inside shard_map.
+
+    Returns [B, Sq_loc, H_loc, hd] in q.dtype.  Fully-masked queries (none
+    possible under causal masking with position 0 present somewhere in the
+    ring) would return zeros rather than NaN.
+    """
+    n = lax.psum(1, axis_name)
+    b, sq, h, hd = q.shape
+    kh = k.shape[2]
+    n_rep = h // kh
+    scale = hd ** -0.5
+
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    o = jnp.zeros((b, h, sq, hd), jnp.float32)
+
+    def fold(m, l, o, k_blk, v_blk, pos_blk):
+        kk = _repeat_kv(k_blk, n_rep)
+        vv = _repeat_kv(v_blk, n_rep)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+        if logit_softcap is not None:
+            logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+        mask = pos_blk[:, None, None, :] <= q_pos[:, None, :, None]  # [B,1,Sq,Skv]
+        logits = jnp.where(mask, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # exp(NEG_INF - NEG_INF) = 1 for fully-masked rows: zero those
+        # probabilities explicitly instead of trusting the subtraction.
+        p = jnp.where(mask, jnp.exp(logits - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        # PV matmul in the value dtype (bf16 → MXU) with f32 accumulation
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(vv.dtype), vv,
+            preferred_element_type=jnp.float32)
+        return m_new, l, o
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        m, l, o = fold(m, l, o, k, v, kv_pos)
+        if step != n - 1:
+            k, v, kv_pos = jax.tree.map(
+                lambda x: lax.ppermute(x, axis_name, perm), (k, v, kv_pos))
+
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Sq, H, hd]
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,      # [B, S, H, hd] global
+    k: jnp.ndarray,      # [B, S, K, hd]
+    v: jnp.ndarray,      # [B, S, K, hd]
+    q_pos: jnp.ndarray,  # [B, S] absolute positions
+    mesh: Mesh,
+    seq_axis: str = "sp",
+    batch_axis: str = "dp",
+    head_axis: str | None = "tp",
+    logit_softcap: float | None = None,
+) -> jnp.ndarray:
+    """shard_map wrapper: sequence over ``seq_axis``, batch over
+    ``batch_axis``, heads over ``head_axis`` (composes with tensor
+    parallelism — Q heads and KV heads shard together, so GQA grouping stays
+    local to each tp shard)."""
+    qkv_spec = P(batch_axis, seq_axis, head_axis, None)
+    pos_spec = P(batch_axis, seq_axis)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=seq_axis, logit_softcap=logit_softcap),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),
+        out_specs=qkv_spec,
+    )
+    return fn(q, k, v, q_pos, q_pos)

@@ -19,15 +19,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from lmrs_tpu.config import ModelConfig
 from lmrs_tpu.models.transformer import forward
-from lmrs_tpu.parallel.sharding import param_shardings
+from lmrs_tpu.parallel.sharding import batch_spec, param_shardings
 
 
 def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
-                   loss_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+                   loss_mask: jnp.ndarray | None = None,
+                   attn_fn=None) -> jnp.ndarray:
     """Next-token cross-entropy in f32.  tokens [B, S]; predicts tokens[:,1:]."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    logits, _ = forward(params, cfg, tokens, positions)  # [B,S,V] f32
+    logits, _ = forward(params, cfg, tokens, positions, attn_fn=attn_fn)  # [B,S,V] f32
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -45,11 +46,21 @@ def make_train_step(
     seq_sharded: bool = False,
 ):
     """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
-    step.  With a mesh: params tensor-parallel, batch over dp (and sequence
-    over sp when seq_sharded)."""
+    step.  With a mesh: params tensor-parallel, batch over dp; when
+    seq_sharded the sequence axis shards over sp and attention runs as a
+    ring (parallel.ring_attention) — K/V blocks rotate over ICI instead of
+    XLA all-gathering the whole sequence onto every sp shard."""
+
+    attn_fn = None
+    if mesh is not None and seq_sharded:
+        from lmrs_tpu.parallel.ring_attention import ring_attention_sharded
+
+        def attn_fn(q, k, v, positions):
+            return ring_attention_sharded(q, k, v, positions, mesh)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(causal_lm_loss)(params, cfg, tokens)
+        loss, grads = jax.value_and_grad(causal_lm_loss)(
+            params, cfg, tokens, attn_fn=attn_fn)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -58,7 +69,7 @@ def make_train_step(
         return jax.jit(step)
 
     pspecs = param_shardings(mesh, cfg.tie_embeddings)
-    batch_sh = NamedSharding(mesh, P("dp", "sp") if seq_sharded else P("dp"))
+    batch_sh = NamedSharding(mesh, batch_spec(seq_sharded))
     # opt_state sharding left unconstrained: XLA propagates the param layout
     # into the optimizer tree (adam mu/nu mirror the params).
     return jax.jit(
