@@ -136,8 +136,8 @@ class TranscriptChunker:
                 current = overlap
                 current_tokens = sum(self._count(s["text"]) for s in overlap)
 
-        for seg in segments:
-            n = self._count(seg["text"])
+        seg_counts = self._count_batch([s["text"] for s in segments])
+        for seg, n in zip(segments, seg_counts):
             if n > self.effective_max_tokens:
                 # Oversized segment: flush, then split sentence-aware into
                 # its own run of chunks (big_chunkeroosky.py:101-128).
@@ -181,6 +181,13 @@ class TranscriptChunker:
 
     def _count(self, text: str) -> int:
         return self.tokenizer.count(text)
+
+    def _count_batch(self, texts: list[str]) -> list[int]:
+        """One call for many strings (native batch path when available)."""
+        fn = getattr(self.tokenizer, "count_batch", None)
+        if fn is not None:
+            return fn(texts)
+        return [self.tokenizer.count(t) for t in texts]
 
     def _overlap_segments(self, packed: list[Segment]) -> list[Segment]:
         """Trailing sentences of a finished chunk, up to ``overlap_tokens``.
@@ -268,6 +275,7 @@ class TranscriptChunker:
         the budget, with timestamps interpolated by character position
         (big_chunkeroosky.py:267-435, interpolation :351-366)."""
         sentences = split_sentences(seg["text"])
+        sent_counts = dict(zip(sentences, self._count_batch(sentences)))
         pieces: list[Segment] = []
         total_chars = max(len(seg["text"]), 1)
         span = seg["end"] - seg["start"]
@@ -295,7 +303,7 @@ class TranscriptChunker:
             buf_start_char = end_char
 
         for sent in sentences:
-            n = self._count(sent)
+            n = sent_counts[sent]
             if n > self.effective_max_tokens:
                 flush_buf(cursor)
                 # advance the char cursor per fragment so interior flushes

@@ -26,19 +26,47 @@ _REPEAT_WORD_RE = re.compile(r"\b(\w+)(\s+\1\b)+", re.IGNORECASE)
 _PUNCT_SPACE_RE = re.compile(r"([.!?,;:])([A-Za-z])")
 
 
-def clean_text(text: str) -> str:
-    """Normalize a segment's text (reference clean_text, preprocessor.py:69-89).
-
-    Collapses whitespace, dedups immediately-repeated words ("the the" →
-    "the"), and restores a missing space after sentence punctuation
-    ("end.Next" → "end. Next").
-    """
+def clean_text_py(text: str) -> str:
+    """Pure-Python clean_text (the parity reference for the native path)."""
     if not text:
         return ""
     text = _WS_RE.sub(" ", text).strip()
     text = _REPEAT_WORD_RE.sub(r"\1", text)
     text = _PUNCT_SPACE_RE.sub(r"\1 \2", text)
     return text
+
+
+def clean_text(text: str) -> str:
+    """Normalize a segment's text (reference clean_text, preprocessor.py:69-89).
+
+    Collapses whitespace, dedups immediately-repeated words ("the the" →
+    "the"), and restores a missing space after sentence punctuation
+    ("end.Next" → "end. Next").  Runs the C++ scan (runtime/native) when the
+    native library is built; falls back to the regex implementation.
+    """
+    if not text:
+        return ""
+    from lmrs_tpu.runtime.native import clean_text_native
+
+    cleaned = clean_text_native(text)
+    if cleaned is not None:
+        return cleaned
+    return clean_text_py(text)
+
+
+def _clean_all(texts: list) -> list[str]:
+    """Clean a list of texts — one native batch call, or the per-string path.
+
+    Non-string entries (e.g. ``"text": null`` in the input JSON) clean to ""
+    and are dropped by the caller, matching clean_text's falsy-input rule.
+    """
+    from lmrs_tpu.runtime.native import clean_text_batch
+
+    texts = [t if isinstance(t, str) else "" for t in texts]
+    batch = clean_text_batch(texts)
+    if batch is not None:
+        return batch
+    return [clean_text_py(t) for t in texts]
 
 
 def format_timestamp(seconds: float) -> str:
@@ -68,9 +96,10 @@ def preprocess_transcript(
     "speaker": str}`` (README.md:162-175).  Output segments add
     ``segment_timestamps`` (per-original timing) when merged.
     """
+    segments = list(segments)
+    texts = _clean_all([seg.get("text", "") for seg in segments])
     cleaned: list[Segment] = []
-    for seg in segments:
-        text = clean_text(seg.get("text", ""))
+    for seg, text in zip(segments, texts):
         if not text:
             continue  # drop empty segments (preprocessor.py:37-39)
         cleaned.append(

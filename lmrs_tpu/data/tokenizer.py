@@ -51,10 +51,31 @@ class ApproxTokenizer:
 
     _word_re = re.compile(r"\S+")
 
-    def count(self, text: str) -> int:
+    def count_py(self, text: str) -> int:
+        """Pure-Python counter (parity reference for the native path)."""
         if not text:
             return 0
         return max(len(text) // 4, len(self._word_re.findall(text)) // 2, 1)
+
+    def count(self, text: str) -> int:
+        if not text:
+            return 0
+        from lmrs_tpu.runtime.native import count_approx_native
+
+        n = count_approx_native(text)
+        if n is not None:
+            return n
+        return self.count_py(text)
+
+    def count_batch(self, texts: list[str]) -> list[int]:
+        """Batched counting — one native FFI crossing for the whole list
+        (the chunker hot loop, SURVEY.md §3.5 #2)."""
+        from lmrs_tpu.runtime.native import count_approx_batch
+
+        batch = count_approx_batch(texts)
+        if batch is not None:
+            return batch
+        return [self.count_py(t) for t in texts]
 
     def encode(self, text: str) -> list[int]:
         return [

@@ -62,6 +62,23 @@ class PageAllocator:
             self._free.append(p)
 
 
+def make_page_allocator(num_pages: int):
+    """Native C++ allocator when built, else the Python free list.
+
+    Both implement the identical contract (parity: tests/test_native.py);
+    allocator churn sits on the scheduler's critical path, so the native one
+    is preferred.
+    """
+    try:
+        from lmrs_tpu.runtime.native import NativePageAllocator, native_available
+
+        if native_available():
+            return NativePageAllocator(num_pages)
+    except Exception as e:  # pragma: no cover - fallback path
+        logger.debug("native allocator unavailable: %s", e)
+    return PageAllocator(num_pages)
+
+
 @dataclass
 class SequencePages:
     """Page table of one active sequence."""
@@ -92,7 +109,7 @@ class PagedKVCache:
         shape = (model_cfg.n_layers, model_cfg.n_kv_heads, num_pages, page_size, hd)
         self.k = jnp.zeros(shape, dt)
         self.v = jnp.zeros(shape, dt)
-        self.allocator = allocator or PageAllocator(num_pages)
+        self.allocator = allocator or make_page_allocator(num_pages)
         logger.info(
             "paged KV cache: %d pages x %d tokens (%.1f MiB)",
             num_pages, page_size,

@@ -107,6 +107,9 @@ def forward(
     if cache is not None and attn_fn is not None:
         raise ValueError("attn_fn (ring attention) is cache-less only; "
                          "decode against a KV cache uses dense/paged attention")
+    if kv_length is not None and attn_fn is not None:
+        raise ValueError("attn_fn does not apply kv_length masking; "
+                         "pad-free batches only on the ring-attention path")
     dt = _dtype(cfg)
     b, s = tokens.shape
     hd = cfg.dim // cfg.n_heads

@@ -1,0 +1,17 @@
+"""Native runtime layer (C++ via ctypes).
+
+The reference is pure Python end to end (SURVEY.md §0); this package houses
+the TPU build's native host-side runtime: data-plane hot loops and the KV
+page allocator, implemented in C++ (``native/src/lmrs_runtime.cc``) and
+bound with ctypes.  Everything degrades to the pure-Python implementations
+when the library is unavailable (``LMRS_NATIVE=0`` forces that).
+"""
+
+from lmrs_tpu.runtime.native import (  # noqa: F401
+    NativePageAllocator,
+    clean_text_batch,
+    clean_text_native,
+    count_approx_batch,
+    count_approx_native,
+    native_available,
+)

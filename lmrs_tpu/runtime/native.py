@@ -1,0 +1,281 @@
+"""ctypes bindings for the native runtime library.
+
+Loads ``native/build/liblmrs_runtime.so``, building it with ``g++`` on first
+use if missing or stale (source newer than the .so).  All entry points have
+pure-Python fallbacks at their call sites; ``LMRS_NATIVE=0`` disables the
+native path entirely.
+
+Exposed surface (mirrors of the Python implementations, parity-tested in
+tests/test_native.py):
+
+* ``clean_text_native`` / ``clean_text_batch`` — data/preprocessor.clean_text
+* ``count_approx_native`` / ``count_approx_batch`` — ApproxTokenizer.count
+* ``NativePageAllocator``  — engine/kv_cache.PageAllocator
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger("lmrs.native")
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_SRC = _NATIVE_DIR / "src" / "lmrs_runtime.cc"
+_LIB = _NATIVE_DIR / "build" / "liblmrs_runtime.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    """Compile the shared library with g++ (no cmake needed for one TU).
+
+    Writes to a temp path and renames into place, so a concurrent process
+    can never dlopen a half-written .so.
+    """
+    try:
+        _LIB.parent.mkdir(parents=True, exist_ok=True)
+        tmp = _LIB.with_suffix(f".so.tmp.{os.getpid()}")
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-fvisibility=hidden",
+            "-o", str(tmp), str(_SRC),
+        ]
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            logger.warning("native build failed:\n%s", r.stderr[-2000:])
+            return False
+        os.replace(tmp, _LIB)
+        logger.info("built native runtime: %s", _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build failed: %s", e)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    if _load_attempted:  # lock-free fast path (GIL-safe read)
+        return _lib
+    with _lock:
+        if _load_attempted:
+            return _lib
+        lib = _try_load()
+        _set_loaded(lib)
+        return lib
+
+
+def _set_loaded(lib: ctypes.CDLL | None) -> None:
+    global _lib, _load_attempted
+    _lib = lib
+    _load_attempted = True
+
+
+def _try_load() -> ctypes.CDLL | None:
+    if os.environ.get("LMRS_NATIVE", "1").strip().lower() in ("0", "false", "off"):
+        return None
+    if not _SRC.exists():
+        return None
+    stale = not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime
+    if stale and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+        lib.lmrs_abi_version.restype = ctypes.c_int32
+        if lib.lmrs_abi_version() != 1:
+            logger.warning("native ABI mismatch; ignoring %s", _LIB)
+            return None
+        lib.lmrs_clean_text.restype = ctypes.c_int64
+        lib.lmrs_clean_text.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.lmrs_clean_text_batch.restype = ctypes.c_int64
+        lib.lmrs_clean_text_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+        lib.lmrs_count_approx.restype = ctypes.c_int64
+        lib.lmrs_count_approx.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.lmrs_count_approx_batch.restype = None
+        lib.lmrs_count_approx_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.lmrs_palloc_create.restype = ctypes.c_void_p
+        lib.lmrs_palloc_create.argtypes = [ctypes.c_int32]
+        lib.lmrs_palloc_destroy.restype = None
+        lib.lmrs_palloc_destroy.argtypes = [ctypes.c_void_p]
+        lib.lmrs_palloc_free_count.restype = ctypes.c_int32
+        lib.lmrs_palloc_free_count.argtypes = [ctypes.c_void_p]
+        lib.lmrs_palloc_alloc.restype = ctypes.c_int32
+        lib.lmrs_palloc_alloc.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        lib.lmrs_palloc_free.restype = ctypes.c_int32
+        lib.lmrs_palloc_free.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        return lib
+    except (OSError, AttributeError) as e:
+        # missing file, missing symbol (stale .so from an older source
+        # revision) — degrade to the Python implementations
+        logger.warning("could not load native runtime %s: %s", _LIB, e)
+        return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------------- text
+
+
+def clean_text_native(text: str) -> str | None:
+    """Native clean_text; returns None when the library is unavailable.
+
+    Non-ASCII strings are routed to the pure-Python cleaner: the regex
+    ``\\w`` / ``IGNORECASE`` semantics are Unicode-aware and the C++ scan
+    only reproduces them exactly for ASCII, so parity is guaranteed by
+    construction instead of by approximation.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if not text.isascii():
+        from lmrs_tpu.data.preprocessor import clean_text_py
+
+        return clean_text_py(text)
+    raw = text.encode("utf-8")
+    cap = 2 * len(raw) + 16
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.lmrs_clean_text(raw, len(raw), buf, cap)
+    if n < 0:  # buffer too small (shouldn't happen: output <= 2n)
+        cap = -n
+        buf = ctypes.create_string_buffer(cap)
+        n = lib.lmrs_clean_text(raw, len(raw), buf, cap)
+    return buf.raw[:n].decode("utf-8")
+
+
+def clean_text_batch(texts: list[str]) -> list[str] | None:
+    """Clean a batch of strings in one FFI crossing (the data-plane path).
+
+    Non-ASCII entries go through the pure-Python cleaner (see
+    clean_text_native); the ASCII majority is cleaned natively in one call.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if not texts:
+        return []
+    non_ascii = [i for i, t in enumerate(texts) if not t.isascii()]
+    if non_ascii:
+        from lmrs_tpu.data.preprocessor import clean_text_py
+
+        keep = [t for t in texts if t.isascii()]
+        cleaned_ascii = iter(clean_text_batch(keep) or [])
+        return [clean_text_py(t) if not t.isascii() else next(cleaned_ascii)
+                for t in texts]
+    raws = [t.encode("utf-8") for t in texts]
+    offsets = np.zeros(len(raws) + 1, np.int64)
+    np.cumsum([len(r) for r in raws], out=offsets[1:])
+    buf = b"".join(raws)
+    cap = 2 * len(buf) + 16
+    out = ctypes.create_string_buffer(cap)
+    out_off = np.zeros(len(raws) + 1, np.int64)
+    rc = lib.lmrs_clean_text_batch(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(raws),
+        out, cap, out_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc < 0:  # shouldn't happen: output <= 2x input
+        cap = -rc
+        out = ctypes.create_string_buffer(cap)
+        lib.lmrs_clean_text_batch(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(raws), out, cap,
+            out_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    raw = out.raw
+    return [raw[out_off[i]:out_off[i + 1]].decode("utf-8")
+            for i in range(len(raws))]
+
+
+def count_approx_native(text: str) -> int | None:
+    lib = _load()
+    if lib is None:
+        return None
+    raw = text.encode("utf-8")
+    return int(lib.lmrs_count_approx(raw, len(raw)))
+
+
+def count_approx_batch(texts: list[str]) -> list[int] | None:
+    """Batch approx counting: one FFI crossing for the whole list."""
+    lib = _load()
+    if lib is None:
+        return None
+    raws = [t.encode("utf-8") for t in texts]
+    offsets = np.zeros(len(raws) + 1, np.int64)
+    np.cumsum([len(r) for r in raws], out=offsets[1:])
+    buf = b"".join(raws)
+    out = np.zeros(len(raws), np.int64)
+    lib.lmrs_count_approx_batch(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(raws), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out.tolist()
+
+
+# -------------------------------------------------------------- allocator
+
+
+class NativePageAllocator:
+    """C++ free-list page allocator; drop-in for kv_cache.PageAllocator.
+
+    Same contract: page 0 reserved, pages handed out lowest-id-first from a
+    LIFO free list, ``OutOfPages`` (raised by the caller shim) on exhaustion.
+    """
+
+    RESERVED = 1
+
+    def __init__(self, num_pages: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        if num_pages <= self.RESERVED:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._lib = lib
+        self._h = lib.lmrs_palloc_create(num_pages)
+        if not self._h:
+            raise RuntimeError("lmrs_palloc_create failed")
+
+    @property
+    def free_count(self) -> int:
+        return int(self._lib.lmrs_palloc_free_count(self._h))
+
+    def alloc(self, n: int) -> list[int]:
+        from lmrs_tpu.engine.kv_cache import OutOfPages
+
+        out = np.zeros(max(n, 1), np.int32)
+        rc = self._lib.lmrs_palloc_alloc(
+            self._h, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise OutOfPages(f"need {n} pages, {self.free_count} free")
+        return out[:n].tolist()
+
+    def free(self, pages: list[int]) -> None:
+        arr = np.asarray(pages, np.int32)
+        rc = self._lib.lmrs_palloc_free(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(pages))
+        if rc != 0:
+            raise ValueError(f"bad page id in {pages}")
+
+    def __del__(self):  # noqa: D105
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._lib.lmrs_palloc_destroy(h)
+            except Exception:  # interpreter teardown
+                pass
+            self._h = None

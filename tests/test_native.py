@@ -1,0 +1,197 @@
+"""Native C++ runtime parity tests.
+
+The native library (native/src/lmrs_runtime.cc) re-implements the data-plane
+hot loops and the KV page allocator; these tests pin its behavior to the
+pure-Python reference implementations.  g++ is part of the environment, so a
+build failure is a test failure, not a skip.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+from pathlib import Path
+
+import pytest
+
+from lmrs_tpu.data.preprocessor import clean_text_py
+from lmrs_tpu.data.tokenizer import ApproxTokenizer
+from lmrs_tpu.engine.kv_cache import OutOfPages, PageAllocator
+from lmrs_tpu.runtime import native
+
+
+@pytest.fixture(scope="module")
+def lib_ok():
+    assert native.native_available(), "native runtime failed to build/load"
+    return True
+
+
+FIXTURE = Path("/root/reference/transcript-example.json")
+
+
+CLEAN_CASES = [
+    "",
+    "   ",
+    "hello world",
+    "  hello   world  ",
+    "the the the end",
+    "The the plan",
+    "word word, word",
+    "end.Next sentence",
+    "a,b then x;Y plus q:r",
+    "tabs\tand\nnewlines\r\nhere",
+    "one two  three   four",
+    "Dr. Smith said hello.Goodbye",
+    "numbers 12 12 stay? no: 12",
+    "Hello!World again?Yes",
+    "foofoo foo foofoo",
+    "a a a a a a",
+    "trailing space dedup dedup ",
+    "mixed CASE case Case words",
+    "punct.[bracket]",
+    "unicode café café test",
+    "nbsp space here",
+    "wide　space",
+    "emoji \U0001f600 \U0001f600 twice",
+]
+
+
+@pytest.mark.parametrize("text", CLEAN_CASES)
+def test_clean_text_parity(lib_ok, text):
+    assert native.clean_text_native(text) == clean_text_py(text)
+
+
+def test_clean_text_parity_fixture(lib_ok):
+    if not FIXTURE.exists():
+        pytest.skip("reference fixture not mounted")
+    segs = json.loads(FIXTURE.read_text())["segments"]
+    for seg in segs[:2000]:
+        t = seg["text"]
+        assert native.clean_text_native(t) == clean_text_py(t), t
+
+
+def test_clean_text_parity_random_ascii(lib_ok):
+    rng = random.Random(0)
+    alphabet = string.ascii_letters + string.digits + " .!?,;:\t\n_-'"
+    for _ in range(500):
+        t = "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 120)))
+        assert native.clean_text_native(t) == clean_text_py(t), repr(t)
+
+
+def test_clean_text_batch_parity(lib_ok):
+    assert native.clean_text_batch([]) == []
+    batch = native.clean_text_batch(CLEAN_CASES)
+    assert batch == [clean_text_py(t) for t in CLEAN_CASES]
+
+
+def test_count_approx_parity(lib_ok):
+    tok = ApproxTokenizer()
+    cases = CLEAN_CASES + ["x", "ab", "a b c d e f g h", "word " * 100]
+    for t in cases:
+        assert native.count_approx_native(t) == tok.count_py(t), repr(t)
+
+
+def test_count_approx_parity_fixture(lib_ok):
+    if not FIXTURE.exists():
+        pytest.skip("reference fixture not mounted")
+    tok = ApproxTokenizer()
+    segs = json.loads(FIXTURE.read_text())["segments"]
+    texts = [s["text"] for s in segs[:3000]]
+    batch = native.count_approx_batch(texts)
+    assert batch == [tok.count_py(t) for t in texts]
+
+
+def test_count_batch_matches_scalar(lib_ok):
+    texts = ["", "one", "two words here", "café au lait", "x" * 1000]
+    batch = native.count_approx_batch(texts)
+    assert batch == [native.count_approx_native(t) for t in texts]
+
+
+def test_clean_handles_non_string_segments(lib_ok):
+    """``"text": null`` (and other non-strings) must drop, not crash."""
+    from lmrs_tpu.data.preprocessor import preprocess_transcript
+
+    segs = [
+        {"start": 0.0, "end": 1.0, "text": None, "speaker": "A"},
+        {"start": 1.0, "end": 2.0, "text": 42, "speaker": "A"},
+        {"start": 2.0, "end": 3.0, "text": "kept", "speaker": "A"},
+    ]
+    out = preprocess_transcript(segs)
+    assert len(out) == 1 and out[0]["text"] == "kept"
+
+
+def test_clean_unicode_routes_to_python(lib_ok):
+    """Non-ASCII goes through the Python cleaner — exact parity always."""
+    cases = ["CAFÉ café plan", "ไทย ไทย",
+             "café café café"]
+    for t in cases:
+        assert native.clean_text_native(t) == clean_text_py(t)
+    assert native.clean_text_batch(cases) == [clean_text_py(t) for t in cases]
+
+
+def test_count_batch_tokenizer_integration(lib_ok):
+    tok = ApproxTokenizer()
+    texts = ["one two three", "", "a much longer piece of text here ok"]
+    assert tok.count_batch(texts) == [tok.count_py(t) for t in texts]
+
+
+# ----------------------------------------------------------- page allocator
+
+
+def test_allocator_parity_sequence(lib_ok):
+    """Drive both allocators through an identical random op sequence."""
+    py = PageAllocator(64)
+    cc = native.NativePageAllocator(64)
+    rng = random.Random(2)
+    held_py: list[list[int]] = []
+    held_cc: list[list[int]] = []
+    for _ in range(300):
+        if rng.random() < 0.6 or not held_py:
+            n = rng.randrange(1, 8)
+            if n > py.free_count:
+                with pytest.raises(OutOfPages):
+                    py.alloc(n)
+                with pytest.raises(OutOfPages):
+                    cc.alloc(n)
+                continue
+            a, b = py.alloc(n), cc.alloc(n)
+            assert a == b
+            held_py.append(a)
+            held_cc.append(b)
+        else:
+            i = rng.randrange(len(held_py))
+            py.free(held_py.pop(i))
+            cc.free(held_cc.pop(i))
+        assert py.free_count == cc.free_count
+
+
+def test_allocator_reserved_page(lib_ok):
+    cc = native.NativePageAllocator(8)
+    got = cc.alloc(7)
+    assert 0 not in got
+    assert sorted(got) == list(range(1, 8))
+    with pytest.raises(OutOfPages):
+        cc.alloc(1)
+    cc.free(got)
+    assert cc.free_count == 7
+    with pytest.raises(ValueError):
+        cc.free([0])
+    with pytest.raises(ValueError):
+        cc.free([8])
+    with pytest.raises(ValueError):
+        native.NativePageAllocator(1)
+
+
+def test_paged_cache_uses_native(lib_ok):
+    from lmrs_tpu.config import ModelConfig
+    from lmrs_tpu.engine.kv_cache import PagedKVCache
+    from lmrs_tpu.runtime.native import NativePageAllocator
+
+    cache = PagedKVCache(ModelConfig(), num_pages=16, page_size=8,
+                         max_pages_per_slot=4)
+    assert isinstance(cache.allocator, NativePageAllocator)
+    seq = cache.open_sequence(20)
+    assert len(seq.pages) == 3
+    cache.close_sequence(seq)
+    assert cache.allocator.free_count == 15
