@@ -60,6 +60,12 @@ class _SlotState:
     kv_len: int = 0
     done: bool = False
     t_start: float = 0.0
+    # chunked prefill (SARATHI-style): a slot is admitted in "prefill" phase
+    # and advances one prompt chunk per scheduler iteration, so active decode
+    # slots keep decoding between chunks instead of stalling behind one long
+    # prompt.  ``prefill_pos`` = prompt tokens already written to KV.
+    phase: str = "prefill"
+    prefill_pos: int = 0
 
 
 class ContinuousScheduler:
@@ -74,6 +80,7 @@ class ContinuousScheduler:
         self.B = max(1, engine_cfg.max_batch_slots)
         self.max_len = model_cfg.max_seq_len
         self.decode_block = 8
+        self.prefill_chunk = max(64, engine_cfg.prefill_chunk)
         ps = engine_cfg.page_size
         max_pages_per_slot = -(-self.max_len // ps)
         # pool sized so every slot can hold a full-length sequence, or the
@@ -83,6 +90,7 @@ class ContinuousScheduler:
         self._use_ragged = self._pick_kernel()
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
+        self._prefill_window_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[int, object] = {}
         # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
         self.metrics = {
@@ -149,26 +157,32 @@ class ContinuousScheduler:
                 seq = self.cache.open_sequence(budget)
                 st = _SlotState(req=req, prompt_ids=ids, max_new=max_new,
                                 seq=seq, t_start=time.time())
-                tok0 = self._prefill(st)
-                st.kv_len = len(ids)
-                st.generated.append(tok0)
-                slots[b] = st
-                last_tok[b] = tok0
-                kv_lens[b] = st.kv_len
-                active[b] = True
+                slots[b] = st  # phase="prefill"; device work happens in the loop
                 temps[b] = req.temperature
                 top_k[b] = req.top_k
                 top_p[b] = min(max(req.top_p, 0.0), 1.0)
-                self.metrics["prefill_tokens"] += len(ids)
                 in_use = self.cache.num_pages - self.cache.allocator.free_count
                 self.metrics["peak_pages_in_use"] = max(
                     self.metrics["peak_pages_in_use"], in_use)
-                self._maybe_finish(b, slots, results, active)
 
-        admit()
         while queue or any(s is not None for s in slots):
             admit()
-            if not any(s is not None for s in slots):
+            # advance every prefilling slot by ONE prompt chunk, then give
+            # decode a turn — long prompts never monopolize the device
+            for b in range(self.B):
+                st = slots[b]
+                if st is None or st.phase != "prefill":
+                    continue
+                tok0 = self._prefill_step(st)
+                if tok0 is not None:  # prompt complete; first token sampled
+                    st.phase = "decode"
+                    st.kv_len = len(st.prompt_ids)
+                    st.generated.append(tok0)
+                    last_tok[b] = tok0
+                    kv_lens[b] = st.kv_len
+                    active[b] = True
+                    self._maybe_finish(b, slots, results, active)
+            if not any(active):
                 continue
             self.metrics["occupancy_sum"] += float(np.mean(active))
             self.metrics["decode_dispatches"] += 1
@@ -233,25 +247,47 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------- prefill
 
-    def _prefill(self, st: _SlotState) -> int:
+    def _prefill_step(self, st: _SlotState) -> int | None:
+        """Advance one prompt chunk; returns the sampled first token when the
+        whole prompt is in KV, else None.
+
+        Prompts that fit one chunk take the fresh-prefill program (attends
+        the chunk directly); longer prompts run the windowed continuation
+        program per chunk (attends the page window, which includes earlier
+        chunks' KV).
+        """
         ids = st.prompt_ids
-        s_bucket = min(_pow2_bucket(len(ids), 64), self.max_len)
-        fn = self._get_prefill_fn(s_bucket)
+        pos = st.prefill_pos
+        chunk = ids[pos: pos + self.prefill_chunk]
+        is_final = pos + len(chunk) >= len(ids)
+        fresh = pos == 0 and is_final  # whole prompt in one dispatch
+        s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
+        table = self.cache.page_table_array([st.seq])
+        if fresh:
+            fn = self._get_prefill_fn(s_bucket)
+        else:
+            need_pages = self.cache.pages_needed(pos + len(chunk))
+            w = min(_pow2_bucket(need_pages, 4), self.cache.max_pages_per_slot)
+            fn = self._get_prefill_window_fn(s_bucket, w)
+            table = table[:, :w]
         tokens = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
-        tokens[0, : len(ids)] = ids
-        table = self.cache.page_table_array([st.seq])  # [1, W]
+        tokens[0, : len(chunk)] = chunk
         alloc_tokens = st.seq.capacity(self.cache.page_size)
         self._key, sub = jax.random.split(self._key)
         tok0, self.cache.k, self.cache.v = fn(
             self.params, self.cache.k, self.cache.v,
-            jnp.asarray(tokens), jnp.asarray([len(ids)], jnp.int32),
+            jnp.asarray(tokens),
+            jnp.asarray([pos], jnp.int32),
+            jnp.asarray([len(chunk)], jnp.int32),
             jnp.asarray([alloc_tokens], jnp.int32),
             jnp.asarray(table), sub,
             jnp.asarray([st.req.temperature], np.float32),
             jnp.asarray([st.req.top_k], np.int32),
             jnp.asarray([min(max(st.req.top_p, 0.0), 1.0)], np.float32),
         )
-        return int(tok0[0])
+        st.prefill_pos = pos + len(chunk)
+        self.metrics["prefill_tokens"] += len(chunk)
+        return int(tok0[0]) if is_final else None
 
     def _get_prefill_fn(self, s_bucket: int):
         if s_bucket in self._prefill_fns:
@@ -260,8 +296,8 @@ class ContinuousScheduler:
         rope_max = self.max_len
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, k_pages, v_pages, tokens, length, alloc_tokens,
-                    table, key, temp, tk, tp):
+        def prefill(params, k_pages, v_pages, tokens, start, length,
+                    alloc_tokens, table, key, temp, tk, tp):
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1])[None], tokens.shape)
             # Padded tail positions can exceed this sequence's allocated
@@ -281,18 +317,54 @@ class ContinuousScheduler:
         self._prefill_fns[s_bucket] = prefill
         return prefill
 
+    def _get_prefill_window_fn(self, s_bucket: int, w: int):
+        """Continuation-prefill program: chunk at absolute positions
+        [start, start+length) attending the page window (chunked prefill)."""
+        key_ = (s_bucket, w)
+        if key_ in self._prefill_window_fns:
+            return self._prefill_window_fns[key_]
+        cfg = self.model_cfg
+        rope_max = self.max_len
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_chunk(params, k_pages, v_pages, tokens, start, length,
+                          alloc_tokens, table, key, temp, tk, tp):
+            positions = start[:, None] + jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None], tokens.shape)
+            write_pos = jnp.minimum(positions, alloc_tokens[:, None] - 1)
+            logits, k_pages, v_pages = forward_paged(
+                params, cfg, tokens, write_pos, k_pages, v_pages, table,
+                start + length, rope_max, use_ragged_kernel=False,
+                window_prefill=True,
+            )
+            last = jnp.take_along_axis(logits, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok0 = sample_logits(last, key, temp, tk, tp)
+            return tok0, k_pages, v_pages
+
+        logger.info("compiling chunked prefill: bucket=%d window=%d pages",
+                    s_bucket, w)
+        self._prefill_window_fns[key_] = prefill_chunk
+        return prefill_chunk
+
     # -------------------------------------------------------------- decode
 
     def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k, top_p):
-        # page window bucketed to the widest active sequence (+ block growth)
+        # page window bucketed to the widest active sequence (+ block growth).
+        # Slots still in prefill phase get the null page table: the decode
+        # program's masked dummy writes must land on page 0, never on pages
+        # holding their half-prefilled KV.
+        decode_seqs = [
+            s.seq if (s is not None and s.phase == "decode") else None
+            for s in slots
+        ]
         max_pages = 1
         for b, st in enumerate(slots):
-            if st is not None:
+            if st is not None and st.phase == "decode":
                 need = self.cache.pages_needed(st.kv_len + self.decode_block)
                 max_pages = max(max_pages, need)
         w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
         fn = self._get_decode_fn(w)
-        table = self.cache.page_table_array([s.seq if s else None for s in slots])
+        table = self.cache.page_table_array(decode_seqs)
         self._key, sub = jax.random.split(self._key)
         toks, n_valid, self.cache.k, self.cache.v = fn(
             self.params, self.cache.k, self.cache.v,

@@ -87,6 +87,65 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, jnp.n
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def decoder_layer(
+    lp: Params,               # one layer's params (no leading L axis)
+    cfg: ModelConfig,
+    x: jnp.ndarray,           # [B, S, D]
+    positions: jnp.ndarray,   # [B, S]
+    sin: jnp.ndarray,
+    cos: jnp.ndarray,
+    attn_fn=None,
+    kv_length: jnp.ndarray | None = None,  # [B] valid-length mask (padding)
+) -> jnp.ndarray:
+    """One cache-less decoder block (attention + SwiGLU MLP, pre-norm).
+
+    The shared body for training/prefill paths that don't carry a KV cache:
+    plain scan in ``forward``, ring attention (``attn_fn``), and the pipeline
+    stages in parallel/pipeline.py.
+    """
+    dt = x.dtype
+    hd = cfg.dim // cfg.n_heads
+    h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+    q = apply_rope(q, positions, sin, cos)
+    k = apply_rope(k, positions, sin, cos)
+    if attn_fn is not None:
+        attn_out = attn_fn(q, k, v, positions)
+    else:
+        attn_out = attention(q, k, v, positions, kv_length, logit_softcap=None)
+    o = jnp.einsum("bshk,hkd->bsd", attn_out,
+                   lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
+    x = x + o
+    h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
+    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
+    return x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+
+
+def embed_tokens(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup (+ Gemma's sqrt(dim) scale)."""
+    x = params["embed"]["weight"][tokens]
+    if cfg.embed_scale:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(_dtype(cfg))
+    return x
+
+
+def lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Final norm + output projection to f32 logits (+ optional softcap)."""
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["weight"])
+    logits = logits.astype(jnp.float32)
+    if cfg.logit_softcap:
+        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    return logits
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -113,67 +172,48 @@ def forward(
     dt = _dtype(cfg)
     b, s = tokens.shape
     hd = cfg.dim // cfg.n_heads
-    x = params["embed"]["weight"][tokens]  # [B,S,D] gather
-    if cfg.embed_scale:  # Gemma multiplies by sqrt(dim)
-        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(dt)
+    x = embed_tokens(params, cfg, tokens)  # [B,S,D]
 
     max_pos = cache["k"].shape[2] if cache is not None else s
     sin, cos = rope_table(max_pos, hd, cfg.rope_theta)
     batch_idx = jnp.arange(b)[:, None]  # [B,1] for cache scatter
 
-    def layer_fn(x, xs):
-        lp, ck, cv = xs  # layer params, cache slices [B, Smax, K, hd]
-        h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
-        q = apply_rope(q, positions, sin, cos)
-        k = apply_rope(k, positions, sin, cos)
-        if ck is not None:
+    if cache is not None:
+        def layer_fn(x, xs):
+            lp, ck, cv = xs  # layer params, cache slices [B, Smax, K, hd]
+            h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
+            q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
+            k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+            v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+            q = apply_rope(q, positions, sin, cos)
+            k = apply_rope(k, positions, sin, cos)
             ck = ck.at[batch_idx, positions].set(k)
             cv = cv.at[batch_idx, positions].set(v)
             attn_out = attention(q, ck, cv, positions, kv_length,
                                  logit_softcap=None)
-        elif attn_fn is not None:
-            attn_out = attn_fn(q, k, v, positions)
-        else:
-            attn_out = attention(q, k, v, positions, kv_length, logit_softcap=None)
-        o = jnp.einsum("bshk,hkd->bsd", attn_out,
-                       lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
-        x = x + o
+            o = jnp.einsum("bshk,hkd->bsd", attn_out,
+                           lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
+            x = x + o
 
-        h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
-        gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
-        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
-        x = x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
-        return x, (ck, cv)
+            h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
+            gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
+            up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
+            ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
+            x = x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+            return x, (ck, cv)
 
-    if cache is not None:
-        xs = (params["layers"], cache["k"], cache["v"])
-    else:
-        xs = (params["layers"], None, None)
-
-    # lax.scan over stacked layers: wq etc. are [L, ...]; cache [L, B, ...]
-    if cache is not None:
-        x, (new_k, new_v) = jax.lax.scan(layer_fn, x, xs)
+        # lax.scan over stacked layers: wq etc. are [L, ...]; cache [L, B, ...]
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_fn, x, (params["layers"], cache["k"], cache["v"]))
         new_cache = {"k": new_k, "v": new_v}
     else:
         def layer_fn_nocache(x, lp):
-            x, _ = layer_fn(x, (lp, None, None))
-            return x, None
+            return decoder_layer(lp, cfg, x, positions, sin, cos, attn_fn,
+                                 kv_length), None
         x, _ = jax.lax.scan(layer_fn_nocache, x, params["layers"])
         new_cache = None
 
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["weight"])
-    logits = logits.astype(jnp.float32)
-    if cfg.logit_softcap:
-        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
-    return logits, new_cache
+    return lm_head(params, cfg, x), new_cache
 
 
 def forward_paged(
@@ -187,6 +227,7 @@ def forward_paged(
     kv_lens: jnp.ndarray,      # [B] valid tokens AFTER this call's writes
     rope_max: int,
     use_ragged_kernel: bool = False,
+    window_prefill: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -197,6 +238,12 @@ def forward_paged(
     Prefill (S>1, fresh sequence starting at position 0) attends the current
     tokens directly (flash path eligible); decode (S==1) attends the paged
     pool — via the ragged Pallas kernel on TPU or the gather fallback.
+
+    ``window_prefill`` is the chunked-prefill path (SARATHI-style,
+    PAPERS.md): S>1 queries at positions ``>= 0`` that must also see KV
+    written by EARLIER chunks of the same prompt — attention runs against
+    the gathered page window (pages are in logical order, so window index
+    == absolute position), masked causally by absolute position + kv_lens.
     """
     from lmrs_tpu.ops.paged_attention import paged_decode_pallas, paged_decode_xla
 
@@ -237,6 +284,15 @@ def forward_paged(
             else:
                 attn = paged_decode_xla(q[:, 0], kp, vp, page_tables, kv_lens)
             attn_out = attn[:, None]  # [B, 1, H, hd]
+        elif window_prefill:
+            # continuation prefill: attend the page window (self K/V included
+            # — this chunk was scattered into its pages above)
+            w = page_tables.shape[1]
+            k_win = kp[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+                b, w * ps, cfg.n_kv_heads, hd)
+            v_win = vp[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+                b, w * ps, cfg.n_kv_heads, hd)
+            attn_out = attention(q, k_win, v_win, positions, kv_lens)
         else:
             # fresh prefill: current tokens ARE the whole context
             attn_out = attention(q, k, v, positions, kv_lens)
