@@ -67,9 +67,12 @@ def test_pp_train_step_runs(setup):
     cfg, params, tokens = setup
     mesh = build_mesh(MeshConfig(dp=2, tp=1, sp=1, pp=2), jax.devices()[:4])
     opt = optax.adamw(1e-3)
-    opt_state = opt.init(params)
+    # the step donates params/opt_state; feed it copies so the shared
+    # module fixture (and the post-step comparison below) stay alive
+    donated = jax.tree.map(jnp.copy, params)
+    opt_state = opt.init(donated)
     step = make_pp_train_step(cfg, opt, mesh, n_micro=2)
-    p2, opt_state, loss = step(params, opt_state, tokens)
+    p2, opt_state, loss = step(donated, opt_state, tokens)
     assert np.isfinite(float(loss))
     # params actually moved
     moved = jax.tree.map(
