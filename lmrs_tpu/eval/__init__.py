@@ -1,0 +1,33 @@
+"""Quality-parity evaluation: ROUGE metrics + the parity harness.
+
+SURVEY.md §7.2 step 7: "Parity harness — ROUGE-L vs stored API-baseline
+outputs; chunks/sec + wall-clock benchmark runner; this is the BASELINE.json
+metric."  The reference has no evaluation machinery at all — its quality bar
+was "whatever GPT-4o returns" — so this subsystem is new surface required by
+the north-star target (BASELINE.json .metric: "ROUGE-L parity with the
+GPT-4o API baseline").
+"""
+
+__all__ = [
+    "rouge_l",
+    "rouge_n",
+    "rouge_scores",
+    "ParityReport",
+    "evaluate_parity",
+    "run_parity",
+]
+
+_ROUGE = {"rouge_l", "rouge_n", "rouge_scores"}
+
+
+def __getattr__(name: str):
+    # Lazy so `python -m lmrs_tpu.eval.parity` doesn't double-import parity.
+    if name in _ROUGE:
+        from lmrs_tpu.eval import rouge
+
+        return getattr(rouge, name)
+    if name in __all__:
+        from lmrs_tpu.eval import parity
+
+        return getattr(parity, name)
+    raise AttributeError(name)

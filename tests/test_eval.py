@@ -1,0 +1,116 @@
+"""Eval subsystem: ROUGE metrics + parity harness (SURVEY.md §7.2 step 7)."""
+
+import json
+
+import pytest
+
+from lmrs_tpu.eval.rouge import rouge_l, rouge_n, rouge_scores, tokenize
+from lmrs_tpu.eval.parity import evaluate_parity, load_baseline, run_parity
+
+
+def test_tokenize_lowercases_and_strips_punctuation():
+    assert tokenize("Hello, World! 42.") == ["hello", "world", "42"]
+
+
+def test_rouge_identical_is_one():
+    s = "the quick brown fox jumps over the lazy dog"
+    for scores in (rouge_n(s, s, 1), rouge_n(s, s, 2), rouge_l(s, s)):
+        assert scores["precision"] == pytest.approx(1.0)
+        assert scores["recall"] == pytest.approx(1.0)
+        assert scores["f"] == pytest.approx(1.0)
+
+
+def test_rouge_disjoint_is_zero():
+    assert rouge_l("alpha beta gamma", "delta epsilon zeta")["f"] == 0.0
+    assert rouge_n("alpha beta", "gamma delta", 1)["f"] == 0.0
+
+
+def test_rouge_empty_inputs():
+    assert rouge_l("", "reference text")["f"] == 0.0
+    assert rouge_l("candidate text", "")["f"] == 0.0
+    assert rouge_n("", "", 1)["f"] == 0.0
+
+
+def test_rouge_l_classic_example():
+    # Lin (2004): LCS("police killed the gunman", "police kill the gunman")
+    # = "police the gunman" → R = P = 3/4.
+    s = rouge_l("police kill the gunman", "police killed the gunman")
+    assert s["recall"] == pytest.approx(0.75)
+    assert s["precision"] == pytest.approx(0.75)
+
+
+def test_rouge_1_clipping():
+    # candidate repeats "the" 4x; reference has it twice → clipped to 2 matches.
+    s = rouge_n("the the the the", "the cat the dog", 1)
+    assert s["precision"] == pytest.approx(2 / 4)
+    assert s["recall"] == pytest.approx(2 / 4)
+
+
+def test_rouge_l_is_subsequence_not_substring():
+    # "a c e" is a subsequence of "a b c d e" (LCS=3) though not contiguous.
+    s = rouge_l("a c e", "a b c d e")
+    assert s["recall"] == pytest.approx(3 / 5)
+    assert s["precision"] == pytest.approx(1.0)
+
+
+def test_rouge_scores_multi_reference_takes_best():
+    scores = rouge_scores("the cat sat", ["totally unrelated words", "the cat sat"])
+    assert scores["rougeL"]["f"] == pytest.approx(1.0)
+    assert scores["rouge1"]["f"] == pytest.approx(1.0)
+
+
+def test_load_baseline_plain_and_json(tmp_path):
+    txt = tmp_path / "base.txt"
+    txt.write_text("A plain summary.")
+    assert load_baseline(txt) == "A plain summary."
+    js = tmp_path / "base.json"
+    js.write_text(json.dumps({"summary": "From JSON.", "meta": {"model": "gpt-4o"}}))
+    assert load_baseline(js) == "From JSON."
+
+
+def test_load_baseline_rejects_json_without_summary(tmp_path):
+    js = tmp_path / "api.json"
+    js.write_text(json.dumps({"choices": [{"message": {"content": "hi"}}]}))
+    with pytest.raises(ValueError, match="no top-level 'summary'"):
+        load_baseline(js)
+    arr = tmp_path / "arr.json"
+    arr.write_text("[1, 2, 3]")
+    with pytest.raises(ValueError, match="JSON array"):
+        load_baseline(arr)
+
+
+def test_rouge_scores_empty_references_raises():
+    from lmrs_tpu.eval.rouge import rouge_scores
+
+    with pytest.raises(ValueError, match="at least one reference"):
+        rouge_scores("candidate", [])
+
+
+def test_evaluate_parity_gate():
+    r = evaluate_parity("the meeting covered budget and hiring",
+                        "the meeting covered budget and hiring", threshold=0.9)
+    assert r.passed and r.rougeL_f == pytest.approx(1.0)
+    r2 = evaluate_parity("completely different text here",
+                         "the meeting covered budget and hiring", threshold=0.9)
+    assert not r2.passed
+
+
+def test_run_parity_end_to_end_mock(transcript):
+    """Self-parity: score the mock pipeline against its own prior output."""
+    from lmrs_tpu.config import EngineConfig, PipelineConfig
+    from lmrs_tpu.pipeline import TranscriptSummarizer
+
+    cfg = PipelineConfig(engine=EngineConfig(backend="mock"))
+    s = TranscriptSummarizer(cfg)
+    try:
+        baseline = s.summarize(transcript)["summary"]
+    finally:
+        s.shutdown()
+
+    report = run_parity(transcript, baseline, cfg, threshold=0.9)
+    assert report.passed, report.to_dict()
+    assert report.chunks > 0
+    assert report.wall_s > 0
+    assert report.chunks_per_sec > 0
+    d = report.to_dict()
+    assert d["passed"] is True and "rougeL_f" in d
