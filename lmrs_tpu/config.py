@@ -81,6 +81,11 @@ class ModelConfig:
     # Gemma-style differences
     logit_softcap: float | None = None
     embed_scale: bool = False  # Gemma multiplies embeddings by sqrt(dim)
+    # Mixture-of-experts (0 experts = dense FFN; ops/moe.py)
+    n_experts: int = 0
+    n_experts_per_token: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01  # load-balance loss weight in training
 
 
 @dataclass
@@ -94,12 +99,13 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1  # expert parallel (MoE expert axis; ops/moe.py)
     pp: int = 1
-    axis_names: tuple[str, ...] = ("dp", "tp", "sp", "pp")
+    axis_names: tuple[str, ...] = ("dp", "tp", "sp", "ep", "pp")
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.tp * self.sp * self.pp
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
 
 @dataclass
@@ -198,6 +204,14 @@ def model_preset(name: str) -> ModelConfig:
             vocab_size=256128, dim=3072, n_layers=28, n_heads=16, n_kv_heads=16,
             hidden_dim=24576, max_seq_len=8192, rope_theta=10000.0,
             tie_embeddings=True, embed_scale=True,
+        ),
+        "tiny-moe": dict(
+            hidden_dim=512, n_experts=4, n_experts_per_token=2,
+        ),
+        "mixtral-8x7b": dict(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            hidden_dim=14336, max_seq_len=8192, rope_theta=1e6,
+            tie_embeddings=False, n_experts=8, n_experts_per_token=2,
         ),
     }
     if name not in presets:

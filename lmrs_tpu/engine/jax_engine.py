@@ -107,7 +107,8 @@ class JaxEngine:
             from lmrs_tpu.parallel.sharding import shard_params
 
             self._mesh = build_mesh(self.mesh_cfg)
-            return shard_params(params, self._mesh, self.model_cfg.tie_embeddings)
+            return shard_params(params, self._mesh, self.model_cfg.tie_embeddings,
+                                moe=self.model_cfg.n_experts > 0)
         self._mesh = None
         return jax.device_put(params)
 

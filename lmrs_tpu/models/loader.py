@@ -50,7 +50,8 @@ def load_checkpoint(path: str, model_cfg: ModelConfig, mesh=None) -> Any:
     if mesh is not None:
         from lmrs_tpu.parallel.sharding import param_shardings
 
-        shardings = param_shardings(mesh, model_cfg.tie_embeddings)
+        shardings = param_shardings(mesh, model_cfg.tie_embeddings,
+                                    moe=model_cfg.n_experts > 0)
         target = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             target, shardings,
@@ -74,6 +75,14 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
         model.layers.{i}.mlp.{gate,up,down}_proj.weight  -> mlp.w_{...}[i]
         model.layers.{i}.(input|post_attention)_layernorm.weight -> ln_*[i]
         model.embed_tokens.weight / lm_head.weight / model.norm.weight
+
+    MoE configs (cfg.n_experts > 0, e.g. mixtral-8x7b) read Mixtral's layout
+    instead of the dense mlp keys:
+
+        model.layers.{i}.block_sparse_moe.gate.weight          -> moe.router[i]
+        model.layers.{i}.block_sparse_moe.experts.{j}.w1.weight -> moe.w_gate[i,j]
+        model.layers.{i}.block_sparse_moe.experts.{j}.w3.weight -> moe.w_up[i,j]
+        model.layers.{i}.block_sparse_moe.experts.{j}.w2.weight -> moe.w_down[i,j]
 
     HF stores projections as [out, in]; we store [in, out] (+ head split),
     and HF RMSNorm weights are ``w`` where we use ``1 + scale``.
@@ -108,6 +117,35 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
     def stack(fmt, transform):
         return np.stack([transform(get(fmt.format(i=i))) for i in range(L)]).astype(dt)
 
+    if cfg.n_experts:
+        E = cfg.n_experts
+
+        def stack_experts(fmt):
+            return np.stack([
+                np.stack([get(fmt.format(i=i, j=j)).T for j in range(E)])
+                for i in range(L)
+            ]).astype(dt)  # [L, E, in, out]
+
+        ffn = {
+            "moe": {
+                "router": stack("model.layers.{i}.block_sparse_moe.gate.weight",
+                                lambda w: w.T),  # [D, E]
+                "w_gate": stack_experts(
+                    "model.layers.{i}.block_sparse_moe.experts.{j}.w1.weight"),
+                "w_up": stack_experts(
+                    "model.layers.{i}.block_sparse_moe.experts.{j}.w3.weight"),
+                "w_down": stack_experts(
+                    "model.layers.{i}.block_sparse_moe.experts.{j}.w2.weight"),
+            }
+        }
+    else:
+        ffn = {
+            "mlp": {
+                "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
+                "w_up": stack("model.layers.{i}.mlp.up_proj.weight", lambda w: w.T),
+                "w_down": stack("model.layers.{i}.mlp.down_proj.weight", lambda w: w.T),
+            }
+        }
     params = {
         "embed": {"weight": get("model.embed_tokens.weight").astype(dt)},
         "layers": {
@@ -125,11 +163,7 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
                 "wo": stack("model.layers.{i}.self_attn.o_proj.weight",
                             lambda w: w.T.reshape(cfg.n_heads, hd, cfg.dim)),
             },
-            "mlp": {
-                "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", lambda w: w.T),
-                "w_up": stack("model.layers.{i}.mlp.up_proj.weight", lambda w: w.T),
-                "w_down": stack("model.layers.{i}.mlp.down_proj.weight", lambda w: w.T),
-            },
+            **ffn,
         },
         "final_norm": {"scale": (get("model.norm.weight") - 1.0).astype(dt)},
     }

@@ -50,7 +50,25 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 / math.sqrt(fan_in)).astype(dt)
 
     L = cfg.n_layers
-    lk = jax.random.split(k_layers, 7)
+    lk = jax.random.split(k_layers, 8)
+    if cfg.n_experts:
+        E = cfg.n_experts
+        ffn = {
+            "moe": {
+                "router": tn(lk[7], (L, cfg.dim, E), cfg.dim),
+                "w_gate": tn(lk[4], (L, E, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_up": tn(lk[5], (L, E, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_down": tn(lk[6], (L, E, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            }
+        }
+    else:
+        ffn = {
+            "mlp": {
+                "w_gate": tn(lk[4], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_up": tn(lk[5], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
+                "w_down": tn(lk[6], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
+            }
+        }
     params: Params = {
         "embed": {"weight": tn(k_embed, (cfg.vocab_size, cfg.dim), cfg.dim)},
         "layers": {
@@ -62,11 +80,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "wv": tn(lk[2], (L, cfg.dim, cfg.n_kv_heads, hd), cfg.dim),
                 "wo": tn(lk[3], (L, cfg.n_heads, hd, cfg.dim), cfg.n_heads * hd),
             },
-            "mlp": {
-                "w_gate": tn(lk[4], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_up": tn(lk[5], (L, cfg.dim, cfg.hidden_dim), cfg.dim),
-                "w_down": tn(lk[6], (L, cfg.hidden_dim, cfg.dim), cfg.hidden_dim),
-            },
+            **ffn,
         },
         "final_norm": {"scale": jnp.zeros((cfg.dim,), dt)},
     }
@@ -87,6 +101,20 @@ def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, jnp.n
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def ffn_block(lp: Params, cfg: ModelConfig, h: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Post-norm FFN body: dense SwiGLU or MoE.  h [B,S,D] (already normed)
+    -> (out [B,S,D], aux f32 scalar — the MoE load-balance loss, 0 for dense)."""
+    if cfg.n_experts:
+        from lmrs_tpu.ops.moe import moe_mlp
+
+        return moe_mlp(lp["moe"], cfg, h)
+    dt = h.dtype
+    gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
+    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
+    return jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"]), jnp.float32(0.0)
+
+
 def decoder_layer(
     lp: Params,               # one layer's params (no leading L axis)
     cfg: ModelConfig,
@@ -96,14 +124,14 @@ def decoder_layer(
     cos: jnp.ndarray,
     attn_fn=None,
     kv_length: jnp.ndarray | None = None,  # [B] valid-length mask (padding)
-) -> jnp.ndarray:
-    """One cache-less decoder block (attention + SwiGLU MLP, pre-norm).
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One cache-less decoder block (attention + dense/MoE FFN, pre-norm).
 
-    The shared body for training/prefill paths that don't carry a KV cache:
-    plain scan in ``forward``, ring attention (``attn_fn``), and the pipeline
-    stages in parallel/pipeline.py.
+    Returns (x, aux) where aux is the MoE load-balance loss for this layer
+    (0 for dense).  The shared body for training/prefill paths that don't
+    carry a KV cache: plain scan in ``forward``, ring attention
+    (``attn_fn``), and the pipeline stages in parallel/pipeline.py.
     """
-    dt = x.dtype
     hd = cfg.dim // cfg.n_heads
     h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
@@ -119,10 +147,8 @@ def decoder_layer(
                    lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
     x = x + o
     h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
-    gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
-    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
-    return x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+    ff, aux = ffn_block(lp, cfg, h)
+    return x + ff, aux
 
 
 def embed_tokens(params: Params, cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -155,8 +181,10 @@ def forward(
     kv_length: jnp.ndarray | None = None,         # [B] valid KV len AFTER this call's writes
     attn_fn=None,  # optional (q, k, v, positions) -> out override (e.g. ring
                    # attention for sequence-parallel training; cache-less only)
-) -> tuple[jnp.ndarray, dict[str, jnp.ndarray] | None]:
-    """Forward pass; returns (logits [B,S,V] f32, updated cache).
+    return_aux: bool = False,  # also return the layer-mean MoE aux loss
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray] | None] | tuple[jnp.ndarray, Any, jnp.ndarray]:
+    """Forward pass; returns (logits [B,S,V] f32, updated cache), plus the
+    layer-mean MoE load-balance loss as a third element when ``return_aux``.
 
     With a cache: K/V for `tokens` are scattered into it at `positions` and
     attention reads the cache (prefill S>1 or decode S=1 both work).
@@ -196,24 +224,31 @@ def forward(
             x = x + o
 
             h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
-            gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
-            up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
-            ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
-            x = x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+            ff, _ = ffn_block(lp, cfg, h)
+            x = x + ff
             return x, (ck, cv)
 
         # lax.scan over stacked layers: wq etc. are [L, ...]; cache [L, B, ...]
         x, (new_k, new_v) = jax.lax.scan(
             layer_fn, x, (params["layers"], cache["k"], cache["v"]))
         new_cache = {"k": new_k, "v": new_v}
+        aux = jnp.float32(0.0)
     else:
-        def layer_fn_nocache(x, lp):
-            return decoder_layer(lp, cfg, x, positions, sin, cos, attn_fn,
-                                 kv_length), None
-        x, _ = jax.lax.scan(layer_fn_nocache, x, params["layers"])
+        def layer_fn_nocache(carry, lp):
+            x, aux = carry
+            x, layer_aux = decoder_layer(lp, cfg, x, positions, sin, cos,
+                                         attn_fn, kv_length)
+            return (x, aux + layer_aux), None
+
+        (x, aux), _ = jax.lax.scan(
+            layer_fn_nocache, (x, jnp.float32(0.0)), params["layers"])
+        aux = aux / cfg.n_layers
         new_cache = None
 
-    return lm_head(params, cfg, x), new_cache
+    logits = lm_head(params, cfg, x)
+    if return_aux:
+        return logits, new_cache, aux
+    return logits, new_cache
 
 
 def forward_paged(
@@ -301,10 +336,8 @@ def forward_paged(
         x = x + o
 
         h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
-        gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
-        ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
-        x = x + jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"])
+        ff, _ = ffn_block(lp, cfg, h)
+        x = x + ff
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -1,0 +1,85 @@
+"""Mixture-of-experts FFN: top-k routing with capacity-bounded dispatch.
+
+TPU-first formulation (GShard/Switch style): routing is expressed as two
+einsums against one-hot dispatch/combine tensors, so the whole layer is
+MXU matmuls with static shapes — no scatter, no dynamic shapes, scannable
+and shardable.  Expert weights carry a leading expert axis that shards over
+the ``ep`` mesh axis (parallel.sharding); under GSPMD the dispatch einsum
+lowers to an all-to-all over ``ep``.
+
+The reference has no experts (dense API models only; SURVEY.md §2.2 "EP:
+out of scope unless a MoE checkpoint is adopted; design mesh axes so EP can
+be added") — this module plus the ``ep`` axis is that design carried out.
+
+Capacity semantics: each expert processes at most C tokens per call
+(C = capacity_factor * N * k / E); overflow tokens lose that expert's
+contribution (their residual stream passes through unchanged) — the
+standard trade for static shapes on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from lmrs_tpu.config import ModelConfig
+
+
+def expert_capacity(n_tokens: int, cfg: ModelConfig) -> int:
+    """Static per-expert token capacity for a call with ``n_tokens`` tokens."""
+    k = min(cfg.n_experts_per_token, cfg.n_experts)
+    c = math.ceil(cfg.expert_capacity_factor * n_tokens * k / cfg.n_experts)
+    return max(1, min(n_tokens, c))
+
+
+def moe_mlp(mp, cfg: ModelConfig, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE SwiGLU FFN.  x [B,S,D] -> (out [B,S,D], aux_loss scalar f32).
+
+    ``mp`` holds one layer's expert params: router [D,E], w_gate/w_up
+    [E,D,F], w_down [E,F,D].  The aux loss is the Switch load-balancing
+    term E * Σ_e f_e·P_e (≈1 when balanced), from top-1 assignments.
+    """
+    dt = x.dtype
+    b, s, d = x.shape
+    e = cfg.n_experts
+    k = min(cfg.n_experts_per_token, e)
+    n = b * s
+    xt = x.reshape(n, d)
+
+    # --- routing (f32 for a stable softmax) ---
+    logits = jnp.einsum("nd,de->ne", xt.astype(jnp.float32),
+                        mp["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)              # [N,E]
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)        # [N,k]
+    gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+    # --- capacity assignment: slot-major cumsum so primary (slot-0)
+    # assignments claim capacity before secondary ones ---
+    c = expert_capacity(n, cfg)
+    expert_flat = gate_idx.T.reshape(k * n)              # [kN] slot-major
+    onehot = jax.nn.one_hot(expert_flat, e, dtype=jnp.int32)
+    pos = ((jnp.cumsum(onehot, axis=0) - onehot) * onehot).sum(-1)  # [kN]
+    keep = (pos < c).astype(jnp.float32)
+    gates_flat = gate_vals.T.reshape(k * n) * keep
+
+    # dispatch/combine one-hots: [kN,E,C] -> merge the k slots -> [N,E,C]
+    slot_oh = jax.nn.one_hot(jnp.clip(pos, 0, c - 1), c, dtype=jnp.float32)
+    dispatch = (onehot.astype(jnp.float32) * keep[:, None])[:, :, None] * slot_oh[:, None, :]
+    combine = gates_flat[:, None, None] * dispatch
+    dispatch = dispatch.reshape(k, n, e, c).sum(0)
+    combine = combine.reshape(k, n, e, c).sum(0)
+
+    # --- expert FFN: all-MXU einsums over [E,C,·] ---
+    xin = jnp.einsum("nd,nec->ecd", xt, dispatch.astype(dt))
+    gate_h = jnp.einsum("ecd,edf->ecf", xin, mp["w_gate"])
+    up = jnp.einsum("ecd,edf->ecf", xin, mp["w_up"])
+    ff = jax.nn.silu(gate_h.astype(jnp.float32)).astype(dt) * up
+    y = jnp.einsum("ecf,efd->ecd", ff, mp["w_down"])
+    out = jnp.einsum("nec,ecd->nd", combine.astype(dt), y)
+
+    # --- Switch load-balance loss ---
+    f = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(f * probs.mean(0))
+    return out.reshape(b, s, d), aux

@@ -8,6 +8,8 @@ Axes (SURVEY.md §2.2 "TPU-native equivalent to build"):
 * ``tp`` — tensor parallel: attention heads + FFN sharded over ICI.
 * ``sp`` — sequence/context parallel: ring attention for single chunks whose
   KV exceeds one chip (SURVEY.md §5.7 tier b).
+* ``ep`` — expert parallel: MoE expert axis (ops/moe.py); dispatch einsums
+  lower to an all-to-all over this axis under GSPMD.
 * ``pp`` — pipeline parallel: layer stages for the 70B tier.
 """
 
@@ -37,10 +39,10 @@ def build_mesh(cfg: MeshConfig | None = None, devices: list | None = None) -> Me
     want = cfg.n_devices
     if want > n:
         raise ValueError(f"mesh needs {want} devices ({cfg}), only {n} available")
-    arr = np.array(devices[:want]).reshape(cfg.dp, cfg.tp, cfg.sp, cfg.pp)
+    arr = np.array(devices[:want]).reshape(cfg.dp, cfg.tp, cfg.sp, cfg.ep, cfg.pp)
     mesh = Mesh(arr, axis_names=cfg.axis_names)
-    logger.info("mesh: dp=%d tp=%d sp=%d pp=%d over %d %s device(s)",
-                cfg.dp, cfg.tp, cfg.sp, cfg.pp, want, devices[0].platform)
+    logger.info("mesh: dp=%d tp=%d sp=%d ep=%d pp=%d over %d %s device(s)",
+                cfg.dp, cfg.tp, cfg.sp, cfg.ep, cfg.pp, want, devices[0].platform)
     return mesh
 
 

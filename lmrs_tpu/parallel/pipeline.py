@@ -40,12 +40,15 @@ from lmrs_tpu.ops.rope import rope_table
 
 
 def _stage_scan(layers_local, cfg: ModelConfig, x, positions, sin, cos):
-    """Apply this stage's L/pp layers (scan over the local leading axis)."""
+    """Apply this stage's L/pp layers (scan over the local leading axis).
+    Returns (x, aux_sum) — the summed MoE load-balance loss of the local
+    layers (0 for dense models)."""
     def body(x, lp):
-        return decoder_layer(lp, cfg, x, positions, sin, cos), None
+        x, aux = decoder_layer(lp, cfg, x, positions, sin, cos)
+        return x, aux
 
-    x, _ = lax.scan(body, x, layers_local)
-    return x
+    x, aux = lax.scan(body, x, layers_local)
+    return x, aux.sum()
 
 
 def pipeline_causal_lm_loss(
@@ -101,7 +104,7 @@ def pipeline_causal_lm_loss(
         x_in = jax.vmap(lambda t: embed_tokens(sp, cfg, t))(micro)  # [M,mb,S,D]
 
         def tick(carry, t):
-            y_prev, loss_sum, tok_count = carry
+            y_prev, loss_sum, tok_count, aux_sum, aux_count = carry
             # previous tick's output moves one stage down the ring
             recv = lax.ppermute(
                 y_prev, pp_axis,
@@ -109,7 +112,15 @@ def pipeline_causal_lm_loss(
             feed = lax.dynamic_index_in_dim(
                 x_in, jnp.clip(t, 0, m - 1), keepdims=False)
             x = jnp.where(stage == 0, feed, recv)
-            y = _stage_scan(layers_local, cfg, x, positions, sin, cos)
+            y, stage_aux = _stage_scan(layers_local, cfg, x, positions, sin, cos)
+
+            # stage s processes microbatch t-s at tick t; aux only counts
+            # when that's a real microbatch (not warmup/drain garbage)
+            mb_idx = t - stage
+            aux_valid = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+            aux_sum = aux_sum + jnp.where(aux_valid, stage_aux, 0.0)
+            aux_count = aux_count + jnp.where(
+                aux_valid, layers_local["ln_attn"]["scale"].shape[0], 0)
 
             # the microbatch finishing at tick t on the last stage is t-(pp-1)
             out_idx = jnp.clip(t - (pp - 1), 0, m - 1)
@@ -120,16 +131,21 @@ def pipeline_causal_lm_loss(
             valid = jnp.logical_and(stage == pp - 1, t >= pp - 1)
             loss_sum = loss_sum + jnp.where(valid, nll.sum(), 0.0)
             tok_count = tok_count + jnp.where(valid, nll.size, 0)
-            return (y, loss_sum, tok_count), None
+            return (y, loss_sum, tok_count, aux_sum, aux_count), None
 
         init = (jnp.zeros((mb, s, cfg.dim), x_in.dtype),
-                jnp.float32(0.0), jnp.int32(0))
-        (_, loss_sum, tok_count), _ = lax.scan(
+                jnp.float32(0.0), jnp.int32(0), jnp.float32(0.0), jnp.int32(0))
+        (_, loss_sum, tok_count, aux_sum, aux_count), _ = lax.scan(
             tick, init, jnp.arange(m + pp - 1))
 
         loss_sum = lax.psum(lax.psum(loss_sum, pp_axis), dp_axis)
         tok_count = lax.psum(lax.psum(tok_count, pp_axis), dp_axis)
-        return loss_sum / jnp.maximum(tok_count, 1)
+        loss = loss_sum / jnp.maximum(tok_count, 1)
+        if cfg.n_experts and cfg.router_aux_coef:
+            aux_sum = lax.psum(lax.psum(aux_sum, pp_axis), dp_axis)
+            aux_count = lax.psum(lax.psum(aux_count, pp_axis), dp_axis)
+            loss = loss + cfg.router_aux_coef * aux_sum / jnp.maximum(aux_count, 1)
+        return loss
 
     fn = jax.shard_map(
         body,

@@ -24,8 +24,25 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
+def param_specs(tie_embeddings: bool = True, moe: bool = False) -> dict[str, Any]:
     """PartitionSpec pytree matching models.transformer.init_params layout."""
+    if moe:
+        ffn = {
+            "moe": {
+                "router": P(None, None, None),     # [L, D, E] replicated (tiny)
+                "w_gate": P(None, "ep", None, "tp"),  # [L, E, D, F] experts over ep
+                "w_up": P(None, "ep", None, "tp"),
+                "w_down": P(None, "ep", "tp", None),  # [L, E, F, D]
+            }
+        }
+    else:
+        ffn = {
+            "mlp": {
+                "w_gate": P(None, None, "tp"),  # [L, D, F] column
+                "w_up": P(None, None, "tp"),
+                "w_down": P(None, "tp", None),  # [L, F, D] row
+            }
+        }
     specs = {
         "embed": {"weight": P("tp", None)},  # vocab sharded
         "layers": {
@@ -37,11 +54,7 @@ def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
                 "wv": P(None, None, "tp", None),
                 "wo": P(None, "tp", None, None),  # [L, H, hd, D] row parallel
             },
-            "mlp": {
-                "w_gate": P(None, None, "tp"),  # [L, D, F] column
-                "w_up": P(None, None, "tp"),
-                "w_down": P(None, "tp", None),  # [L, F, D] row
-            },
+            **ffn,
         },
         "final_norm": {"scale": P(None)},
     }
@@ -50,18 +63,19 @@ def param_specs(tie_embeddings: bool = True) -> dict[str, Any]:
     return specs
 
 
-def param_shardings(mesh: Mesh, tie_embeddings: bool = True):
+def param_shardings(mesh: Mesh, tie_embeddings: bool = True, moe: bool = False):
     """NamedSharding pytree for jit in_shardings / device_put."""
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec),
-        param_specs(tie_embeddings),
+        param_specs(tie_embeddings, moe),
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
-def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True) -> Any:
+def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True,
+                 moe: bool = False) -> Any:
     """Place a host-side param pytree onto the mesh with the TP layout."""
-    shardings = param_shardings(mesh, tie_embeddings)
+    shardings = param_shardings(mesh, tie_embeddings, moe)
     return jax.tree.map(jax.device_put, params, shardings)
 
 

@@ -28,15 +28,20 @@ def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
     """Next-token cross-entropy in f32.  tokens [B, S]; predicts tokens[:,1:]."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    logits, _ = forward(params, cfg, tokens, positions, attn_fn=attn_fn)  # [B,S,V] f32
+    logits, _, aux = forward(params, cfg, tokens, positions, attn_fn=attn_fn,
+                             return_aux=True)  # [B,S,V] f32
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if loss_mask is not None:
         m = loss_mask[:, 1:].astype(jnp.float32)
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return nll.mean()
+        loss = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    else:
+        loss = nll.mean()
+    if cfg.n_experts and cfg.router_aux_coef:
+        loss = loss + cfg.router_aux_coef * aux
+    return loss
 
 
 def make_train_step(
@@ -68,7 +73,7 @@ def make_train_step(
     if mesh is None:
         return jax.jit(step)
 
-    pspecs = param_shardings(mesh, cfg.tie_embeddings)
+    pspecs = param_shardings(mesh, cfg.tie_embeddings, moe=cfg.n_experts > 0)
     batch_sh = NamedSharding(mesh, batch_spec(seq_sharded))
     # opt_state sharding left unconstrained: XLA propagates the param layout
     # into the optimizer tree (adam mu/nu mirror the params).

@@ -23,7 +23,7 @@ def cfg8():
 
 def test_build_mesh_axes():
     mesh = build_mesh(MeshConfig(dp=2, tp=2, sp=2, pp=1))
-    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2, "pp": 1}
+    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2, "ep": 1, "pp": 1}
 
 
 def test_mesh_too_big_raises():
