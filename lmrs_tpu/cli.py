@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None,
                    help="device mesh axes as dp,tp[,sp[,pp]] e.g. 2,4 or 1,4,2,1")
     p.add_argument("--profile", action="store_true", help="emit jax.profiler spans")
+    p.add_argument("--quantize", default=None, choices=["int8"],
+                   help="weight-only quantization for the jax backend")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
@@ -83,6 +85,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, model=args.model)
     if args.max_concurrent_requests is not None:
         engine = dataclasses.replace(engine, max_concurrent_requests=args.max_concurrent_requests)
+    if args.quantize:
+        engine = dataclasses.replace(engine, quantize=args.quantize)
     return PipelineConfig(
         data=DataConfig(
             merge_same_speaker=not args.no_merge,

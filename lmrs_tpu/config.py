@@ -136,12 +136,16 @@ class EngineConfig:
     num_pages: int = 512
     prefill_chunk: int = 512
     checkpoint_path: str | None = None
+    quantize: str | None = None  # None | "int8" (weight-only; ops/quant.py)
 
     def __post_init__(self) -> None:
         # Reference DEFAULT_PROVIDER values name HTTP vendors; both map to
         # the local engine choice "mock" when no backend is explicitly set.
         if self.backend in ("openai", "anthropic"):
             self.backend = "mock"
+        if self.quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {self.quantize!r}; "
+                             "supported: int8")
 
 
 @dataclass

@@ -80,6 +80,13 @@ class JaxEngine:
                     "(throughput-correct, content-free)", model_cfg.name,
                 )
                 params = init_params(model_cfg, key)
+        if engine_cfg.quantize:  # mode validated in EngineConfig.__post_init__
+            from lmrs_tpu.ops.quant import quantize_params, quantized_bytes
+
+            before = quantized_bytes(params)
+            params = quantize_params(params)
+            logger.info("int8 weight quantization: %.1f -> %.1f MiB",
+                        before / 2**20, quantized_bytes(params) / 2**20)
         self.params = self._place(params)
         logger.info("model %s: %.1fM params ready in %.1fs", model_cfg.name,
                     param_count(self.params) / 1e6, time.time() - t0)

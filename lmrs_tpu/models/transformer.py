@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from lmrs_tpu.config import ModelConfig
 from lmrs_tpu.ops.attention import attention
 from lmrs_tpu.ops.norms import rms_norm
+from lmrs_tpu.ops.quant import deq
 from lmrs_tpu.ops.rope import apply_rope, rope_table
 
 Params = dict[str, Any]
@@ -109,10 +110,30 @@ def ffn_block(lp: Params, cfg: ModelConfig, h: jnp.ndarray) -> tuple[jnp.ndarray
 
         return moe_mlp(lp["moe"], cfg, h)
     dt = h.dtype
-    gate = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", h, lp["mlp"]["w_up"])
+    gate = jnp.einsum("bsd,df->bsf", h, deq(lp["mlp"]["w_gate"], dt))
+    up = jnp.einsum("bsd,df->bsf", h, deq(lp["mlp"]["w_up"], dt))
     ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
-    return jnp.einsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"]), jnp.float32(0.0)
+    return jnp.einsum("bsf,fd->bsd", ff, deq(lp["mlp"]["w_down"], dt)), jnp.float32(0.0)
+
+
+def qkv_proj(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
+    """Project a normed [B,S,D] into (q [B,S,H,hd], k, v [B,S,K,hd])."""
+    hd = cfg.dim // cfg.n_heads
+    dt = h.dtype
+    q = jnp.einsum("bsd,dhk->bshk", h,
+                   deq(lp["attn"]["wq"], dt).reshape(cfg.dim, cfg.n_heads, hd))
+    k = jnp.einsum("bsd,dhk->bshk", h,
+                   deq(lp["attn"]["wk"], dt).reshape(cfg.dim, cfg.n_kv_heads, hd))
+    v = jnp.einsum("bsd,dhk->bshk", h,
+                   deq(lp["attn"]["wv"], dt).reshape(cfg.dim, cfg.n_kv_heads, hd))
+    return q, k, v
+
+
+def out_proj(lp: Params, cfg: ModelConfig, attn_out: jnp.ndarray) -> jnp.ndarray:
+    """[B,S,H,hd] attention output back to [B,S,D]."""
+    hd = cfg.dim // cfg.n_heads
+    wo = deq(lp["attn"]["wo"], attn_out.dtype).reshape(cfg.n_heads, hd, cfg.dim)
+    return jnp.einsum("bshk,hkd->bsd", attn_out, wo)
 
 
 def decoder_layer(
@@ -132,20 +153,15 @@ def decoder_layer(
     carry a KV cache: plain scan in ``forward``, ring attention
     (``attn_fn``), and the pipeline stages in parallel/pipeline.py.
     """
-    hd = cfg.dim // cfg.n_heads
     h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+    q, k, v = qkv_proj(lp, cfg, h)
     q = apply_rope(q, positions, sin, cos)
     k = apply_rope(k, positions, sin, cos)
     if attn_fn is not None:
         attn_out = attn_fn(q, k, v, positions)
     else:
         attn_out = attention(q, k, v, positions, kv_length, logit_softcap=None)
-    o = jnp.einsum("bshk,hkd->bsd", attn_out,
-                   lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
-    x = x + o
+    x = x + out_proj(lp, cfg, attn_out)
     h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
     ff, aux = ffn_block(lp, cfg, h)
     return x + ff, aux
@@ -165,7 +181,7 @@ def lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["weight"])
+        logits = jnp.einsum("bsd,dv->bsv", x, deq(params["lm_head"]["weight"], x.dtype))
     logits = logits.astype(jnp.float32)
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
@@ -210,18 +226,14 @@ def forward(
         def layer_fn(x, xs):
             lp, ck, cv = xs  # layer params, cache slices [B, Smax, K, hd]
             h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
-            q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
-            k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
-            v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+            q, k, v = qkv_proj(lp, cfg, h)
             q = apply_rope(q, positions, sin, cos)
             k = apply_rope(k, positions, sin, cos)
             ck = ck.at[batch_idx, positions].set(k)
             cv = cv.at[batch_idx, positions].set(v)
             attn_out = attention(q, ck, cv, positions, kv_length,
                                  logit_softcap=None)
-            o = jnp.einsum("bshk,hkd->bsd", attn_out,
-                           lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
-            x = x + o
+            x = x + out_proj(lp, cfg, attn_out)
 
             h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
             ff, _ = ffn_block(lp, cfg, h)
@@ -302,9 +314,7 @@ def forward_paged(
     def layer_fn(x, xs):
         lp, kp, vp = xs  # kp/vp: [K, P, ps, hd]
         h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wq"].reshape(cfg.dim, cfg.n_heads, hd))
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wk"].reshape(cfg.dim, cfg.n_kv_heads, hd))
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["attn"]["wv"].reshape(cfg.dim, cfg.n_kv_heads, hd))
+        q, k, v = qkv_proj(lp, cfg, h)
         q = apply_rope(q, positions, sin, cos)
         k = apply_rope(k, positions, sin, cos)
 
@@ -331,9 +341,7 @@ def forward_paged(
         else:
             # fresh prefill: current tokens ARE the whole context
             attn_out = attention(q, k, v, positions, kv_lens)
-        o = jnp.einsum("bshk,hkd->bsd", attn_out,
-                       lp["attn"]["wo"].reshape(cfg.n_heads, hd, cfg.dim))
-        x = x + o
+        x = x + out_proj(lp, cfg, attn_out)
 
         h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
         ff, _ = ffn_block(lp, cfg, h)
@@ -347,7 +355,7 @@ def forward_paged(
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["weight"])
+        logits = jnp.einsum("bsd,dv->bsv", x, deq(params["lm_head"]["weight"], x.dtype))
     logits = logits.astype(jnp.float32)
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from lmrs_tpu.config import ModelConfig
+from lmrs_tpu.ops.quant import deq
 
 
 def expert_capacity(n_tokens: int, cfg: ModelConfig) -> int:
@@ -73,10 +74,10 @@ def moe_mlp(mp, cfg: ModelConfig, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndar
 
     # --- expert FFN: all-MXU einsums over [E,C,·] ---
     xin = jnp.einsum("nd,nec->ecd", xt, dispatch.astype(dt))
-    gate_h = jnp.einsum("ecd,edf->ecf", xin, mp["w_gate"])
-    up = jnp.einsum("ecd,edf->ecf", xin, mp["w_up"])
+    gate_h = jnp.einsum("ecd,edf->ecf", xin, deq(mp["w_gate"], dt))
+    up = jnp.einsum("ecd,edf->ecf", xin, deq(mp["w_up"], dt))
     ff = jax.nn.silu(gate_h.astype(jnp.float32)).astype(dt) * up
-    y = jnp.einsum("ecf,efd->ecd", ff, mp["w_down"])
+    y = jnp.einsum("ecf,efd->ecd", ff, deq(mp["w_down"], dt))
     out = jnp.einsum("nec,ecd->nd", combine.astype(dt), y)
 
     # --- Switch load-balance loss ---

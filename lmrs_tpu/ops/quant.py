@@ -1,0 +1,111 @@
+"""Int8 weight-only quantization.
+
+TPU decode is HBM-bandwidth-bound (SURVEY.md §7.4: the scheduler/kernel
+design problem is feeding the MXU, not FLOPs) — storing the big projection
+matrices as int8 halves the bytes streamed per decode step vs bfloat16.
+Dequantization is a convert+multiply that XLA fuses into the consuming
+matmul, so the bf16 tensor never materializes in HBM.
+
+Scheme: symmetric per-output-channel scales.  For a weight of shape
+[..., out], ``s = max|w| / 127`` over all axes except the last, ``q =
+round(w / s)`` as int8; a quantized leaf is the dict ``{"q": int8, "s":
+f32}``.  Weights stay in this form in the param pytree; every use site in
+models/transformer.py goes through ``deq`` (a no-op passthrough for plain
+arrays, so dense/bf16 params take the same code path).
+
+The reference has no weights at all (the model is behind OpenAI's API) —
+this is serving-stack surface with no reference counterpart.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and set(leaf.keys()) == {"q", "s"}
+
+
+def quantize_weight(w: jnp.ndarray, axes: tuple[int, ...]) -> dict[str, jnp.ndarray]:
+    """Symmetric int8 quantization; ``axes`` are the contracting axes of the
+    consuming matmul — scales are shared only along them, so every output
+    channel (and every stacked layer / expert) gets its own scale."""
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=axes, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def deq(x: Any, dtype) -> jnp.ndarray:
+    """Dequantize a {"q","s"} leaf to ``dtype``; plain arrays pass through.
+    The convert*scale is an elementwise producer of the consuming matmul —
+    XLA fuses it, so only int8 is read from HBM."""
+    if is_quantized(x):
+        return (x["q"].astype(jnp.float32) * x["s"]).astype(dtype)
+    return x
+
+
+# Weight names eligible for quantization: the large projection matrices.
+# Embeddings stay full-precision (gather path), router stays full-precision
+# (tiny, and routing decisions are precision-sensitive), norms are vectors.
+_QUANT_NAMES = frozenset({"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"})
+
+
+def _contract_axes(name: str, ndim: int) -> tuple[int, ...]:
+    """Contracting axes of the matmul that consumes each stacked weight:
+    wq/wk/wv [L,D,H,hd] contract D; wo [L,H,hd,D] contracts (H,hd); dense
+    FFN [L,in,out] contracts axis 1; MoE FFN [L,E,in,out] contracts axis 2;
+    lm_head [D,V] contracts D."""
+    if name == "wo":
+        return (1, 2)
+    if name in ("wq", "wk", "wv"):
+        return (1,)
+    if name in ("w_gate", "w_up", "w_down"):
+        return (2,) if ndim == 4 else (1,)
+    if name == "lm_head":
+        return (0,)
+    raise ValueError(f"no contraction rule for weight {name!r}")
+
+
+def quantize_params(params: Any) -> Any:
+    """Quantize the projection weights of a transformer param pytree.
+
+    Returns a new pytree where eligible leaves become {"q","s"} dicts;
+    structure is otherwise identical (scan/shard/jit all still work).
+    ``lm_head.weight`` is included; ``embed.weight`` is not.
+    """
+    def walk(tree: Any, path: tuple[str, ...]) -> Any:
+        if isinstance(tree, dict) and not is_quantized(tree):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        name = path[-1] if path else ""
+        if name in _QUANT_NAMES:
+            return quantize_weight(tree, _contract_axes(name, tree.ndim))
+        if len(path) >= 2 and path[-2] == "lm_head":
+            return quantize_weight(tree, _contract_axes("lm_head", tree.ndim))
+        return tree
+
+    return walk(params, ())
+
+
+def quantized_bytes(params: Any) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+def match_quantized_specs(specs: Any, params: Any) -> Any:
+    """Adapt a PartitionSpec pytree to a quantized param pytree: wherever a
+    param leaf is {"q","s"}, the spec leaf P becomes {"q": P, "s": P(...)}
+    (scales replicated — they are tiny)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(spec: Any, param: Any) -> Any:
+        if is_quantized(param):
+            return {"q": spec, "s": P(*([None] * param["s"].ndim))}
+        if isinstance(param, dict):
+            return {k: walk(spec[k], param[k]) for k in param}
+        return spec
+
+    return walk(specs, params)

@@ -63,20 +63,28 @@ def param_specs(tie_embeddings: bool = True, moe: bool = False) -> dict[str, Any
     return specs
 
 
-def param_shardings(mesh: Mesh, tie_embeddings: bool = True, moe: bool = False):
-    """NamedSharding pytree for jit in_shardings / device_put."""
+def specs_to_shardings(specs: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree → NamedSharding pytree on ``mesh``."""
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        param_specs(tie_embeddings, moe),
+        lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
+def param_shardings(mesh: Mesh, tie_embeddings: bool = True, moe: bool = False):
+    """NamedSharding pytree for jit in_shardings / device_put."""
+    return specs_to_shardings(param_specs(tie_embeddings, moe), mesh)
+
+
 def shard_params(params: Any, mesh: Mesh, tie_embeddings: bool = True,
                  moe: bool = False) -> Any:
-    """Place a host-side param pytree onto the mesh with the TP layout."""
-    shardings = param_shardings(mesh, tie_embeddings, moe)
-    return jax.tree.map(jax.device_put, params, shardings)
+    """Place a host-side param pytree onto the mesh with the TP layout.
+    Handles int8-quantized trees (ops/quant.py): the q tensor takes the
+    weight's spec, scales replicate."""
+    from lmrs_tpu.ops.quant import match_quantized_specs
+
+    specs = match_quantized_specs(param_specs(tie_embeddings, moe), params)
+    return jax.tree.map(jax.device_put, params, specs_to_shardings(specs, mesh))
 
 
 def batch_spec(seq_sharded: bool = False) -> P:

@@ -1,0 +1,130 @@
+"""Int8 weight-only quantization (ops/quant.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lmrs_tpu.config import MeshConfig, ModelConfig
+from lmrs_tpu.models.transformer import forward, init_params
+from lmrs_tpu.ops.quant import (
+    deq,
+    is_quantized,
+    quantize_params,
+    quantize_weight,
+    quantized_bytes,
+)
+
+
+def _cfg(**kw) -> ModelConfig:
+    base = dict(vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                hidden_dim=96, max_seq_len=128, dtype="float32",
+                tie_embeddings=False)
+    base.update(kw)
+    return ModelConfig(name="test-q", **base)
+
+
+def test_quantize_weight_roundtrip_error():
+    w = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 48), jnp.float32) * 0.1
+    q = quantize_weight(w, axes=(1,))
+    assert q["q"].dtype == jnp.int8
+    assert q["s"].shape == (2, 1, 48)  # per-layer, per-out-channel scales
+    back = deq(q, jnp.float32)
+    # max error is half a quantization step = s/2 per element
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    bound = np.asarray(q["s"]) * 0.5 + 1e-8
+    assert (err <= bound + 1e-7).all()
+
+
+def test_deq_passthrough():
+    w = jnp.ones((4, 4), jnp.bfloat16)
+    assert deq(w, jnp.bfloat16) is w
+
+
+def test_quantize_params_structure_and_size():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    # projections quantized, embeddings/norms untouched
+    assert is_quantized(qparams["layers"]["attn"]["wq"])
+    assert is_quantized(qparams["layers"]["mlp"]["w_gate"])
+    assert is_quantized(qparams["lm_head"]["weight"])
+    assert not is_quantized(qparams["embed"])
+    assert qparams["embed"]["weight"].dtype == params["embed"]["weight"].dtype
+    assert not is_quantized(qparams["layers"]["ln_attn"])
+    # big weights at 1/4 the bytes (f32 model) -> sizable total shrink
+    assert quantized_bytes(qparams) < 0.6 * quantized_bytes(params)
+
+
+def test_quantized_forward_close_to_full_precision():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16)[None], (2, 16))
+    full, _ = forward(params, cfg, tokens, pos)
+    quant, _ = forward(qparams, cfg, tokens, pos)
+    # int8 noise is small relative to logit scale; top-1 agreement is the bar
+    assert np.isfinite(np.asarray(quant)).all()
+    top_full = np.asarray(jnp.argmax(full, -1))
+    top_quant = np.asarray(jnp.argmax(quant, -1))
+    assert (top_full == top_quant).mean() > 0.9
+
+
+def test_quantize_params_moe():
+    cfg = _cfg(n_experts=4, n_experts_per_token=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_params(params)
+    moe = qparams["layers"]["moe"]
+    assert is_quantized(moe["w_gate"])
+    # per (layer, expert, out-channel) scales: [L, E, 1, F]
+    assert moe["w_gate"]["s"].shape == (cfg.n_layers, cfg.n_experts, 1, cfg.hidden_dim)
+    assert not is_quantized(moe["router"])  # router stays full precision
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8)[None], (1, 8))
+    logits, _ = forward(qparams, cfg, tokens, pos)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_quantized_shard_params_on_mesh():
+    from lmrs_tpu.parallel.mesh import build_mesh
+    from lmrs_tpu.parallel.sharding import shard_params
+
+    cfg = _cfg()
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)))
+    mesh = build_mesh(MeshConfig(dp=2, tp=2), jax.devices()[:4])
+    sharded = shard_params(params, mesh, cfg.tie_embeddings)
+    wq = sharded["layers"]["attn"]["wq"]
+    # q sharded like the original weight (heads over tp), scales replicated
+    assert wq["q"].sharding.shard_shape(wq["q"].shape)[2] == cfg.n_heads // 2
+    assert wq["s"].sharding.is_fully_replicated
+
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8)[None], (2, 8))
+    logits, _ = jax.jit(lambda p, t, q: forward(p, cfg, t, q))(sharded, tokens, pos)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_engine_generates_with_int8():
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.engine.api import GenerationRequest, make_engine
+
+    eng_cfg = EngineConfig(backend="jax", model="tiny", quantize="int8",
+                           max_batch_slots=2, num_pages=64, page_size=16)
+    engine = make_engine(eng_cfg)
+    try:
+        reqs = [GenerationRequest(prompt="quantized decode test", request_id=0,
+                                  max_new_tokens=8)]
+        results = engine.generate_batch(reqs)
+    finally:
+        engine.shutdown()
+    assert results[0].error is None
+    assert results[0].completion_tokens > 0
+
+
+def test_engine_rejects_unknown_quantize_mode():
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.engine.api import make_engine
+
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        make_engine(EngineConfig(backend="jax", model="tiny", quantize="fp4"))
