@@ -147,6 +147,14 @@ def main(argv: list[str] | None = None) -> int:
             f"device-s: {stats['total_device_seconds']}  "
             f"wall: {format_duration(stats['processing_time'])}"
         )
+        em = stats.get("engine_metrics") or {}
+        if em:
+            print(
+                f"engine: prefill {em['prefill_tokens_per_sec']} tok/s  "
+                f"decode {em['decode_tokens_per_sec']} tok/s  "
+                f"occupancy {em['mean_decode_occupancy']}  "
+                f"kv-pages {em['peak_kv_page_utilization']}"
+            )
 
     if args.output:
         try:

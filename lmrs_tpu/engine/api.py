@@ -64,6 +64,11 @@ class Engine(Protocol):
 
     def shutdown(self) -> None: ...
 
+    def engine_metrics(self) -> dict:
+        """Serving metrics (tokens/s, occupancy, KV utilization); {} when the
+        backend has none (SURVEY.md §5.5 'new build' obligation)."""
+        ...
+
 
 def make_engine(
     engine_cfg: "EngineConfig",

@@ -122,6 +122,9 @@ class JaxEngine:
     def shutdown(self) -> None:
         self._gen_fns.clear()
 
+    def engine_metrics(self) -> dict:
+        return self._scheduler.metrics_report() if self._scheduler else {}
+
     # -------------------------------------------------------------- generate
 
     def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:

@@ -40,6 +40,9 @@ class MockEngine:
     def shutdown(self) -> None:
         pass
 
+    def engine_metrics(self) -> dict:
+        return {}
+
     def _one(self, req: GenerationRequest) -> GenerationResult:
         if self.latency_s:
             time.sleep(self.latency_s)

@@ -95,7 +95,28 @@ class ContinuousScheduler:
         # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
         self.metrics = {
             "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
-            "occupancy_sum": 0.0, "peak_pages_in_use": 0,
+            "occupancy_sum": 0.0, "peak_pages_in_use": 0, "run_seconds": 0.0,
+        }
+
+    def metrics_report(self) -> dict:
+        """Derived engine metrics, cumulative over every run() on this
+        scheduler (the same lifetime semantics as the executor's token
+        counters, llm_executor.py:86-90): throughput (tokens/s over
+        scheduler wall-clock), mean decode batch occupancy (fraction of
+        slots live per dispatch), and peak KV page utilization over the
+        usable pool (the HBM-pressure analog)."""
+        m = self.metrics
+        secs = max(m["run_seconds"], 1e-9)
+        return {
+            "prefill_tokens": m["prefill_tokens"],
+            "decode_tokens": m["decode_tokens"],
+            "prefill_tokens_per_sec": round(m["prefill_tokens"] / secs, 1),
+            "decode_tokens_per_sec": round(m["decode_tokens"] / secs, 1),
+            "mean_decode_occupancy": round(
+                m["occupancy_sum"] / max(m["decode_dispatches"], 1), 3),
+            "peak_kv_page_utilization": round(
+                m["peak_pages_in_use"] / (self.cache.num_pages - 1), 3),
+            "scheduler_seconds": round(m["run_seconds"], 3),
         }
 
     def _pick_kernel(self) -> bool:
@@ -112,6 +133,7 @@ class ContinuousScheduler:
     # ----------------------------------------------------------- public API
 
     def run(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+        t_run = time.time()
         queue: deque[tuple[GenerationRequest, list[int], int]] = deque()
         for req in requests:
             ids, max_new = self._encode(req)
@@ -161,7 +183,9 @@ class ContinuousScheduler:
                 temps[b] = req.temperature
                 top_k[b] = req.top_k
                 top_p[b] = min(max(req.top_p, 0.0), 1.0)
-                in_use = self.cache.num_pages - self.cache.allocator.free_count
+                # usable pages only: the reserved null page is neither
+                # allocatable nor counted, so utilization can reach 0 and 1
+                in_use = usable_pages - self.cache.allocator.free_count
                 self.metrics["peak_pages_in_use"] = max(
                     self.metrics["peak_pages_in_use"], in_use)
 
@@ -200,6 +224,7 @@ class ContinuousScheduler:
                 self.metrics["decode_tokens"] += valid
                 self._maybe_finish(b, slots, results, active)
 
+        self.metrics["run_seconds"] += time.time() - t_run
         return [results[r.request_id] for r in requests]
 
     # ------------------------------------------------------------ internals

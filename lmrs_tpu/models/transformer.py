@@ -198,6 +198,7 @@ def forward(
     attn_fn=None,  # optional (q, k, v, positions) -> out override (e.g. ring
                    # attention for sequence-parallel training; cache-less only)
     return_aux: bool = False,  # also return the layer-mean MoE aux loss
+    remat: bool = False,  # rematerialize each layer in the backward pass
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray] | None] | tuple[jnp.ndarray, Any, jnp.ndarray]:
     """Forward pass; returns (logits [B,S,V] f32, updated cache), plus the
     layer-mean MoE load-balance loss as a third element when ``return_aux``.
@@ -246,10 +247,19 @@ def forward(
         new_cache = {"k": new_k, "v": new_v}
         aux = jnp.float32(0.0)
     else:
+        def one_layer(lp, x):
+            return decoder_layer(lp, cfg, x, positions, sin, cos,
+                                 attn_fn, kv_length)
+
+        if remat:
+            # Trade FLOPs for HBM: save only each layer's input activation,
+            # recompute the rest in backward — activation memory drops from
+            # O(L * per-layer intermediates) to O(L * [B,S,D]).
+            one_layer = jax.checkpoint(one_layer)
+
         def layer_fn_nocache(carry, lp):
             x, aux = carry
-            x, layer_aux = decoder_layer(lp, cfg, x, positions, sin, cos,
-                                         attn_fn, kv_length)
+            x, layer_aux = one_layer(lp, x)
             return (x, aux + layer_aux), None
 
         (x, aux), _ = jax.lax.scan(

@@ -202,6 +202,9 @@ class TranscriptSummarizer:
             "hierarchical": agg["hierarchical"],
             "reduce_levels": agg["levels"],
             "stage_times": timer.report(),
+            # cumulative over this summarizer's lifetime, like the token
+            # counters below (reference reuses its executor the same way)
+            "engine_metrics": self.executor.engine.engine_metrics(),
             **self.executor.stats(),
         }
         logger.info(

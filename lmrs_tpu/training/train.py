@@ -24,12 +24,12 @@ from lmrs_tpu.parallel.sharding import batch_spec, param_shardings
 
 def causal_lm_loss(params: Any, cfg: ModelConfig, tokens: jnp.ndarray,
                    loss_mask: jnp.ndarray | None = None,
-                   attn_fn=None) -> jnp.ndarray:
+                   attn_fn=None, remat: bool = False) -> jnp.ndarray:
     """Next-token cross-entropy in f32.  tokens [B, S]; predicts tokens[:,1:]."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     logits, _, aux = forward(params, cfg, tokens, positions, attn_fn=attn_fn,
-                             return_aux=True)  # [B,S,V] f32
+                             return_aux=True, remat=remat)  # [B,S,V] f32
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -49,12 +49,15 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     mesh: Mesh | None = None,
     seq_sharded: bool = False,
+    remat: bool = False,
 ):
     """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
     step.  With a mesh: params tensor-parallel, batch over dp; when
     seq_sharded the sequence axis shards over sp and attention runs as a
     ring (parallel.ring_attention) — K/V blocks rotate over ICI instead of
-    XLA all-gathering the whole sequence onto every sp shard."""
+    XLA all-gathering the whole sequence onto every sp shard.  ``remat``
+    rematerializes each decoder layer in backward (jax.checkpoint), cutting
+    activation HBM to one [B,S,D] residual per layer for long sequences."""
 
     attn_fn = None
     if mesh is not None and seq_sharded:
@@ -65,7 +68,7 @@ def make_train_step(
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(causal_lm_loss)(
-            params, cfg, tokens, attn_fn=attn_fn)
+            params, cfg, tokens, attn_fn=attn_fn, remat=remat)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

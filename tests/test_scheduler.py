@@ -115,3 +115,23 @@ def test_single_slot_serializes():
     out = eng.generate_batch(reqs)
     assert [r.request_id for r in out] == [0, 1, 2]
     assert all(r.error is None for r in out)
+
+
+def test_engine_metrics_report(cont_engine):
+    """engine_metrics() exposes derived serving metrics with sane ranges."""
+    reqs = [GenerationRequest(prompt="metrics probe", request_id=0,
+                              max_new_tokens=6)]
+    cont_engine.generate_batch(reqs)
+    em = cont_engine.engine_metrics()
+    assert em["prefill_tokens"] > 0 and em["decode_tokens"] > 0
+    assert em["prefill_tokens_per_sec"] > 0
+    assert em["decode_tokens_per_sec"] > 0
+    assert 0.0 < em["mean_decode_occupancy"] <= 1.0
+    assert 0.0 < em["peak_kv_page_utilization"] <= 1.0
+    assert em["scheduler_seconds"] > 0
+
+
+def test_mock_engine_metrics_empty():
+    from lmrs_tpu.engine.mock import MockEngine
+
+    assert MockEngine().engine_metrics() == {}
