@@ -55,15 +55,21 @@ def main() -> int:
     # ~45M-param byte-vocab decoder: big enough that prefill rides the MXU,
     # small enough to compile fast.  Random weights (no egress for real
     # checkpoints) — throughput-identical to a trained model of this shape.
+    # head_dim 128 engages the ragged Pallas decode kernel on TPU.
     model = ModelConfig(
-        name="bench-45m", vocab_size=512, dim=512, n_layers=8, n_heads=8,
-        n_kv_heads=8, hidden_dim=1536, max_seq_len=4096, dtype="bfloat16",
+        name="bench-45m", vocab_size=512, dim=512, n_layers=8, n_heads=4,
+        n_kv_heads=4, hidden_dim=1536, max_seq_len=4096, dtype="bfloat16",
     )
     cfg = PipelineConfig(
         chunk=ChunkConfig(max_tokens_per_chunk=2048, context_tokens=150,
                           overlap_tokens=0, tokenizer="byte"),
+        # decode_block/prefill_chunk sized for high-latency host links
+        # (~250 ms/round-trip on tunneled chips): fewer, bigger dispatches,
+        # and prefill_chunk > max prompt so every prefill is one fresh
+        # flash-attention dispatch (no window-gather continuation path)
         engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=8,
-                            retry_delay=0.0, seed=0),
+                            retry_delay=0.0, seed=0,
+                            decode_block=64, prefill_chunk=4096),
         model=model,
         reduce=ReduceConfig(max_tokens_per_batch=6000),
     )

@@ -81,11 +81,18 @@ class ModelConfig:
     # Gemma-style differences
     logit_softcap: float | None = None
     embed_scale: bool = False  # Gemma multiplies embeddings by sqrt(dim)
+    head_dim: int | None = None  # explicit per-head dim (Gemma-7B: 256 != dim/heads)
+    activation: str = "silu"  # FFN gate activation: "silu" (Llama) | "gelu" (Gemma)
     # Mixture-of-experts (0 experts = dense FFN; ops/moe.py)
     n_experts: int = 0
     n_experts_per_token: int = 2
     expert_capacity_factor: float = 1.25
     router_aux_coef: float = 0.01  # load-balance loss weight in training
+
+    @property
+    def hd(self) -> int:
+        """Per-head dimension; ``head_dim`` overrides the dim/n_heads default."""
+        return self.head_dim or self.dim // self.n_heads
 
 
 @dataclass
@@ -135,6 +142,7 @@ class EngineConfig:
     page_size: int = 128
     num_pages: int = 512
     prefill_chunk: int = 512
+    decode_block: int = 16  # decode steps per host sync (see scheduler)
     checkpoint_path: str | None = None
     quantize: str | None = None  # None | "int8" (weight-only; ops/quant.py)
 
@@ -187,7 +195,8 @@ def model_preset(name: str) -> ModelConfig:
     presets: dict[str, dict] = {
         "tiny": {},
         "tiny-gemma": dict(
-            logit_softcap=30.0, embed_scale=True, rope_theta=10000.0, tie_embeddings=True
+            logit_softcap=30.0, embed_scale=True, rope_theta=10000.0,
+            tie_embeddings=True, activation="gelu", norm_eps=1e-6,
         ),
         "llama3-8b": dict(
             vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
@@ -202,12 +211,14 @@ def model_preset(name: str) -> ModelConfig:
         "gemma-2b": dict(
             vocab_size=256128, dim=2048, n_layers=18, n_heads=8, n_kv_heads=1,
             hidden_dim=16384, max_seq_len=8192, rope_theta=10000.0,
-            tie_embeddings=True, embed_scale=True,
+            tie_embeddings=True, embed_scale=True, head_dim=256,
+            activation="gelu", norm_eps=1e-6,
         ),
         "gemma-7b": dict(
             vocab_size=256128, dim=3072, n_layers=28, n_heads=16, n_kv_heads=16,
             hidden_dim=24576, max_seq_len=8192, rope_theta=10000.0,
-            tie_embeddings=True, embed_scale=True,
+            tie_embeddings=True, embed_scale=True, head_dim=256,  # != dim/heads
+            activation="gelu", norm_eps=1e-6,
         ),
         "tiny-moe": dict(
             hidden_dim=512, n_experts=4, n_experts_per_token=2,

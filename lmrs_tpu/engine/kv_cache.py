@@ -101,7 +101,7 @@ class PagedKVCache:
 
     def __init__(self, model_cfg: ModelConfig, num_pages: int, page_size: int,
                  max_pages_per_slot: int, allocator: PageAllocator | None = None):
-        hd = model_cfg.dim // model_cfg.n_heads
+        hd = model_cfg.hd
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_pages_per_slot = max_pages_per_slot

@@ -79,7 +79,11 @@ class ContinuousScheduler:
         self.tokenizer = tokenizer
         self.B = max(1, engine_cfg.max_batch_slots)
         self.max_len = model_cfg.max_seq_len
-        self.decode_block = 8
+        # decode steps per dispatch: the host syncs once per block, so on
+        # high-latency links (tunneled chips, remote hosts) a bigger block
+        # amortizes the round trip; overshoot past a slot's budget is
+        # trimmed in _maybe_finish and its pages are pre-reserved in admit()
+        self.decode_block = max(1, engine_cfg.decode_block)
         self.prefill_chunk = max(64, engine_cfg.prefill_chunk)
         ps = engine_cfg.page_size
         max_pages_per_slot = -(-self.max_len // ps)
@@ -88,10 +92,12 @@ class ContinuousScheduler:
         num_pages = max(engine_cfg.num_pages, self.B * max_pages_per_slot + 1)
         self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot)
         self._use_ragged = self._pick_kernel()
+        self._use_flash = True  # flash prefill; cleared if lowering fails
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[int, object] = {}
+        self._ran_ok: set = set()  # fn-cache keys that have executed once
         # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
         self.metrics = {
             "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
@@ -120,14 +126,11 @@ class ContinuousScheduler:
         }
 
     def _pick_kernel(self) -> bool:
+        from lmrs_tpu.utils.platform import on_tpu
+
         if self.cfg.scheduler == "continuous":
-            try:
-                platform = jax.devices()[0].platform
-            except Exception:
-                platform = "cpu"
-            hd = self.model_cfg.dim // self.model_cfg.n_heads
             # ragged kernel wants MXU-friendly head_dim and a TPU backend
-            return platform not in ("cpu", "gpu") and hd % 128 == 0
+            return on_tpu() and self.model_cfg.hd % 128 == 0
         return False
 
     # ----------------------------------------------------------- public API
@@ -192,20 +195,20 @@ class ContinuousScheduler:
         while queue or any(s is not None for s in slots):
             admit()
             # advance every prefilling slot by ONE prompt chunk, then give
-            # decode a turn — long prompts never monopolize the device
-            for b in range(self.B):
+            # decode a turn — long prompts never monopolize the device.
+            # Same-shape chunks batch into one dispatch (a [N,S] prefill
+            # feeds the MXU far better than N serialized [1,S] programs),
+            # and all first tokens come back in ONE device_get: each extra
+            # host-link round trip costs a full RTT.
+            for b, tok0 in self._advance_prefills(slots):
                 st = slots[b]
-                if st is None or st.phase != "prefill":
-                    continue
-                tok0 = self._prefill_step(st)
-                if tok0 is not None:  # prompt complete; first token sampled
-                    st.phase = "decode"
-                    st.kv_len = len(st.prompt_ids)
-                    st.generated.append(tok0)
-                    last_tok[b] = tok0
-                    kv_lens[b] = st.kv_len
-                    active[b] = True
-                    self._maybe_finish(b, slots, results, active)
+                st.phase = "decode"
+                st.kv_len = len(st.prompt_ids)
+                st.generated.append(tok0)
+                last_tok[b] = tok0
+                kv_lens[b] = st.kv_len
+                active[b] = True
+                self._maybe_finish(b, slots, results, active)
             if not any(active):
                 continue
             self.metrics["occupancy_sum"] += float(np.mean(active))
@@ -272,53 +275,106 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------- prefill
 
-    def _prefill_step(self, st: _SlotState) -> int | None:
-        """Advance one prompt chunk; returns the sampled first token when the
-        whole prompt is in KV, else None.
+    def _advance_prefills(self, slots) -> list[tuple[int, int]]:
+        """Advance every prefilling slot by one prompt chunk and return
+        [(slot, first_token)] for the slots whose whole prompt is now in KV.
 
         Prompts that fit one chunk take the fresh-prefill program (attends
         the chunk directly); longer prompts run the windowed continuation
         program per chunk (attends the page window, which includes earlier
-        chunks' KV).
+        chunks' KV).  Chunks with the same (program, bucket) run as ONE
+        batched dispatch; the batch dim is either 1 or B (padded) so each
+        shape compiles at most twice — XLA compiles are seconds-long and a
+        per-group-size shape zoo would thrash the cache at runtime.
         """
-        ids = st.prompt_ids
-        pos = st.prefill_pos
-        chunk = ids[pos: pos + self.prefill_chunk]
-        is_final = pos + len(chunk) >= len(ids)
-        fresh = pos == 0 and is_final  # whole prompt in one dispatch
-        s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
-        table = self.cache.page_table_array([st.seq])
-        if fresh:
-            fn = self._get_prefill_fn(s_bucket)
-        else:
-            need_pages = self.cache.pages_needed(pos + len(chunk))
-            w = min(_pow2_bucket(need_pages, 4), self.cache.max_pages_per_slot)
-            fn = self._get_prefill_window_fn(s_bucket, w)
-            table = table[:, :w]
-        tokens = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
-        tokens[0, : len(chunk)] = chunk
-        alloc_tokens = st.seq.capacity(self.cache.page_size)
-        self._key, sub = jax.random.split(self._key)
-        tok0, self.cache.k, self.cache.v = fn(
-            self.params, self.cache.k, self.cache.v,
-            jnp.asarray(tokens),
-            jnp.asarray([pos], jnp.int32),
-            jnp.asarray([len(chunk)], jnp.int32),
-            jnp.asarray([alloc_tokens], jnp.int32),
-            jnp.asarray(table), sub,
-            jnp.asarray([st.req.temperature], np.float32),
-            jnp.asarray([st.req.top_k], np.int32),
-            jnp.asarray([min(max(st.req.top_p, 0.0), 1.0)], np.float32),
-        )
-        st.prefill_pos = pos + len(chunk)
-        self.metrics["prefill_tokens"] += len(chunk)
-        return int(tok0[0]) if is_final else None
+        groups: dict[tuple, list] = {}
+        for b in range(self.B):
+            st = slots[b]
+            if st is None or st.phase != "prefill":
+                continue
+            ids = st.prompt_ids
+            pos = st.prefill_pos
+            chunk = ids[pos: pos + self.prefill_chunk]
+            is_final = pos + len(chunk) >= len(ids)
+            fresh = pos == 0 and is_final  # whole prompt in one dispatch
+            s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
+            if fresh:
+                w = self.cache.max_pages_per_slot
+            else:
+                need_pages = self.cache.pages_needed(pos + len(chunk))
+                w = min(_pow2_bucket(need_pages, 4), self.cache.max_pages_per_slot)
+            groups.setdefault((fresh, s_bucket, w), []).append(
+                (b, st, chunk, pos, is_final))
+
+        # dispatch each group (async), collecting unfetched [N] token arrays
+        pending: list[tuple[object, list[tuple[int, int]]]] = []
+        for (fresh, s_bucket, w), items in groups.items():
+            n = 1 if len(items) == 1 else self.B
+            tokens = np.full((n, s_bucket), self.tokenizer.pad_id, np.int32)
+            start = np.zeros((n,), np.int32)
+            length = np.ones((n,), np.int32)  # pad rows: 1 token on the null page
+            alloc = np.full((n,), self.cache.page_size, np.int32)
+            table = np.zeros((n, self.cache.max_pages_per_slot), np.int32)
+            temps = np.ones((n,), np.float32)
+            tks = np.zeros((n,), np.int32)
+            tps = np.ones((n,), np.float32)
+            table[: len(items)] = self.cache.page_table_array(
+                [st.seq for _, st, _, _, _ in items])
+            for row, (b, st, chunk, pos, _) in enumerate(items):
+                tokens[row, : len(chunk)] = chunk
+                start[row] = pos
+                length[row] = len(chunk)
+                alloc[row] = st.seq.capacity(self.cache.page_size)
+                temps[row] = st.req.temperature
+                tks[row] = st.req.top_k
+                tps[row] = min(max(st.req.top_p, 0.0), 1.0)
+                st.prefill_pos = pos + len(chunk)
+                self.metrics["prefill_tokens"] += len(chunk)
+            self._key, sub = jax.random.split(self._key)
+            args = (
+                self.params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(start), jnp.asarray(length),
+                jnp.asarray(alloc), jnp.asarray(table[:, :w]), sub,
+                jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
+            )
+            key_ = ("prefill", fresh, s_bucket, w)
+            try:
+                fn = (self._get_prefill_fn(s_bucket) if fresh
+                      else self._get_prefill_window_fn(s_bucket, w))
+                tok0, self.cache.k, self.cache.v = fn(*args)
+            except Exception:
+                # compile-time lowering failure of the flash prefill kernel:
+                # rebuild without it and retry (cache buffers were not yet
+                # donated — donation happens at execution).  Anything after a
+                # successful run of this shape is a real error: re-raise.
+                if not self._use_flash or key_ in self._ran_ok:
+                    raise
+                logger.warning("flash prefill kernel failed to lower; "
+                               "falling back to XLA attention", exc_info=True)
+                self._use_flash = False
+                self._prefill_fns.clear()
+                self._prefill_window_fns.clear()
+                fn = (self._get_prefill_fn(s_bucket) if fresh
+                      else self._get_prefill_window_fn(s_bucket, w))
+                tok0, self.cache.k, self.cache.v = fn(*args)
+            self._ran_ok.add(key_)
+            rows = [(b, row) for row, (b, _, _, _, is_final) in enumerate(items)
+                    if is_final]
+            if rows:
+                pending.append((tok0, rows))
+
+        if not pending:
+            return []
+        fetched = jax.device_get([t for t, _ in pending])  # one transfer
+        return [(b, int(t0[row])) for t0, (_, rows) in zip(fetched, pending)
+                for b, row in rows]
 
     def _get_prefill_fn(self, s_bucket: int):
         if s_bucket in self._prefill_fns:
             return self._prefill_fns[s_bucket]
         cfg = self.model_cfg
         rope_max = self.max_len
+        use_flash = self._use_flash  # captured: rebuilt fns see the fallback
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill(params, k_pages, v_pages, tokens, start, length,
@@ -332,13 +388,14 @@ class ContinuousScheduler:
             write_pos = jnp.minimum(positions, alloc_tokens[:, None] - 1)
             logits, k_pages, v_pages = forward_paged(
                 params, cfg, tokens, write_pos, k_pages, v_pages, table,
-                length, rope_max, use_ragged_kernel=False,
+                length, rope_max, use_ragged_kernel=False, use_flash=use_flash,
             )
             last = jnp.take_along_axis(logits, (length - 1)[:, None, None], axis=1)[:, 0]
             tok0 = sample_logits(last, key, temp, tk, tp)
             return tok0, k_pages, v_pages
 
-        logger.info("compiling paged prefill: bucket=%d", s_bucket)
+        logger.info("compiling paged prefill: bucket=%d (flash=%s)",
+                    s_bucket, use_flash)
         self._prefill_fns[s_bucket] = prefill
         return prefill
 
@@ -388,17 +445,33 @@ class ContinuousScheduler:
                 need = self.cache.pages_needed(st.kv_len + self.decode_block)
                 max_pages = max(max_pages, need)
         w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
-        fn = self._get_decode_fn(w)
         table = self.cache.page_table_array(decode_seqs)
         self._key, sub = jax.random.split(self._key)
-        toks, n_valid, self.cache.k, self.cache.v = fn(
+        args = (
             self.params, self.cache.k, self.cache.v,
             jnp.asarray(last_tok), jnp.asarray(kv_lens),
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
-        return (np.asarray(jax.device_get(toks)),
-                np.asarray(jax.device_get(n_valid)))
+        try:
+            out = self._get_decode_fn(w)(*args)
+        except Exception:
+            # Only degrade on a compile-time lowering failure of the ragged
+            # kernel (first call of this window shape — donation happens at
+            # execution, so args are still valid).  A failure after a shape
+            # has run successfully is a real runtime error: re-raise rather
+            # than retrying against possibly-donated buffers.
+            if not self._use_ragged or ("decode", w) in self._ran_ok:
+                raise
+            logger.warning("ragged decode kernel failed to lower; "
+                           "falling back to XLA paged decode", exc_info=True)
+            self._use_ragged = False
+            self._decode_fns.clear()
+            out = self._get_decode_fn(w)(*args)
+        self._ran_ok.add(("decode", w))
+        toks, n_valid, self.cache.k, self.cache.v = out
+        toks, n_valid = jax.device_get((toks, n_valid))  # one transfer
+        return np.asarray(toks), np.asarray(n_valid)
 
     def _get_decode_fn(self, w: int):
         if w in self._decode_fns:

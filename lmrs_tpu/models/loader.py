@@ -65,7 +65,7 @@ def load_checkpoint(path: str, model_cfg: ModelConfig, mesh=None) -> Any:
 # ------------------------------------------------- HF safetensors conversion
 
 
-def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
+def convert_hf_llama(src_dir: str, cfg: ModelConfig, *, norm_offset: float = 1.0) -> Any:
     """Convert a local HF Llama-style checkpoint into the stacked layout.
 
     Expects ``model*.safetensors`` files in ``src_dir``.  HF per-layer names
@@ -84,8 +84,10 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
         model.layers.{i}.block_sparse_moe.experts.{j}.w3.weight -> moe.w_up[i,j]
         model.layers.{i}.block_sparse_moe.experts.{j}.w2.weight -> moe.w_down[i,j]
 
-    HF stores projections as [out, in]; we store [in, out] (+ head split),
-    and HF RMSNorm weights are ``w`` where we use ``1 + scale``.
+    HF stores projections as [out, in]; we store [in, out] (+ head split).
+    ``norm_offset``: our RMSNorm multiplies by ``1 + scale``; HF Llama
+    multiplies by ``w`` (offset 1.0 -> scale = w - 1), HF Gemma already by
+    ``1 + w`` (offset 0.0 -> scale = w; see ``convert_hf_gemma``).
     """
     import json as _json
 
@@ -107,8 +109,9 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
             for name in fh.keys():
                 tensors[name] = fh.get_tensor(name)
 
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     L = cfg.n_layers
+    off = np.float32(norm_offset)
     dt = np.dtype(np.float32) if cfg.dtype == "float32" else np.dtype("bfloat16")
 
     def get(name):
@@ -150,9 +153,9 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
         "embed": {"weight": get("model.embed_tokens.weight").astype(dt)},
         "layers": {
             "ln_attn": {"scale": stack(
-                "model.layers.{i}.input_layernorm.weight", lambda w: w - 1.0)},
+                "model.layers.{i}.input_layernorm.weight", lambda w: w - off)},
             "ln_mlp": {"scale": stack(
-                "model.layers.{i}.post_attention_layernorm.weight", lambda w: w - 1.0)},
+                "model.layers.{i}.post_attention_layernorm.weight", lambda w: w - off)},
             "attn": {
                 "wq": stack("model.layers.{i}.self_attn.q_proj.weight",
                             lambda w: w.T.reshape(cfg.dim, cfg.n_heads, hd)),
@@ -165,10 +168,21 @@ def convert_hf_llama(src_dir: str, cfg: ModelConfig) -> Any:
             },
             **ffn,
         },
-        "final_norm": {"scale": (get("model.norm.weight") - 1.0).astype(dt)},
+        "final_norm": {"scale": (get("model.norm.weight") - off).astype(dt)},
     }
     if not cfg.tie_embeddings:
         head = tensors.get("lm_head.weight", tensors["model.embed_tokens.weight"])
         params["lm_head"] = {"weight": head.T.astype(dt)}
     logger.info("converted HF checkpoint %s (%d tensors)", src_dir, len(tensors))
     return jax.tree.map(lambda x: jax.numpy.asarray(x), params)
+
+
+def convert_hf_gemma(src_dir: str, cfg: ModelConfig) -> Any:
+    """Convert a local HF Gemma checkpoint (same tensor names as Llama, but
+    HF GemmaRMSNorm already multiplies by ``1 + w`` — our parameterization —
+    so norm weights pass through unshifted; embeddings are always tied, and
+    ``cfg`` should carry Gemma's explicit head_dim / gelu activation /
+    embed_scale (see the gemma presets in config.py)."""
+    if not cfg.tie_embeddings:
+        raise ValueError("Gemma checkpoints tie lm_head to the embedding")
+    return convert_hf_llama(src_dir, cfg, norm_offset=0.0)

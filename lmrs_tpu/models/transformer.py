@@ -43,7 +43,7 @@ def _dtype(cfg: ModelConfig):
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Random-init params (truncated-normal fan-in scaling), stacked layers."""
     dt = _dtype(cfg)
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     k_embed, k_layers, k_head = jax.random.split(key, 3)
 
     def tn(key, shape, fan_in):
@@ -96,14 +96,25 @@ def param_count(params: Params) -> int:
 
 def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int) -> dict[str, jnp.ndarray]:
     """Dense per-slot KV cache [L, B, S, K, hd] (paged cache: engine/kv_cache)."""
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, hd)
     dt = _dtype(cfg)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def gate_act(cfg: ModelConfig, gate: jnp.ndarray) -> jnp.ndarray:
+    """Gated-FFN activation in f32: SiLU (Llama SwiGLU) or tanh-approximate
+    GELU (Gemma GeGLU), selected by ``cfg.activation``."""
+    gf = gate.astype(jnp.float32)
+    if cfg.activation == "gelu":
+        return jax.nn.gelu(gf, approximate=True)
+    if cfg.activation == "silu":
+        return jax.nn.silu(gf)
+    raise ValueError(f"unknown activation {cfg.activation!r}; silu|gelu")
+
+
 def ffn_block(lp: Params, cfg: ModelConfig, h: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Post-norm FFN body: dense SwiGLU or MoE.  h [B,S,D] (already normed)
+    """Post-norm FFN body: dense gated FFN or MoE.  h [B,S,D] (already normed)
     -> (out [B,S,D], aux f32 scalar — the MoE load-balance loss, 0 for dense)."""
     if cfg.n_experts:
         from lmrs_tpu.ops.moe import moe_mlp
@@ -112,13 +123,13 @@ def ffn_block(lp: Params, cfg: ModelConfig, h: jnp.ndarray) -> tuple[jnp.ndarray
     dt = h.dtype
     gate = jnp.einsum("bsd,df->bsf", h, deq(lp["mlp"]["w_gate"], dt))
     up = jnp.einsum("bsd,df->bsf", h, deq(lp["mlp"]["w_up"], dt))
-    ff = jax.nn.silu(gate.astype(jnp.float32)).astype(dt) * up
+    ff = gate_act(cfg, gate).astype(dt) * up
     return jnp.einsum("bsf,fd->bsd", ff, deq(lp["mlp"]["w_down"], dt)), jnp.float32(0.0)
 
 
 def qkv_proj(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
     """Project a normed [B,S,D] into (q [B,S,H,hd], k, v [B,S,K,hd])."""
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     dt = h.dtype
     q = jnp.einsum("bsd,dhk->bshk", h,
                    deq(lp["attn"]["wq"], dt).reshape(cfg.dim, cfg.n_heads, hd))
@@ -131,7 +142,7 @@ def qkv_proj(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
 
 def out_proj(lp: Params, cfg: ModelConfig, attn_out: jnp.ndarray) -> jnp.ndarray:
     """[B,S,H,hd] attention output back to [B,S,D]."""
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     wo = deq(lp["attn"]["wo"], attn_out.dtype).reshape(cfg.n_heads, hd, cfg.dim)
     return jnp.einsum("bshk,hkd->bsd", attn_out, wo)
 
@@ -216,7 +227,7 @@ def forward(
                          "pad-free batches only on the ring-attention path")
     dt = _dtype(cfg)
     b, s = tokens.shape
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     x = embed_tokens(params, cfg, tokens)  # [B,S,D]
 
     max_pos = cache["k"].shape[2] if cache is not None else s
@@ -273,6 +284,16 @@ def forward(
     return logits, new_cache
 
 
+def _use_flash_prefill(seq_len: int, hd: int) -> bool:
+    """Route fresh prefill through the Pallas flash kernel: TPU backend, a
+    sequence long enough that O(S²) logits materialization starts to matter,
+    and a lane-aligned head dim (validated on hardware for multiples of 64;
+    smaller head dims fail Mosaic lowering)."""
+    from lmrs_tpu.utils.platform import on_tpu
+
+    return seq_len >= 256 and hd % 64 == 0 and on_tpu()
+
+
 def forward_paged(
     params: Params,
     cfg: ModelConfig,
@@ -285,6 +306,7 @@ def forward_paged(
     rope_max: int,
     use_ragged_kernel: bool = False,
     window_prefill: bool = False,
+    use_flash: bool = True,  # allow the flash prefill kernel (when eligible)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -306,7 +328,7 @@ def forward_paged(
 
     dt = _dtype(cfg)
     b, s = tokens.shape
-    hd = cfg.dim // cfg.n_heads
+    hd = cfg.hd
     ps = k_pages.shape[3]
     x = params["embed"]["weight"][tokens]
     if cfg.embed_scale:
@@ -349,8 +371,16 @@ def forward_paged(
                 b, w * ps, cfg.n_kv_heads, hd)
             attn_out = attention(q, k_win, v_win, positions, kv_lens)
         else:
-            # fresh prefill: current tokens ARE the whole context
-            attn_out = attention(q, k, v, positions, kv_lens)
+            # fresh prefill: current tokens ARE the whole context.  Row i's
+            # position is i (scheduler fresh-prefill contract), which is
+            # exactly the flash kernel's implicit layout — use it on TPU for
+            # long chunks; XLA reference elsewhere.
+            if use_flash and _use_flash_prefill(s, hd):
+                from lmrs_tpu.ops.flash_attention import flash_attention
+
+                attn_out = flash_attention(q, k, v, kv_lens)
+            else:
+                attn_out = attention(q, k, v, positions, kv_lens)
         x = x + out_proj(lp, cfg, attn_out)
 
         h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)

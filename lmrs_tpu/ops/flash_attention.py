@@ -28,7 +28,7 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(
-    lengths_ref,  # SMEM [1] valid kv length for this batch row
+    lengths_ref,  # SMEM [B] valid kv length per batch row (unblocked)
     q_ref,        # VMEM [1, 1, QB, hd]
     k_ref,        # VMEM [1, 1, KB, hd]
     v_ref,        # VMEM [1, 1, KB, hd]
@@ -53,7 +53,7 @@ def _flash_kernel(
 
     q_start = qi * q_block
     k_start = ki * kv_block
-    length = lengths_ref[0]
+    length = lengths_ref[pl.program_id(0)]
 
     # A KV block is live iff some query row can see it: k_start <= last query
     # position, and it intersects the valid prefix.
@@ -145,8 +145,9 @@ def flash_attention(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda bi, hi, qi, ki: (bi,),
-                         memory_space=pltpu.SMEM),
+            # whole [B] array in SMEM (rank-1 blocking is restricted on real
+            # TPU lowering); the kernel indexes it by program_id(0)
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, q_block, hd),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, kv_block, hd),

@@ -76,7 +76,9 @@ def moe_mlp(mp, cfg: ModelConfig, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndar
     xin = jnp.einsum("nd,nec->ecd", xt, dispatch.astype(dt))
     gate_h = jnp.einsum("ecd,edf->ecf", xin, deq(mp["w_gate"], dt))
     up = jnp.einsum("ecd,edf->ecf", xin, deq(mp["w_up"], dt))
-    ff = jax.nn.silu(gate_h.astype(jnp.float32)).astype(dt) * up
+    from lmrs_tpu.models.transformer import gate_act
+
+    ff = gate_act(cfg, gate_h).astype(dt) * up
     y = jnp.einsum("ecf,efd->ecd", ff, deq(mp["w_down"], dt))
     out = jnp.einsum("nec,ecd->nd", combine.astype(dt), y)
 

@@ -139,24 +139,30 @@ def paged_decode_pallas(
     b, h, hd = q.shape
     kh, _, ps, _ = k_pages.shape
     n_rep = h // kh
-    # group query heads by kv head: [B, K, n_rep, hd]
+    # group query heads by kv head: [B, K, n_rep, hd].  The group dim is a
+    # Mosaic block sublane dim, so pad it to 8 rows (bf16/f32 tiling both
+    # divide 8; the MXU pads small dots to 8x128 anyway, so this is free) —
+    # n_rep=1 (MHA) would otherwise fail sublane alignment on real TPUs.
+    n_rep_p = -(-n_rep // 8) * 8
     qg = q.reshape(b, kh, n_rep, hd)
+    if n_rep_p != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, n_rep_p - n_rep), (0, 0)))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kh),
         in_specs=[
-            pl.BlockSpec((1, 1, n_rep, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, n_rep, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((ps, hd), k_pages.dtype),
             pltpu.VMEM((ps, hd), v_pages.dtype),
-            pltpu.VMEM((n_rep, hd), jnp.float32),
-            pltpu.VMEM((n_rep, 128), jnp.float32),
-            pltpu.VMEM((n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_rep_p, hd), jnp.float32),
+            pltpu.VMEM((n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
@@ -174,7 +180,7 @@ def paged_decode_pallas(
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, n_rep, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, n_rep_p, hd), q.dtype),
         interpret=interpret,
     )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, k_pages, v_pages)
-    return out.reshape(b, h, hd)
+    return out[:, :, :n_rep].reshape(b, h, hd)

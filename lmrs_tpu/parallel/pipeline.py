@@ -98,7 +98,7 @@ def pipeline_causal_lm_loss(
         mb = b // m
         micro = tok.reshape(m, mb, s)
         positions = jnp.broadcast_to(jnp.arange(s)[None], (mb, s))
-        hd = cfg.dim // cfg.n_heads
+        hd = cfg.hd
         sin, cos = rope_table(s, hd, cfg.rope_theta)
 
         x_in = jax.vmap(lambda t: embed_tokens(sp, cfg, t))(micro)  # [M,mb,S,D]

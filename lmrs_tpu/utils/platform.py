@@ -1,0 +1,16 @@
+"""Accelerator platform probe shared by the kernel-routing gates
+(scheduler._pick_kernel, transformer._use_flash_prefill)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def on_tpu() -> bool:
+    """True when the default JAX backend is a TPU-family device (anything
+    that is not the cpu/gpu XLA backends — covers tpu and tunneled variants)."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    return platform not in ("cpu", "gpu")

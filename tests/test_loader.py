@@ -136,3 +136,69 @@ def test_convert_hf_mixtral_moe(tmp_path):
 def test_convert_hf_missing_files_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         convert_hf_llama(str(tmp_path), _cfg())
+
+
+def test_convert_hf_gemma(tmp_path):
+    """Gemma conversion: unshifted norm weights, tied embeddings, explicit
+    head_dim != dim/n_heads."""
+    from lmrs_tpu.models.loader import convert_hf_gemma
+
+    cfg = _cfg(tie_embeddings=True, head_dim=16, activation="gelu",
+               norm_eps=1e-6, embed_scale=True)
+    assert cfg.hd == 16 and cfg.hd != cfg.dim // cfg.n_heads
+    rng = np.random.default_rng(2)
+    t = _hf_dense_tensors(cfg, rng)
+    del t["lm_head.weight"]  # tied
+    # Gemma norm weights: stored w, applied as (1 + w) — give them a
+    # recognizable non-trivial value to pin the no-offset conversion
+    for k in list(t):
+        if k.endswith("norm.weight"):
+            t[k] = np.full_like(t[k], 0.25)
+    # head_dim-sized projections
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}.self_attn"
+        t[f"{p}.q_proj.weight"] = rng.normal(size=(cfg.n_heads * 16, cfg.dim)).astype(np.float32)
+        t[f"{p}.k_proj.weight"] = rng.normal(size=(cfg.n_kv_heads * 16, cfg.dim)).astype(np.float32)
+        t[f"{p}.v_proj.weight"] = rng.normal(size=(cfg.n_kv_heads * 16, cfg.dim)).astype(np.float32)
+        t[f"{p}.o_proj.weight"] = rng.normal(size=(cfg.dim, cfg.n_heads * 16)).astype(np.float32)
+    _write_safetensors(tmp_path / "model.safetensors", t)
+
+    params = convert_hf_gemma(str(tmp_path), cfg)
+    want = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    assert jax.tree.structure(params) == jax.tree.structure(want)
+    assert "lm_head" not in params
+    assert params["layers"]["attn"]["wq"].shape == (
+        cfg.n_layers, cfg.dim, cfg.n_heads, 16)
+    # no -1 shift: scale == stored weight
+    np.testing.assert_allclose(
+        np.asarray(params["final_norm"]["scale"]), 0.25, rtol=1e-6)
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8)[None], (1, 8))
+    logits, _ = forward(params, cfg, tokens, pos)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_convert_hf_gemma_rejects_untied(tmp_path):
+    from lmrs_tpu.models.loader import convert_hf_gemma
+
+    with pytest.raises(ValueError, match="tie"):
+        convert_hf_gemma(str(tmp_path), _cfg(tie_embeddings=False))
+
+
+def test_gelu_activation_forward():
+    """activation="gelu" changes the FFN (and runs finite); bad names raise."""
+    cfg_s = _cfg(tie_embeddings=True)
+    cfg_g = _cfg(tie_embeddings=True, activation="gelu")
+    params = init_params(cfg_s, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (1, 8)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8)[None], (1, 8))
+    l_s, _ = forward(params, cfg_s, tokens, pos)
+    l_g, _ = forward(params, cfg_g, tokens, pos)
+    assert np.isfinite(np.asarray(l_g)).all()
+    assert np.abs(np.asarray(l_s) - np.asarray(l_g)).max() > 1e-6
+
+    import dataclasses
+    cfg_bad = dataclasses.replace(cfg_s, activation="relu")
+    with pytest.raises(ValueError, match="activation"):
+        forward(params, cfg_bad, tokens, pos)

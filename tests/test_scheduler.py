@@ -135,3 +135,23 @@ def test_mock_engine_metrics_empty():
     from lmrs_tpu.engine.mock import MockEngine
 
     assert MockEngine().engine_metrics() == {}
+
+
+def test_ragged_kernel_failure_degrades_to_xla(cont_engine):
+    """If the ragged Pallas kernel can't lower on this platform, the decode
+    dispatch must fall back to the XLA gather path, not fail the batch."""
+    sched = cont_engine._scheduler
+    sched._use_ragged = True  # force the kernel on CPU, where it can't lower
+    sched._decode_fns.clear()
+    # drop run-history: the fallback (correctly) only triggers on shapes that
+    # have never executed — a failure on a proven shape re-raises
+    sched._ran_ok = {k for k in sched._ran_ok if k[0] != "decode"}
+    try:
+        out = cont_engine.generate_batch(
+            [GenerationRequest(prompt="fallback probe", request_id=0,
+                               max_new_tokens=4)])
+    finally:
+        sched._use_ragged = False
+        sched._decode_fns.clear()
+    assert out[0].error is None
+    assert out[0].completion_tokens > 0
