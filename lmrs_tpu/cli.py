@@ -68,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true", help="emit jax.profiler spans")
     p.add_argument("--quantize", default=None, choices=["int8"],
                    help="weight-only quantization for the jax backend")
+    p.add_argument("--speculate-k", type=int, default=None,
+                   help="prompt-lookup speculative decoding draft length "
+                        "(0 = off; output distribution is unchanged)")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
@@ -87,6 +90,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, max_concurrent_requests=args.max_concurrent_requests)
     if args.quantize:
         engine = dataclasses.replace(engine, quantize=args.quantize)
+    if args.speculate_k is not None:
+        engine = dataclasses.replace(engine, speculate_k=args.speculate_k)
     return PipelineConfig(
         data=DataConfig(
             merge_same_speaker=not args.no_merge,

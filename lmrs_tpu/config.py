@@ -143,6 +143,10 @@ class EngineConfig:
     num_pages: int = 512
     prefill_chunk: int = 512
     decode_block: int = 16  # decode steps per host sync (see scheduler)
+    # prompt-lookup speculative decoding: draft length per step (0 = off).
+    # Exact-distribution verify (ops/speculative.py) — output quality is
+    # unchanged; latency drops when summaries quote their source.
+    speculate_k: int = 0
     checkpoint_path: str | None = None
     quantize: str | None = None  # None | "int8" (weight-only; ops/quant.py)
 

@@ -69,6 +69,15 @@ class Engine(Protocol):
         backend has none (SURVEY.md §5.5 'new build' obligation)."""
         ...
 
+    # Optional attribute contract (checked via getattr, absent == False):
+    # ``schedules_internally: bool`` — True when the backend runs its own
+    # admission control (continuous batching); the executor then submits its
+    # whole queue in one call instead of fixed concurrency waves, so batch
+    # slots never sit idle waiting on a wave barrier.  Deliberately NOT a
+    # Protocol data member: runtime_checkable isinstance would then require
+    # it on every implementation, and a Protocol class default is not
+    # inherited structurally anyway.
+
 
 def make_engine(
     engine_cfg: "EngineConfig",

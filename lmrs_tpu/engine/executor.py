@@ -97,8 +97,16 @@ class MapExecutor:
     # ----------------------------------------------------- request plumbing
 
     def run_requests(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
-        """Admission-controlled waves + retry/requeue + accounting."""
-        wave = max(1, self.config.max_concurrent_requests)
+        """Admission-controlled waves + retry/requeue + accounting.
+
+        Engines with their own admission control (continuous batching) get
+        the whole queue at once — the wave cap is the semaphore analog for
+        engines that lack one (mock, static), and a barrier between waves
+        would leave the continuous scheduler's slots draining idle."""
+        if getattr(self.engine, "schedules_internally", False):
+            wave = max(1, len(requests))
+        else:
+            wave = max(1, self.config.max_concurrent_requests)
         done: dict[int, GenerationResult] = {}
         pending = list(requests)
         attempt = 1

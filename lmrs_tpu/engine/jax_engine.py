@@ -93,12 +93,15 @@ class JaxEngine:
         self._key = jax.random.PRNGKey(engine_cfg.seed + 1)
         self._gen_fns: dict[tuple, object] = {}  # (B, S_bucket, max_new) -> jitted
         self._scheduler = None
+        self.schedules_internally = False
         if engine_cfg.scheduler == "continuous":
             from lmrs_tpu.engine.scheduler import ContinuousScheduler
 
             self._scheduler = ContinuousScheduler(
                 engine_cfg, model_cfg, self.params, self.tokenizer
             )
+            # slot + page admission control replaces the executor's wave cap
+            self.schedules_internally = True
 
     # -------------------------------------------------------------- plumbing
 

@@ -84,6 +84,11 @@ class ContinuousScheduler:
         # amortizes the round trip; overshoot past a slot's budget is
         # trimmed in _maybe_finish and its pages are pre-reserved in admit()
         self.decode_block = max(1, engine_cfg.decode_block)
+        # speculation: each scan step verifies spec_k drafts + 1 bonus, so
+        # fewer steps per dispatch keep tokens-per-block ~= decode_block
+        self.spec_k = max(0, engine_cfg.speculate_k)
+        self.decode_steps = (max(1, self.decode_block // (self.spec_k + 1))
+                             if self.spec_k else self.decode_block)
         self.prefill_chunk = max(64, engine_cfg.prefill_chunk)
         ps = engine_cfg.page_size
         max_pages_per_slot = -(-self.max_len // ps)
@@ -98,10 +103,12 @@ class ContinuousScheduler:
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
         self._decode_fns: dict[int, object] = {}
         self._ran_ok: set = set()  # fn-cache keys that have executed once
+        self._spec_buf = None  # device token-history buffer (speculation)
         # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
         self.metrics = {
             "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
             "occupancy_sum": 0.0, "peak_pages_in_use": 0, "run_seconds": 0.0,
+            "spec_accepted_tokens": 0,  # draft tokens accepted (speculation)
         }
 
     def metrics_report(self) -> dict:
@@ -123,6 +130,8 @@ class ContinuousScheduler:
             "peak_kv_page_utilization": round(
                 m["peak_pages_in_use"] / (self.cache.num_pages - 1), 3),
             "scheduler_seconds": round(m["run_seconds"], 3),
+            **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
+               if self.spec_k else {}),
         }
 
     def _pick_kernel(self) -> bool:
@@ -163,7 +172,7 @@ class ContinuousScheduler:
                 # Need is capped at max_pages_per_slot (decode write positions
                 # are clamped below max_seq_len, so a capped allocation is
                 # never written past).
-                budget = len(ids) + max_new + self.decode_block
+                budget = len(ids) + max_new + self.decode_block + self.spec_k
                 need = min(self.cache.pages_needed(budget),
                            self.cache.max_pages_per_slot)
                 if need > usable_pages:
@@ -208,23 +217,30 @@ class ContinuousScheduler:
                 last_tok[b] = tok0
                 kv_lens[b] = st.kv_len
                 active[b] = True
+                self.seed_history(b, st)
                 self._maybe_finish(b, slots, results, active)
             if not any(active):
                 continue
             self.metrics["occupancy_sum"] += float(np.mean(active))
             self.metrics["decode_dispatches"] += 1
-            toks, n_valid = self._decode_block(slots, last_tok, kv_lens, active,
-                                               temps, top_k, top_p)
+            if self.spec_k:
+                emitted = self._spec_decode_block(
+                    slots, last_tok, kv_lens, active, temps, top_k, top_p)
+            else:
+                toks, n_valid = self._decode_block(
+                    slots, last_tok, kv_lens, active, temps, top_k, top_p)
+                emitted = [toks[b, : int(n_valid[b])].tolist()
+                           for b in range(self.B)]
             for b in range(self.B):
                 st = slots[b]
                 if st is None or not active[b]:
                     continue
-                valid = int(n_valid[b])
-                st.generated.extend(toks[b, :valid].tolist())
-                st.kv_len += valid
+                new = emitted[b]
+                st.generated.extend(new)
+                st.kv_len += len(new)
                 kv_lens[b] = st.kv_len
                 last_tok[b] = st.generated[-1] if st.generated else 0
-                self.metrics["decode_tokens"] += valid
+                self.metrics["decode_tokens"] += len(new)
                 self._maybe_finish(b, slots, results, active)
 
         self.metrics["run_seconds"] += time.time() - t_run
@@ -430,22 +446,26 @@ class ContinuousScheduler:
 
     # -------------------------------------------------------------- decode
 
-    def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k, top_p):
-        # page window bucketed to the widest active sequence (+ block growth).
-        # Slots still in prefill phase get the null page table: the decode
-        # program's masked dummy writes must land on page 0, never on pages
-        # holding their half-prefilled KV.
+    def _decode_window(self, slots, extra_tokens: int):
+        """(w, table) for one decode dispatch: page window bucketed to the
+        widest active sequence plus ``extra_tokens`` of block growth.  Slots
+        still in prefill phase get the null page table: the decode program's
+        masked dummy writes must land on page 0, never on pages holding
+        their half-prefilled KV."""
         decode_seqs = [
             s.seq if (s is not None and s.phase == "decode") else None
             for s in slots
         ]
         max_pages = 1
-        for b, st in enumerate(slots):
+        for st in slots:
             if st is not None and st.phase == "decode":
-                need = self.cache.pages_needed(st.kv_len + self.decode_block)
+                need = self.cache.pages_needed(st.kv_len + extra_tokens)
                 max_pages = max(max_pages, need)
         w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
-        table = self.cache.page_table_array(decode_seqs)
+        return w, self.cache.page_table_array(decode_seqs)
+
+    def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k, top_p):
+        w, table = self._decode_window(slots, self.decode_block)
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
@@ -512,3 +532,108 @@ class ContinuousScheduler:
                     "(ragged_kernel=%s)", self.B, n_steps, w, use_ragged)
         self._decode_fns[w] = decode
         return decode
+
+    # -------------------------------------------- speculative decode (k > 0)
+
+    def seed_history(self, b: int, st: _SlotState) -> None:
+        """Load slot b's token history into the device-resident buffer (one
+        row upload at decode admission; the device appends from then on)."""
+        if not self.spec_k:
+            return
+        if self._spec_buf is None:
+            self._spec_buf = jnp.zeros((self.B, self.max_len), jnp.int32)
+        row = np.zeros((self.max_len,), np.int32)
+        hist = (st.prompt_ids + st.generated)[-self.max_len:]
+        row[: len(hist)] = hist
+        self._spec_buf = self._spec_buf.at[b].set(jnp.asarray(row))
+
+    def _spec_decode_block(self, slots, last_tok, kv_lens, active, temps,
+                           top_k, top_p) -> list[list[int]]:
+        """One speculative decode dispatch; returns the per-slot emitted
+        token lists.  The token-history buffer lives on device (seeded per
+        row at decode admission, appended by the device inside the block) —
+        no per-dispatch O(B*max_len) upload."""
+        w, table = self._decode_window(slots,
+                                       self.decode_block + self.spec_k)
+        self._key, sub = jax.random.split(self._key)
+        fn = self._get_spec_decode_fn(w)
+        toks, counts, self._spec_buf, self.cache.k, self.cache.v = fn(
+            self.params, self.cache.k, self.cache.v, self._spec_buf,
+            jnp.asarray(last_tok), jnp.asarray(kv_lens),
+            jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
+            jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        toks, counts = jax.device_get((toks, counts))  # one transfer
+        emitted: list[list[int]] = []
+        for b in range(self.B):
+            row: list[int] = []
+            for s in range(counts.shape[1]):
+                c = int(counts[b, s])
+                row.extend(int(t) for t in toks[b, s, :c])
+                self.metrics["spec_accepted_tokens"] += max(0, c - 1)
+            emitted.append(row)
+        return emitted
+
+    def _get_spec_decode_fn(self, w: int):
+        key_ = ("specfn", w)
+        if key_ in self._decode_fns:
+            return self._decode_fns[key_]
+        cfg = self.model_cfg
+        n_steps = self.decode_steps
+        k = self.spec_k
+        eos_id = self.tokenizer.eos_id
+        max_len = self.max_len
+        rope_max = self.max_len
+
+        from lmrs_tpu.ops.sampling import filtered_probs
+        from lmrs_tpu.ops.speculative import draft_lookup, verify_tokens
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def spec_decode(params, k_pages, v_pages, buf, last_tok, kv_lens,
+                        table, active, key, temps, tk, tp):
+            b_rows = jnp.arange(buf.shape[0])[:, None]
+            offs = jnp.arange(k + 1)[None, :]
+
+            def step(carry, _):
+                k_pages, v_pages, buf, tok, lens, done, key = carry
+                # current token enters the history at index == its KV position
+                buf = buf.at[b_rows[:, 0], jnp.minimum(lens, max_len - 1)].set(tok)
+                draft, n_valid = draft_lookup(buf, lens + 1, k, pad_id=eos_id)
+
+                toks_in = jnp.concatenate([tok[:, None], draft], axis=1)
+                positions = jnp.minimum(lens[:, None] + offs, max_len - 1)
+                logits, k_pages, v_pages = forward_paged(
+                    params, cfg, toks_in, positions, k_pages, v_pages, table,
+                    jnp.minimum(lens + 1 + k, max_len), rope_max,
+                    use_ragged_kernel=False, window_prefill=True,
+                )
+                probs = jax.vmap(filtered_probs, in_axes=(1, None, None, None),
+                                 out_axes=1)(logits, temps, tk, tp)
+                key, sub = jax.random.split(key)
+                emit, count = verify_tokens(probs, draft, n_valid, sub)
+                emit = jnp.where(done[:, None], eos_id, emit)
+                count = jnp.where(done, 0, count)
+
+                hit_eos = jnp.any((offs < count[:, None]) & (emit == eos_id), 1)
+                newly_done = jnp.logical_or(done, hit_eos)
+                # accepted tokens extend the history (the final emitted token
+                # lands exactly at the next step's write index — idempotent)
+                cols = jnp.minimum(lens[:, None] + 1 + offs, max_len - 1)
+                buf = buf.at[b_rows, cols].set(emit)
+                lens = jnp.minimum(lens + count, max_len)
+                nxt = jnp.take_along_axis(
+                    emit, jnp.maximum(count - 1, 0)[:, None], 1)[:, 0]
+                nxt = jnp.where(done, tok, nxt)
+                return (k_pages, v_pages, buf, nxt, lens, newly_done, key), (emit, count)
+
+            carry = (k_pages, v_pages, buf, last_tok, kv_lens, ~active, key)
+            (k_pages, v_pages, buf, *_), (toks, counts) = jax.lax.scan(
+                step, carry, None, length=n_steps)
+            # [steps, B, k+1] -> [B, steps, k+1]; counts [steps, B] -> [B, steps]
+            return (jnp.transpose(toks, (1, 0, 2)), jnp.transpose(counts),
+                    buf, k_pages, v_pages)
+
+        logger.info("compiling speculative decode: B=%d steps=%d k=%d "
+                    "window=%d pages", self.B, n_steps, k, w)
+        self._decode_fns[key_] = spec_decode
+        return spec_decode

@@ -12,23 +12,19 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_logits(
+def filter_logits(
     logits: jnp.ndarray,  # [B, V] f32
-    key: jax.Array,
     temperature: jnp.ndarray,  # [B] 0.0 => greedy
     top_k: jnp.ndarray,  # [B] int32, 0 => disabled
     top_p: jnp.ndarray,  # [B] f32, 1.0 => disabled
 ) -> jnp.ndarray:
-    """Returns sampled token ids [B].
-
-    Greedy is expressed as temperature==0 (the categorical draw is replaced by
-    argmax via where), so batches can mix greedy and sampled requests.
-    """
+    """Temperature-scaled, top-k/top-p-masked logits [B, V] (-inf outside the
+    nucleus).  softmax of the result is the sampling distribution for
+    temperature > 0 rows; greedy rows are the caller's argmax special case."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
-    greedy_ids = jnp.argmax(logits, axis=-1)
 
-    # temperature scaling (guard divide-by-zero; greedy rows overridden below)
+    # temperature scaling (guard divide-by-zero; greedy rows overridden later)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
 
@@ -47,6 +43,37 @@ def sample_logits(
     pth = jnp.take_along_axis(sorted_desc, p_idx[:, None], axis=-1)
     p_mask = jnp.where((top_p < 1.0)[:, None], scaled >= pth, True)
 
-    masked = jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+    return jnp.where(k_mask & p_mask, scaled, -jnp.inf)
+
+
+def filtered_probs(
+    logits: jnp.ndarray,  # [B, V] f32
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """The per-row sampling distribution [B, V]: softmax of the filtered
+    logits for temperature > 0, a one-hot argmax for greedy rows — the
+    acceptance-test target in speculative decoding (ops/speculative.py)."""
+    v = logits.shape[-1]
+    probs = jax.nn.softmax(filter_logits(logits, temperature, top_k, top_p), -1)
+    greedy = jax.nn.one_hot(jnp.argmax(logits, -1), v, dtype=probs.dtype)
+    return jnp.where((temperature > 0)[:, None], probs, greedy)
+
+
+def sample_logits(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B] 0.0 => greedy
+    top_k: jnp.ndarray,  # [B] int32, 0 => disabled
+    top_p: jnp.ndarray,  # [B] f32, 1.0 => disabled
+) -> jnp.ndarray:
+    """Returns sampled token ids [B].
+
+    Greedy is expressed as temperature==0 (the categorical draw is replaced by
+    argmax via where), so batches can mix greedy and sampled requests.
+    """
+    greedy_ids = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    masked = filter_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy_ids)

@@ -1,0 +1,113 @@
+"""Speculative decoding: prompt-lookup drafting + exact-distribution verify.
+
+Summaries quote their source, so the next tokens of a summary frequently
+continue an n-gram that already occurred in the prompt (prompt-lookup /
+n-gram speculation).  Drafting is FREE — no draft model: find the most
+recent earlier occurrence of the last bigram in the token history and
+propose the tokens that followed it.  One [B, 1+k] verify forward then
+scores all k drafts at once, turning up to k+1 sequential decode steps
+into one — a latency win precisely proportional to how repetitive the
+decode is, with NO quality change:
+
+Acceptance is the standard speculative-sampling rule with a deterministic
+proposal q = delta(draft): accept draft_j with probability p_j(draft_j)
+(p = the temperature/top-k/top-p-filtered model distribution,
+ops/sampling.filtered_probs); on first rejection sample from the residual
+norm(max(p - q, 0)) = p with the rejected token zeroed; if every valid
+draft is accepted, sample the bonus token from the model's own p_k.  This
+preserves the output distribution EXACTLY (greedy rows degenerate to
+"accept while draft == argmax"), so speculation is purely a scheduling
+optimization.  The reference has no model-side decoding at all — this is
+serving-stack surface with no reference counterpart.
+
+Everything here is trace-friendly (static k, where-masks, no data-dependent
+shapes) so it runs inside the scheduler's on-device decode block scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def draft_lookup(
+    buf: jnp.ndarray,   # [B, L] int32 token history (prompt + generated)
+    hist_len: jnp.ndarray,  # [B] valid tokens in buf
+    k: int,
+    pad_id: int = 0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Propose k draft tokens per row by bigram lookup over the history.
+
+    Finds the most recent position i < hist_len-2 with
+    (buf[i], buf[i+1]) == (buf[hist_len-2], buf[hist_len-1]) and drafts the
+    k tokens that followed it.  Returns (draft [B, k], n_valid [B]) with
+    n_valid == 0 when the row has no earlier occurrence (or < 2 tokens).
+    """
+    b, L = buf.shape
+    c1 = jnp.take_along_axis(buf, jnp.maximum(hist_len - 2, 0)[:, None], 1)  # [B,1]
+    c2 = jnp.take_along_axis(buf, jnp.maximum(hist_len - 1, 0)[:, None], 1)
+    idx = jnp.arange(L - 1)[None, :]  # candidate bigram start positions
+    match = (buf[:, :-1] == c1) & (buf[:, 1:] == c2)
+    # exclude the query bigram itself and anything whose draft window would
+    # start at/after the history end
+    match &= idx + 2 < hist_len[:, None]
+    # a match so close to the buffer end that its k-token continuation
+    # window would run past L can't be drafted from (the clip below would
+    # silently slide the window onto unrelated tokens) — require room
+    match &= idx + 2 <= L - k
+    has = jnp.any(match, axis=1) & (hist_len >= 2)
+    # most recent match: argmax over idx * match
+    pos = jnp.max(jnp.where(match, idx, -1), axis=1)  # [B], -1 if none
+
+    start = jnp.clip(pos + 2, 0, L - k)  # draft source window
+    draft = jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice_in_dim(row, s, k)
+    )(buf, start)
+    n_valid = jnp.where(has, jnp.minimum(k, hist_len - start), 0)
+    draft = jnp.where(jnp.arange(k)[None, :] < n_valid[:, None], draft, pad_id)
+    return draft, n_valid.astype(jnp.int32)
+
+
+def verify_tokens(
+    probs: jnp.ndarray,   # [B, k+1, V] filtered model distribution per slot
+    draft: jnp.ndarray,   # [B, k] proposed tokens
+    n_valid: jnp.ndarray, # [B] usable draft prefix length
+    key: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Speculative-sampling acceptance (deterministic proposal).
+
+    Returns (emit [B, k+1], count [B]): row b's new tokens are
+    emit[b, :count[b]] — the accepted draft prefix plus one token that is
+    either the residual sample at the rejection slot or the bonus sample
+    when every valid draft was accepted.  1 <= count <= k+1.
+    """
+    b, kp1, v = probs.shape
+    k = kp1 - 1
+    key_u, key_s = jax.random.split(key)
+    u = jax.random.uniform(key_u, (b, k))
+
+    # p_j(draft_j) for each draft slot
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], draft[:, :, None], axis=2
+    )[:, :, 0]  # [B, k]
+    ok = (u < p_draft) & (jnp.arange(k)[None, :] < n_valid[:, None])
+    # accepted prefix length: first failure cuts everything after it
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1)  # [B, k]
+    a = jnp.sum(acc, axis=1)  # [B] in [0, n_valid]
+
+    # distribution for the final token, taken at slot a
+    p_final = jnp.take_along_axis(probs, a[:, None, None], axis=1)[:, 0]  # [B,V]
+    rejected = a < n_valid  # a rejection happened at slot a
+    draft_a = jnp.take_along_axis(draft, jnp.minimum(a, k - 1)[:, None], 1)[:, 0]
+    residual = p_final.at[jnp.arange(b), draft_a].set(0.0)
+    residual = residual / jnp.maximum(residual.sum(-1, keepdims=True), 1e-20)
+    dist = jnp.where(rejected[:, None], residual, p_final)
+    final = jax.random.categorical(key_s, jnp.log(jnp.maximum(dist, 1e-20)), -1)
+
+    # emit = draft[:a] + [final]
+    slots = jnp.arange(kp1)[None, :]
+    emit = jnp.where(slots < a[:, None],
+                     jnp.pad(draft, ((0, 0), (0, 1))),
+                     0)
+    emit = jnp.where(slots == a[:, None], final[:, None], emit)
+    return emit.astype(jnp.int32), (a + 1).astype(jnp.int32)

@@ -102,3 +102,33 @@ def test_mock_engine_deterministic():
     b = eng.generate_batch([req])[0]
     assert a.text == b.text
     assert "[00:10]" in a.text
+
+
+def test_internally_scheduled_engine_gets_whole_queue():
+    """Engines with their own admission control receive all requests in one
+    generate_batch call (no wave barrier)."""
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+    from lmrs_tpu.engine.executor import MapExecutor
+
+    calls = []
+
+    class FakeEngine:
+        schedules_internally = True
+
+        def generate_batch(self, requests):
+            calls.append(len(requests))
+            return [GenerationResult(request_id=r.request_id, text="ok")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(FakeEngine(), EngineConfig(max_concurrent_requests=2))
+    reqs = [GenerationRequest(prompt=f"r{i}", request_id=i) for i in range(7)]
+    out = ex.run_requests(reqs)
+    assert [r.request_id for r in out] == list(range(7))
+    assert calls == [7]  # one call with the whole queue, not ceil(7/2) waves
