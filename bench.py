@@ -67,7 +67,9 @@ def main() -> int:
         # (~250 ms/round-trip on tunneled chips): fewer, bigger dispatches,
         # and prefill_chunk > max prompt so every prefill is one fresh
         # flash-attention dispatch (no window-gather continuation path)
-        engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=8,
+        # 24 slots: decode's per-dispatch host RTT amortizes over 3x more
+        # rows (measured 3.0 -> 5.2 req/s vs 8 slots on the bench chip)
+        engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=24,
                             retry_delay=0.0, seed=0,
                             decode_block=64, prefill_chunk=4096),
         model=model,

@@ -55,44 +55,60 @@ class MapExecutor:
         system_prompt: str | None = None,
     ) -> list[Chunk]:
         """Summarize every chunk; returns chunks ordered by chunk_index."""
+        self.process_chunk_groups([chunks], prompt_template, summary_type,
+                                  system_prompt)
+        return sorted(chunks, key=lambda c: c.chunk_index)  # llm_executor.py:157
+
+    def process_chunk_groups(
+        self,
+        groups: Sequence[Sequence[Chunk]],
+        prompt_template: str,
+        summary_type: str = "summary",
+        system_prompt: str | None = None,
+    ) -> None:
+        """Summarize every chunk of every group through ONE pooled request
+        queue (multi-transcript batching: the engine's batch slots fill from
+        all transcripts at once instead of draining per transcript).
+        Summaries are written onto the chunks in place."""
         t0 = time.time()
         requests = []
-        for chunk in chunks:
-            # safe_format, not str.format: user prompt files may contain
-            # literal braces (JSON examples) that str.format would choke on
-            prompt = safe_format(
-                prompt_template,
-                transcript=chunk.text_with_context,
-                summary_type=summary_type,
-            )
-            requests.append(
-                GenerationRequest(
-                    prompt=prompt,
-                    request_id=chunk.chunk_index,
-                    system_prompt=chunk.system_prompt or system_prompt,
-                    max_new_tokens=self.config.max_tokens,
-                    temperature=self.config.temperature,
-                    seed=self.config.seed,
+        flat: list[Chunk] = []
+        for chunks in groups:
+            for chunk in chunks:
+                # safe_format, not str.format: user prompt files may contain
+                # literal braces (JSON examples) that str.format would choke on
+                prompt = safe_format(
+                    prompt_template,
+                    transcript=chunk.text_with_context,
+                    summary_type=summary_type,
                 )
-            )
+                requests.append(
+                    GenerationRequest(
+                        prompt=prompt,
+                        request_id=len(flat),  # pool-unique, not chunk_index
+                        system_prompt=chunk.system_prompt or system_prompt,
+                        max_new_tokens=self.config.max_tokens,
+                        temperature=self.config.temperature,
+                        seed=self.config.seed,
+                    )
+                )
+                flat.append(chunk)
 
         results = self.run_requests(requests)
-        by_id = {r.request_id: r for r in results}
-        out = sorted(chunks, key=lambda c: c.chunk_index)  # llm_executor.py:157
-        for chunk in out:
-            res = by_id[chunk.chunk_index]
+        failed = 0
+        for chunk, res in zip(flat, results):
             if res.error is not None:
                 chunk.summary = f"[Error processing chunk: {res.error}]"
                 chunk.error = res.error
+                failed += 1
             else:
                 chunk.summary = res.text
             chunk.tokens_used = res.total_tokens
             chunk.device_seconds = res.device_seconds
         logger.info(
-            "map stage: %d chunks in %.2fs (%d failed)",
-            len(out), time.time() - t0, sum(1 for c in out if c.error),
+            "map stage: %d chunks (%d groups) in %.2fs (%d failed)",
+            len(flat), len(groups), time.time() - t0, failed,
         )
-        return list(out)
 
     # ----------------------------------------------------- request plumbing
 

@@ -67,13 +67,21 @@ class JaxEngine:
         self.model_cfg = model_cfg
         self.mesh_cfg = mesh_cfg
         self.tokenizer = tokenizer or self._default_tokenizer()
+        self._mesh = None
+        if mesh_cfg is not None and mesh_cfg.n_devices > 1:
+            from lmrs_tpu.parallel.mesh import build_mesh
+
+            self._mesh = build_mesh(mesh_cfg)
         key = jax.random.PRNGKey(engine_cfg.seed)
         t0 = time.time()
         if params is None:
             if engine_cfg.checkpoint_path:
                 from lmrs_tpu.models.loader import load_checkpoint
 
-                params = load_checkpoint(engine_cfg.checkpoint_path, model_cfg)
+                # restore directly onto the mesh: shards stream to their
+                # devices and the full tree never materializes on one host
+                params = load_checkpoint(engine_cfg.checkpoint_path, model_cfg,
+                                         mesh=self._mesh)
             else:
                 logger.warning(
                     "no checkpoint for %s: using random-init weights "
@@ -98,7 +106,8 @@ class JaxEngine:
             from lmrs_tpu.engine.scheduler import ContinuousScheduler
 
             self._scheduler = ContinuousScheduler(
-                engine_cfg, model_cfg, self.params, self.tokenizer
+                engine_cfg, model_cfg, self.params, self.tokenizer,
+                mesh=self._mesh,
             )
             # slot + page admission control replaces the executor's wave cap
             self.schedules_internally = True
@@ -111,15 +120,13 @@ class JaxEngine:
         return ByteTokenizer() if self.model_cfg.vocab_size < 100000 else get_tokenizer("approx")
 
     def _place(self, params):
-        """Put params on device(s); with a >1-device mesh, use TP layout."""
-        if self.mesh_cfg is not None and self.mesh_cfg.n_devices > 1:
-            from lmrs_tpu.parallel.mesh import build_mesh
+        """Put params on device(s); with a >1-device mesh, use TP layout.
+        (No-op re-placement for params a sharded restore already placed.)"""
+        if self._mesh is not None:
             from lmrs_tpu.parallel.sharding import shard_params
 
-            self._mesh = build_mesh(self.mesh_cfg)
             return shard_params(params, self._mesh, self.model_cfg.tie_embeddings,
                                 moe=self.model_cfg.n_experts > 0)
-        self._mesh = None
         return jax.device_put(params)
 
     def shutdown(self) -> None:

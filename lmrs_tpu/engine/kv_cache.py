@@ -100,15 +100,30 @@ class PagedKVCache:
     """
 
     def __init__(self, model_cfg: ModelConfig, num_pages: int, page_size: int,
-                 max_pages_per_slot: int, allocator: PageAllocator | None = None):
+                 max_pages_per_slot: int, allocator: PageAllocator | None = None,
+                 mesh=None):
         hd = model_cfg.hd
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_pages_per_slot = max_pages_per_slot
         dt = jnp.dtype(model_cfg.dtype)
         shape = (model_cfg.n_layers, model_cfg.n_kv_heads, num_pages, page_size, hd)
-        self.k = jnp.zeros(shape, dt)
-        self.v = jnp.zeros(shape, dt)
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            # tensor-parallel serving: pages shard on the kv-head axis,
+            # matching the wk/wv head sharding — each shard's attention and
+            # page writes stay local, no cross-chip KV traffic
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if model_cfg.n_kv_heads % mesh.shape["tp"]:
+                raise ValueError(
+                    f"n_kv_heads={model_cfg.n_kv_heads} not divisible by "
+                    f"tp={mesh.shape['tp']}")
+            sh = NamedSharding(mesh, P(None, "tp"))
+            self.k = jnp.zeros(shape, dt, device=sh)
+            self.v = jnp.zeros(shape, dt, device=sh)
+        else:
+            self.k = jnp.zeros(shape, dt)
+            self.v = jnp.zeros(shape, dt)
         self.allocator = allocator or make_page_allocator(num_pages)
         logger.info(
             "paged KV cache: %d pages x %d tokens (%.1f MiB)",

@@ -72,11 +72,12 @@ class ContinuousScheduler:
     """Host-side scheduling loop over device-side prefill/decode programs."""
 
     def __init__(self, engine_cfg: EngineConfig, model_cfg: ModelConfig,
-                 params, tokenizer):
+                 params, tokenizer, mesh=None):
         self.cfg = engine_cfg
         self.model_cfg = model_cfg
         self.params = params
         self.tokenizer = tokenizer
+        self.mesh = mesh  # tensor-parallel serving: params + pages sharded
         self.B = max(1, engine_cfg.max_batch_slots)
         self.max_len = model_cfg.max_seq_len
         # decode steps per dispatch: the host syncs once per block, so on
@@ -95,9 +96,12 @@ class ContinuousScheduler:
         # pool sized so every slot can hold a full-length sequence, or the
         # configured pool size if larger (+1: page 0 is the reserved null page)
         num_pages = max(engine_cfg.num_pages, self.B * max_pages_per_slot + 1)
-        self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot)
+        self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot,
+                                  mesh=mesh)
         self._use_ragged = self._pick_kernel()
-        self._use_flash = True  # flash prefill; cleared if lowering fails
+        # flash prefill: single-device only (same pallas-under-mesh limit as
+        # the ragged gate above); also cleared if lowering fails at runtime
+        self._use_flash = mesh is None
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
@@ -138,8 +142,11 @@ class ContinuousScheduler:
         from lmrs_tpu.utils.platform import on_tpu
 
         if self.cfg.scheduler == "continuous":
-            # ragged kernel wants MXU-friendly head_dim and a TPU backend
-            return on_tpu() and self.model_cfg.hd % 128 == 0
+            # ragged kernel wants MXU-friendly head_dim, a TPU backend, and a
+            # single device (under a mesh, XLA auto-partitioning of the
+            # pallas_call is not supported — the gather fallback shards fine)
+            return (on_tpu() and self.model_cfg.hd % 128 == 0
+                    and self.mesh is None)
         return False
 
     # ----------------------------------------------------------- public API

@@ -126,6 +126,24 @@ class TranscriptSummarizer:
 
     # ------------------------------------------------------------------ API
 
+    def _prep(self, transcript_data: dict[str, Any], timer: StageTimer):
+        """Shared stages 1-3: limit → preprocess → chunk.
+        Returns (n_input_segments, processed_segments, chunks)."""
+        segments = transcript_data.get("segments", [])
+        if self.config.data.limit_segments:
+            segments = segments[: self.config.data.limit_segments]
+        with timer.stage("preprocess"):
+            processed = preprocess_transcript(
+                segments,
+                merge_same_speaker=self.config.data.merge_same_speaker,
+                time_interval_seconds=self.config.data.time_interval_seconds,
+                max_segment_duration=self.config.data.max_segment_duration,
+                preserve_timestamps=self.config.data.preserve_timestamps,
+            )
+        with timer.stage("chunk"):
+            chunks = self.chunker.chunk_transcript(processed)
+        return len(segments), processed, chunks
+
     def summarize(
         self,
         transcript_data: dict[str, Any],
@@ -144,24 +162,9 @@ class TranscriptSummarizer:
         timer = StageTimer(profile=self.profile)
         t_start = time.time()
 
-        segments = transcript_data.get("segments", [])
-        if self.config.data.limit_segments:
-            segments = segments[: self.config.data.limit_segments]
-        n_input_segments = len(segments)
-
-        with timer.stage("preprocess"):
-            processed = preprocess_transcript(
-                segments,
-                merge_same_speaker=self.config.data.merge_same_speaker,
-                time_interval_seconds=self.config.data.time_interval_seconds,
-                max_segment_duration=self.config.data.max_segment_duration,
-                preserve_timestamps=self.config.data.preserve_timestamps,
-            )
+        n_input_segments, processed, chunks = self._prep(transcript_data, timer)
         duration = get_transcript_duration(processed)
         speakers = extract_speakers(processed)
-
-        with timer.stage("chunk"):
-            chunks = self.chunker.chunk_transcript(processed)
 
         map_prompt = resolve_map_prompt(prompt_template, prompt_file)
         sys_prompt = resolve_system_prompt(system_prompt, system_prompt_file)
@@ -211,6 +214,78 @@ class TranscriptSummarizer:
             "pipeline done: %d chunks, %.2fs total", len(chunks), stats["processing_time"]
         )
         return stats
+
+    def summarize_many(
+        self,
+        transcripts: list[dict[str, Any]],
+        *,
+        prompt_template: str | None = None,
+        prompt_file: str | None = None,
+        system_prompt: str | None = None,
+        system_prompt_file: str | None = None,
+        aggregator_prompt: str | None = None,
+        aggregator_prompt_file: str | None = None,
+        summary_type: str = "summary",
+    ) -> list[dict[str, Any]]:
+        """Summarize several transcripts through ONE pooled map queue
+        (BASELINE config #5: multi-transcript batching).
+
+        Every transcript's chunks feed the engine's batch slots together, so
+        one transcript's decode tail overlaps the next one's prefill instead
+        of draining between transcripts; each transcript then gets its own
+        reduce tree and stats dict (same shape as ``summarize``'s).
+        """
+        timer = StageTimer(profile=self.profile)
+        t_start = time.time()
+        map_prompt = resolve_map_prompt(prompt_template, prompt_file)
+        sys_prompt = resolve_system_prompt(system_prompt, system_prompt_file)
+        reduce_prompt = resolve_reduce_prompt(aggregator_prompt, aggregator_prompt_file)
+
+        prepped = [self._prep(data, timer) for data in transcripts]
+
+        with timer.stage("map"):
+            self.executor.process_chunk_groups(
+                [chunks for _, _, chunks in prepped], map_prompt, summary_type,
+                sys_prompt)
+
+        out = []
+        for n_input, processed, chunks in prepped:
+            ordered = sorted(chunks, key=lambda c: c.chunk_index)
+            duration = get_transcript_duration(processed)
+            speakers = extract_speakers(processed)
+            metadata = {
+                "duration": format_duration(duration),
+                "speakers": ", ".join(speakers),
+                "num_chunks": len(ordered),
+            }
+            with timer.stage("reduce"):
+                agg = self.aggregator.aggregate(ordered, reduce_prompt, metadata)
+            out.append({
+                "summary": agg["final_summary"],
+                "num_input_segments": n_input,
+                "num_segments": len(processed),
+                "num_chunks": len(ordered),
+                "num_resumed_chunks": 0,
+                "transcript_duration": duration,
+                "transcript_duration_str": format_duration(duration),
+                "speakers": speakers,
+                "hierarchical": agg["hierarchical"],
+                "reduce_levels": agg["levels"],
+            })
+        total = time.time() - t_start
+        # shared accounting is copied per result: these dicts are pooled
+        # across the batch, and handing every caller the same mutable object
+        # would let edits to one result bleed into the others
+        for stats in out:
+            stats.update({
+                "processing_time": total,
+                "stage_times": dict(timer.report()),
+                "engine_metrics": dict(self.executor.engine.engine_metrics()),
+                **self.executor.stats(),
+            })
+        logger.info("pipeline done: %d transcripts, %d chunks, %.2fs total",
+                    len(transcripts), sum(s["num_chunks"] for s in out), total)
+        return out
 
     async def asummarize(self, transcript_data: dict[str, Any], **kw: Any) -> dict[str, Any]:
         """Async facade for reference-API parity (main.py:82 is async)."""

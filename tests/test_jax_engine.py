@@ -73,3 +73,34 @@ def test_make_engine_resolves_preset(monkeypatch):
     from lmrs_tpu.config import EngineConfig as EC, ModelConfig as MC
     api_mod.make_engine(EC(backend="jax", model="gemma-2b"), MC(), None)
     assert captured["model"] == "gemma-2b"
+
+
+def test_engine_restores_checkpoint_sharded(tmp_path):
+    """checkpoint_path + mesh: weights restore directly sharded (never
+    materializing unsharded) and generation matches the in-memory params."""
+    from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+    from lmrs_tpu.models.loader import save_checkpoint
+    from lmrs_tpu.models.transformer import init_params
+
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                     dtype="float32")
+    params = init_params(mc, jax.random.PRNGKey(7))
+    save_checkpoint(str(tmp_path / "ckpt"), params)
+
+    req = GenerationRequest(prompt="restore probe restore probe",
+                            max_new_tokens=8)
+    direct = JaxEngine(EngineConfig(backend="jax", seed=0), mc, params=params)
+    want = direct.generate_batch([req])[0].text
+    direct.shutdown()
+
+    ec = EngineConfig(backend="jax", seed=0,
+                      checkpoint_path=str(tmp_path / "ckpt"))
+    eng = JaxEngine(ec, mc, mesh_cfg=MeshConfig(dp=1, tp=2))
+    wq = eng.params["layers"]["attn"]["wq"]
+    assert wq.sharding.shard_shape(wq.shape)[2] == mc.n_heads // 2
+    got = eng.generate_batch([req])[0].text
+    eng.shutdown()
+    assert got == want

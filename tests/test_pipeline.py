@@ -150,3 +150,32 @@ def test_unknown_backend_is_value_error(transcript):
     cfg = _cfg(engine=EC(backend="nope"))
     with pytest.raises(ValueError):
         TranscriptSummarizer(cfg).summarize(transcript)
+
+
+def test_summarize_many_pools_map_requests():
+    """Multi-transcript batching (BASELINE config #5): one pooled map queue,
+    per-transcript reduce + stats."""
+    from lmrs_tpu.config import PipelineConfig, EngineConfig, ChunkConfig
+    from lmrs_tpu.pipeline import TranscriptSummarizer
+
+    def transcript(n, tag):
+        return {"segments": [
+            {"start": i * 2.0, "end": i * 2.0 + 1.5,
+             "text": f"{tag} segment {i} talks about item {i % 7}.",
+             "speaker": f"SPEAKER_0{i % 2}"}
+            for i in range(n)]}
+
+    s = TranscriptSummarizer(PipelineConfig(
+        engine=EngineConfig(backend="mock"),
+        chunk=ChunkConfig(max_tokens_per_chunk=256, tokenizer="approx"),
+    ))
+    results = s.summarize_many([transcript(40, "alpha"), transcript(25, "beta")])
+    assert len(results) == 2
+    for r in results:
+        assert r["summary"]
+        assert r["num_chunks"] >= 1
+    assert results[0].get("failed_requests") == 0
+    # per-transcript fields differ, pooled accounting is shared
+    assert results[0]["num_input_segments"] == 40
+    assert results[1]["num_input_segments"] == 25
+    assert results[0]["total_requests"] == results[1]["total_requests"]

@@ -155,3 +155,28 @@ def test_ragged_kernel_failure_degrades_to_xla(cont_engine):
         sched._decode_fns.clear()
     assert out[0].error is None
     assert out[0].completion_tokens > 0
+
+
+def test_tp_sharded_continuous_serving_matches_single_device():
+    """Continuous-batching map over a tp=2 mesh: params AND the paged KV
+    pool shard on the head axis; greedy output must equal single-device
+    (BASELINE config #3's architecture, scaled to the virtual mesh)."""
+    from lmrs_tpu.config import MeshConfig
+
+    reqs = [GenerationRequest(prompt=f"tensor parallel serving probe {i} " * 6,
+                              request_id=i, max_new_tokens=10)
+            for i in range(3)]
+    single = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                    max_tokens=16, max_batch_slots=2, seed=0),
+                       tiny_model())
+    want = [r.text for r in single.generate_batch(reqs)]
+    single.shutdown()
+
+    tp = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                max_tokens=16, max_batch_slots=2, seed=0),
+                   tiny_model(), mesh_cfg=MeshConfig(dp=1, tp=2))
+    kv = tp._scheduler.cache.k
+    assert kv.sharding.shard_shape(kv.shape)[1] == tiny_model().n_kv_heads // 2
+    got = [r.text for r in tp.generate_batch(reqs)]
+    tp.shutdown()
+    assert got == want
