@@ -98,13 +98,14 @@ class ResultAggregator:
 
     # ------------------------------------------------------------ internals
 
-    def _reduce_once(
+    def _build_request(
         self,
         summaries: list[str],
         template: str,
         metadata: dict[str, Any] | None,
-    ) -> str:
-        """One reduce call through the engine (reference _single_aggregation,
+        request_id: int = 0,
+    ) -> GenerationRequest:
+        """Format one reduce prompt (reference _single_aggregation,
         result_aggregator.py:111-286, minus its OpenAI hardwiring)."""
         blocks = [
             f"SUMMARY {i + 1}:\n{'=' * 20}\n{s}" for i, s in enumerate(summaries)
@@ -116,19 +117,42 @@ class ResultAggregator:
             metadata=meta_str,
             num_summaries=len(summaries),
         )
-        req = GenerationRequest(
+        return GenerationRequest(
             prompt=prompt,
-            request_id=0,
+            request_id=request_id,
             max_new_tokens=self.executor.config.max_tokens,
             temperature=self.config.temperature,  # reference hardcodes 0.2
             seed=self.executor.config.seed,
         )
-        res = self.executor.run_requests([req])[0]
-        if res.error is not None:
-            # degrade to an error string, never raise
-            # (result_aggregator.py:256-259,284-286)
-            return f"[Error aggregating summaries: {res.error}]"
-        return res.text
+
+    def _reduce_wave(
+        self,
+        jobs: list[tuple[list[str], str, dict[str, Any] | None]],
+    ) -> list[str]:
+        """Run one level's reduce calls as a SINGLE engine wave — the
+        reference fans batches out concurrently (asyncio.create_task +
+        gather, result_aggregator.py:326-342); here they fill the batch
+        slots together instead of serializing one round trip per batch."""
+        requests = [
+            self._build_request(summaries, template, metadata, request_id=i)
+            for i, (summaries, template, metadata) in enumerate(jobs)
+        ]
+        results = self.executor.run_requests(requests)
+        # degrade to an error string, never raise
+        # (result_aggregator.py:256-259,284-286)
+        return [
+            res.text if res.error is None
+            else f"[Error aggregating summaries: {res.error}]"
+            for res in results
+        ]
+
+    def _reduce_once(
+        self,
+        summaries: list[str],
+        template: str,
+        metadata: dict[str, Any] | None,
+    ) -> str:
+        return self._reduce_wave([(summaries, template, metadata)])[0]
 
     def _hierarchical(
         self,
@@ -152,7 +176,7 @@ class ResultAggregator:
                 "reduce level %d: %d summaries in %d batches of <=%d",
                 level, len(current), len(batches), batch_size,
             )
-            nxt = []
+            jobs = []
             n = len(batches)
             for i, batch in enumerate(batches):
                 # Positional metadata per batch (result_aggregator.py:326-339)
@@ -162,12 +186,10 @@ class ResultAggregator:
                 batch_meta.update(
                     {"batch": f"{i + 1}/{n}", "position": f"{lo:.0f}%-{hi:.0f}% of the transcript"}
                 )
-                nxt.append(
-                    self._reduce_once(
-                        batch, prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, batch_meta
-                    )
+                jobs.append(
+                    (batch, prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, batch_meta)
                 )
-            current = nxt
+            current = self._reduce_wave(jobs)
         if len(current) == 1:
             return current[0], level
         final = self._reduce_once(
