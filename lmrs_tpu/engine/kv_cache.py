@@ -1,9 +1,10 @@
 """Paged KV cache: fixed page pool + host-side page allocator.
 
 The serving-memory design SURVEY.md §7.4 ranks as hard part #1: a fixed-size
-page pool in HBM ([L, num_pages, page_size, K, hd]) with per-slot page tables,
-so KV memory is allocated in O(page) quanta instead of one max_seq_len region
-per slot.  Admission control = free pages (the reference's semaphore analog,
+page pool in HBM ([K, n_layers * num_pages, page_size, hd] — see PagedKVCache
+for the layer-flattened layout rationale) with per-slot page tables, so KV
+memory is allocated in O(page) quanta instead of one max_seq_len region per
+slot.  Admission control = free pages (the reference's semaphore analog,
 SURVEY.md §2.2).
 
 The allocator is deliberately tiny and host-side (free-list); a C++
@@ -93,10 +94,16 @@ class SequencePages:
 class PagedKVCache:
     """Device page pool + per-slot host page tables.
 
-    Layout [L, K, P, page_size, hd] — kv-head-major, so one (kv head, page)
+    Layout [K, L*P, page_size, hd] — kv-head-major, so one (kv head, page)
     pair is a contiguous [page_size, hd] block (a single DMA in the ragged
-    decode kernel).  A slot's logical KV position maps to
-    (page_table[pos // ps], pos % ps).
+    decode kernel), with the layer axis FLATTENED into the page axis: layer
+    ``li``'s copy of logical page ``p`` is physical page ``li * P + p``.
+    That lets the per-layer decode scatter write straight into the full
+    carried pool with global page ids — no per-layer slice/update round
+    trip, which would otherwise move the whole layer slice every decode
+    step (models/transformer.forward_paged).  A slot's logical KV position
+    maps to (page_table[pos // ps], pos % ps); tables hold LOGICAL page ids
+    (< P) and are globalized per layer inside the forward.
     """
 
     def __init__(self, model_cfg: ModelConfig, num_pages: int, page_size: int,
@@ -107,7 +114,8 @@ class PagedKVCache:
         self.num_pages = num_pages
         self.max_pages_per_slot = max_pages_per_slot
         dt = jnp.dtype(model_cfg.dtype)
-        shape = (model_cfg.n_layers, model_cfg.n_kv_heads, num_pages, page_size, hd)
+        shape = (model_cfg.n_kv_heads, model_cfg.n_layers * num_pages,
+                 page_size, hd)
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             # tensor-parallel serving: pages shard on the kv-head axis,
             # matching the wk/wv head sharding — each shard's attention and
@@ -118,7 +126,7 @@ class PagedKVCache:
                 raise ValueError(
                     f"n_kv_heads={model_cfg.n_kv_heads} not divisible by "
                     f"tp={mesh.shape['tp']}")
-            sh = NamedSharding(mesh, P(None, "tp"))
+            sh = NamedSharding(mesh, P("tp"))
             self.k = jnp.zeros(shape, dt, device=sh)
             self.v = jnp.zeros(shape, dt, device=sh)
         else:

@@ -144,9 +144,11 @@ class ContinuousScheduler:
         if self.cfg.scheduler == "continuous":
             # ragged kernel wants MXU-friendly head_dim, a TPU backend, and a
             # single device (under a mesh, XLA auto-partitioning of the
-            # pallas_call is not supported — the gather fallback shards fine)
+            # pallas_call is not supported — the gather fallback shards fine);
+            # the fused write RMWs an 8-row-aligned DMA window, which only
+            # stays inside the page when the page size is a multiple of 8
             return (on_tpu() and self.model_cfg.hd % 128 == 0
-                    and self.mesh is None)
+                    and self.cfg.page_size % 8 == 0 and self.mesh is None)
         return False
 
     # ----------------------------------------------------------- public API

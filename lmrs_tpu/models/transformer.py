@@ -235,8 +235,14 @@ def forward(
     batch_idx = jnp.arange(b)[:, None]  # [B,1] for cache scatter
 
     if cache is not None:
-        def layer_fn(x, xs):
-            lp, ck, cv = xs  # layer params, cache slices [B, Smax, K, hd]
+        def layer_fn(carry, xs):
+            # cache rides the carry, not xs/ys — as xs every iteration
+            # would memcpy the full [L,B,S,K,hd] buffers into the stacked
+            # scan output (see forward_paged's layer_fn note)
+            x, ck_all, cv_all = carry
+            lp, li = xs  # layer params, layer index
+            ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+            cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
             h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
             q, k, v = qkv_proj(lp, cfg, h)
             q = apply_rope(q, positions, sin, cos)
@@ -250,11 +256,14 @@ def forward(
             h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
             ff, _ = ffn_block(lp, cfg, h)
             x = x + ff
-            return x, (ck, cv)
+            ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
+            cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
+            return (x, ck_all, cv_all), None
 
         # lax.scan over stacked layers: wq etc. are [L, ...]; cache [L, B, ...]
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_fn, x, (params["layers"], cache["k"], cache["v"]))
+        (x, new_k, new_v), _ = jax.lax.scan(
+            layer_fn, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
         new_cache = {"k": new_k, "v": new_v}
         aux = jnp.float32(0.0)
     else:
@@ -299,9 +308,9 @@ def forward_paged(
     cfg: ModelConfig,
     tokens: jnp.ndarray,       # [B, S] int32
     positions: jnp.ndarray,    # [B, S] absolute positions
-    k_pages: jnp.ndarray,      # [L, K, P, ps, hd]
-    v_pages: jnp.ndarray,      # [L, K, P, ps, hd]
-    page_tables: jnp.ndarray,  # [B, W] page ids
+    k_pages: jnp.ndarray,      # [K, L*P, ps, hd] (layer-flattened pool)
+    v_pages: jnp.ndarray,      # [K, L*P, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] LOGICAL page ids (< P)
     kv_lens: jnp.ndarray,      # [B] valid tokens AFTER this call's writes
     rope_max: int,
     use_ragged_kernel: bool = False,
@@ -324,12 +333,16 @@ def forward_paged(
     the gathered page window (pages are in logical order, so window index
     == absolute position), masked causally by absolute position + kv_lens.
     """
-    from lmrs_tpu.ops.paged_attention import paged_decode_pallas, paged_decode_xla
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_pallas_fused,
+        paged_decode_xla,
+    )
 
     dt = _dtype(cfg)
     b, s = tokens.shape
     hd = cfg.hd
-    ps = k_pages.shape[3]
+    ps = k_pages.shape[2]
+    n_pool = k_pages.shape[1] // cfg.n_layers  # logical pages per layer
     x = params["embed"]["weight"][tokens]
     if cfg.embed_scale:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(dt)
@@ -339,35 +352,51 @@ def forward_paged(
 
     page_idx = jnp.take_along_axis(
         page_tables, jnp.clip(positions // ps, 0, page_tables.shape[1] - 1), axis=1
-    )  # [B, S] physical page per token
+    )  # [B, S] logical page per token
     offsets = positions % ps
     batch_r = jnp.arange(b)[:, None]
 
-    def layer_fn(x, xs):
-        lp, kp, vp = xs  # kp/vp: [K, P, ps, hd]
+    def layer_fn(carry, xs):
+        # The page pools ride the scan CARRY (not xs/ys) and the layer axis
+        # is flattened into the page axis, so each layer scatters straight
+        # into the full pool at its GLOBAL page ids.  Either a per-layer
+        # stacked scan output or a slice/update round trip moves the whole
+        # pool (or a whole layer slice) every decode step — measured linear
+        # in pool size; this layout moves only the tokens written.
+        x, kp_all, vp_all = carry  # pools: [K, L*P, ps, hd]
+        lp, li = xs  # layer params, layer index
+        g_page_idx = li * n_pool + page_idx      # [B, S] global page ids
+        g_tables = li * n_pool + page_tables     # [B, W]
         h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
         q, k, v = qkv_proj(lp, cfg, h)
         q = apply_rope(q, positions, sin, cos)
         k = apply_rope(k, positions, sin, cos)
 
-        # scatter current K/V into the page pool: [K, P, ps, hd] at
-        # [kh, page_idx[b,s], offsets[b,s]]
-        kp = kp.at[:, page_idx, offsets].set(k.transpose(2, 0, 1, 3))
-        vp = vp.at[:, page_idx, offsets].set(v.transpose(2, 0, 1, 3))
+        if is_decode and use_ragged_kernel:
+            # write-fused ragged kernel: the current token's K/V lands in
+            # its page by in-place DMA inside the kernel (pools are i/o
+            # aliased), replacing the XLA scatter below — which was measured
+            # copying the whole pool every decode step
+            attn, kp_all, vp_all = paged_decode_pallas_fused(
+                q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables, kv_lens)
+            attn_out = attn[:, None]  # [B, 1, H, hd]
+            return _finish_layer(lp, x, attn_out, kp_all, vp_all)
+
+        # scatter current K/V into the pool: [K, L*P, ps, hd] at
+        # [kh, g_page_idx[b,s], offsets[b,s]]
+        kp_all = kp_all.at[:, g_page_idx, offsets].set(k.transpose(2, 0, 1, 3))
+        vp_all = vp_all.at[:, g_page_idx, offsets].set(v.transpose(2, 0, 1, 3))
 
         if is_decode:
-            if use_ragged_kernel:
-                attn = paged_decode_pallas(q[:, 0], kp, vp, page_tables, kv_lens)
-            else:
-                attn = paged_decode_xla(q[:, 0], kp, vp, page_tables, kv_lens)
+            attn = paged_decode_xla(q[:, 0], kp_all, vp_all, g_tables, kv_lens)
             attn_out = attn[:, None]  # [B, 1, H, hd]
         elif window_prefill:
             # continuation prefill: attend the page window (self K/V included
             # — this chunk was scattered into its pages above)
             w = page_tables.shape[1]
-            k_win = kp[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+            k_win = kp_all[:, g_tables].transpose(1, 2, 3, 0, 4).reshape(
                 b, w * ps, cfg.n_kv_heads, hd)
-            v_win = vp[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+            v_win = vp_all[:, g_tables].transpose(1, 2, 3, 0, 4).reshape(
                 b, w * ps, cfg.n_kv_heads, hd)
             attn_out = attention(q, k_win, v_win, positions, kv_lens)
         else:
@@ -381,15 +410,17 @@ def forward_paged(
                 attn_out = flash_attention(q, k, v, kv_lens)
             else:
                 attn_out = attention(q, k, v, positions, kv_lens)
-        x = x + out_proj(lp, cfg, attn_out)
+        return _finish_layer(lp, x, attn_out, kp_all, vp_all)
 
+    def _finish_layer(lp, x, attn_out, kp_all, vp_all):
+        x = x + out_proj(lp, cfg, attn_out)
         h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
         ff, _ = ffn_block(lp, cfg, h)
-        x = x + ff
-        return x, (kp, vp)
+        return (x + ff, kp_all, vp_all), None
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_pages, v_pages)
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_fn, (x, k_pages, v_pages),
+        (params["layers"], jnp.arange(cfg.n_layers)),
     )
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:

@@ -13,8 +13,10 @@ Two implementations with one contract:
   whole point of paging: decode is HBM-bandwidth-bound and the bandwidth
   spent is exactly the live KV bytes.
 
-Cache layout: [K, P, page_size, hd] per layer (kv-head-major so one page of
-one kv head is a contiguous [page_size, hd] DMA).
+Cache layout: [K, P_total, page_size, hd] (kv-head-major so one page of one
+kv head is a contiguous [page_size, hd] DMA; P_total flattens the layer axis
+into the page axis — engine/kv_cache.PagedKVCache — and callers pass GLOBAL
+page ids).
 """
 
 from __future__ import annotations
@@ -125,6 +127,148 @@ def _ragged_decode_kernel(
     jax.lax.fori_loop(0, n_pages, body, None)
     l = l_scr[:, :1]
     o_ref[0] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def _fused_decode_kernel(
+    # scalar prefetch
+    page_tables_ref,  # SMEM [B, W] GLOBAL page ids
+    kv_lens_ref,      # SMEM [B] length INCLUDING the current token
+    # inputs
+    q_ref,            # VMEM [1, 1, n_rep_p, hd]
+    knew_ref,         # VMEM [1, 1, 8, hd] current token's K (row 0 real)
+    vnew_ref,         # VMEM [1, 1, 8, hd]
+    k_hbm,            # ANY  [P_total, ps, hd] (this kv head's pool)
+    v_hbm,            # ANY  [P_total, ps, hd]
+    # outputs
+    o_ref,            # VMEM [1, 1, n_rep_p, hd]
+    k_out,            # ANY  aliased to k_hbm
+    v_out,            # ANY  aliased to v_hbm
+    # scratch
+    k_scr, v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem,
+    *,
+    page_size: int,
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    length = kv_lens_ref[b]
+    pos = length - 1
+    page = page_tables_ref[b, jax.lax.div(pos, page_size)]
+    off = jax.lax.rem(pos, page_size)
+
+    # Write the current token's K/V into its page slot IN PLACE (k_out is
+    # aliased to k_hbm) before the ragged walk reads that page.  Mosaic
+    # can't DMA a single sublane row, so read-modify-write an aligned 8-row
+    # window around the slot: DMA it in, blend the new row (knew_ref rows
+    # are broadcast-identical, so a where on the row index suffices), DMA
+    # it back.
+    # window start must be PROVABLY 8-aligned for Mosaic's tile reasoning
+    w0 = jax.lax.div(off, 8) * 8
+    r = off - w0
+    rk = pltpu.make_async_copy(k_out.at[page, pl.ds(w0, 8)], k8_scr, wsem.at[0])
+    rv = pltpu.make_async_copy(v_out.at[page, pl.ds(w0, 8)], v8_scr, wsem.at[1])
+    rk.start()
+    rv.start()
+    rk.wait()
+    rv.wait()
+    row = jax.lax.broadcasted_iota(jnp.int32, k8_scr.shape, 0) == r
+    k8_scr[:] = jnp.where(row, knew_ref[0, 0], k8_scr[:])
+    v8_scr[:] = jnp.where(row, vnew_ref[0, 0], v8_scr[:])
+    wk = pltpu.make_async_copy(k8_scr, k_out.at[page, pl.ds(w0, 8)], wsem.at[0])
+    wv = pltpu.make_async_copy(v8_scr, v_out.at[page, pl.ds(w0, 8)], wsem.at[1])
+    wk.start()
+    wv.start()
+    wk.wait()
+    wv.wait()
+
+    _ragged_decode_kernel(
+        page_tables_ref, kv_lens_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
+        k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+        page_size=page_size, sm_scale=sm_scale,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_pallas_fused(
+    q: jnp.ndarray,            # [B, H, hd]
+    k_new: jnp.ndarray,        # [B, K, hd] current token K (post-rope)
+    v_new: jnp.ndarray,        # [B, K, hd]
+    k_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    v_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] GLOBAL page ids
+    kv_lens: jnp.ndarray,      # [B] incl. current token
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Write-fused ragged decode: scatter the current token's K/V into the
+    page pool (in place — the pools are input/output aliased) and attend the
+    live pages, in one kernel.  Replaces XLA scatter + kernel: the XLA
+    scatter on the multi-GiB pool was measured copying the whole pool per
+    decode step (no in-place aliasing through the scan carry)."""
+    b, h, hd = q.shape
+    kh = k_pages.shape[0]
+    ps = k_pages.shape[2]
+    n_rep = h // kh
+    n_rep_p = -(-n_rep // 8) * 8
+    qg = q.reshape(b, kh, n_rep, hd)
+    if n_rep_p != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, n_rep_p - n_rep), (0, 0)))
+    # pad the singleton row dim to 8 for sublane alignment (see n_rep_p)
+    knew = jnp.broadcast_to(k_new[:, :, None], (b, kh, 8, hd))
+    vnew = jnp.broadcast_to(v_new[:, :, None], (b, kh, 8, hd))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kh),
+        in_specs=[
+            pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, 1, 8, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, 1, 8, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((ps, hd), k_pages.dtype),
+            pltpu.VMEM((ps, hd), v_pages.dtype),
+            pltpu.VMEM((n_rep_p, hd), jnp.float32),
+            pltpu.VMEM((n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((8, hd), k_pages.dtype),
+            pltpu.VMEM((8, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+
+    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
+               o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
+               k8_scr, v8_scr, sem, wsem):
+        ki = pl.program_id(1)
+        _fused_decode_kernel(
+            pt_ref, len_ref, q_ref, knew_ref, vnew_ref,
+            k_hbm.at[ki], v_hbm.at[ki], o_ref, k_out.at[ki], v_out.at[ki],
+            k_scr, v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem,
+            page_size=ps, sm_scale=hd**-0.5,
+        )
+
+    out, k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kh, n_rep_p, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # +2: indices count the scalar-prefetch operands; pools alias so the
+        # page write happens in the caller's buffers, no pool copy
+        input_output_aliases={5: 1, 6: 2},
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      qg, knew, vnew, k_pages, v_pages)
+    return out[:, :, :n_rep].reshape(b, h, hd), k_pages, v_pages
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

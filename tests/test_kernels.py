@@ -59,3 +59,41 @@ def test_use_flash_prefill_gate():
     assert not _use_flash_prefill(2048, 80)  # unaligned head dim
     # on the CPU test backend the long-seq gate must still say no
     assert not _use_flash_prefill(2048, 128)
+
+
+def test_fused_decode_matches_scatter_plus_xla():
+    """The write-fused ragged decode kernel (interpret mode) must produce
+    the same attention output AND the same pool contents as the XLA
+    scatter + gather fallback."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_pallas_fused,
+        paged_decode_xla,
+    )
+
+    b, h, kh, hd, ps, n_pages = 2, 4, 4, 128, 16, 12
+    rng = jax.random.split(jax.random.PRNGKey(0), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
+    # row 0: 29 tokens live (pos 28 = page 1, off 12 -> RMW window start 8);
+    # row 1: 5 tokens (off 4 -> window start 0) — covers both w0 cases
+    tables = jnp.asarray([[3, 5, 7], [9, 0, 0]], jnp.int32)
+    kv_lens = jnp.asarray([29, 5], jnp.int32)
+
+    # reference: XLA scatter of the new token, then gather-attend
+    pos = kv_lens - 1
+    page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
+    off = pos % ps
+    k_ref = k_pages.at[:, page, off].set(k_new.transpose(1, 0, 2))
+    v_ref = v_pages.at[:, page, off].set(v_new.transpose(1, 0, 2))
+    want = paged_decode_xla(q, k_ref, v_ref, tables, kv_lens)
+
+    got, k_out, v_out = paged_decode_pallas_fused(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
+    np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
