@@ -73,12 +73,12 @@ def _ragged_decode_kernel(
     # output
     o_ref,            # VMEM [1, n_rep, hd]
     # scratch
-    k_scr,            # VMEM [ps, hd]
-    v_scr,            # VMEM [ps, hd]
+    k_scr,            # VMEM [2, ps, hd] double-buffered
+    v_scr,            # VMEM [2, ps, hd]
     acc_scr,          # VMEM [n_rep, hd] f32
     m_scr,            # VMEM [n_rep, 128] f32
     l_scr,            # VMEM [n_rep, 128] f32
-    sem,              # DMA semaphores (2,)
+    sem,              # DMA semaphores (2, 2): [buffer parity, k/v]
     *,
     page_size: int,
     sm_scale: float,
@@ -92,15 +92,27 @@ def _ragged_decode_kernel(
     acc_scr[:] = jnp.zeros_like(acc_scr)
     q = q_ref[0].astype(jnp.float32)  # [n_rep, hd]
 
-    def body(p, _):
+    def fetch(p, slot):
         page = page_tables_ref[b, p]
-        ck = pltpu.make_async_copy(k_hbm.at[page], k_scr, sem.at[0])
-        cv = pltpu.make_async_copy(v_hbm.at[page], v_scr, sem.at[1])
-        ck.start()
-        cv.start()
-        ck.wait()
-        cv.wait()
-        k = k_scr[:].astype(jnp.float32)  # [ps, hd]
+        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sem.at[slot, 0]).start()
+        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sem.at[slot, 1]).start()
+
+    @pl.when(n_pages > 0)
+    def _prime():
+        fetch(0, 0)
+
+    def body(p, _):
+        slot = jax.lax.rem(p, 2)
+
+        # overlap: next page's DMA streams while this page computes
+        @pl.when(p + 1 < n_pages)
+        def _prefetch():
+            fetch(p + 1, jax.lax.rem(p + 1, 2))
+
+        page = page_tables_ref[b, p]
+        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sem.at[slot, 1]).wait()
+        k = k_scr[slot].astype(jnp.float32)  # [ps, hd]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [n_rep, ps]
@@ -117,7 +129,7 @@ def _ragged_decode_kernel(
         l_scr[:] = jnp.broadcast_to(
             alpha * l_scr[:, :1] + jnp.sum(pw, axis=1, keepdims=True), l_scr.shape
         )
-        vv = v_scr[:].astype(jnp.float32)
+        vv = v_scr[slot].astype(jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             pw, vv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -231,14 +243,14 @@ def paged_decode_pallas_fused(
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((ps, hd), k_pages.dtype),
-            pltpu.VMEM((ps, hd), v_pages.dtype),
+            pltpu.VMEM((2, ps, hd), k_pages.dtype),  # double-buffered pages
+            pltpu.VMEM((2, ps, hd), v_pages.dtype),
             pltpu.VMEM((n_rep_p, hd), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.VMEM((8, hd), k_pages.dtype),
             pltpu.VMEM((8, hd), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
@@ -302,12 +314,12 @@ def paged_decode_pallas(
         ],
         out_specs=pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((ps, hd), k_pages.dtype),
-            pltpu.VMEM((ps, hd), v_pages.dtype),
+            pltpu.VMEM((2, ps, hd), k_pages.dtype),  # double-buffered pages
+            pltpu.VMEM((2, ps, hd), v_pages.dtype),
             pltpu.VMEM((n_rep_p, hd), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
 
