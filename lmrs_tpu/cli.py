@@ -27,6 +27,7 @@ from lmrs_tpu.config import (
     MeshConfig,
     PipelineConfig,
     ReduceConfig,
+    parse_mesh,
 )
 from lmrs_tpu.pipeline import TranscriptSummarizer
 from lmrs_tpu.utils.logging import setup_logging
@@ -76,11 +77,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def config_from_args(args: argparse.Namespace) -> PipelineConfig:
-    mesh = MeshConfig()
-    if args.mesh:
-        dims = [int(x) for x in args.mesh.split(",")]
-        dims += [1] * (4 - len(dims))
-        mesh = MeshConfig(dp=dims[0], tp=dims[1], sp=dims[2], pp=dims[3])
+    mesh = parse_mesh(args.mesh) if args.mesh else MeshConfig()
     engine = EngineConfig()
     if args.backend:
         engine = dataclasses.replace(engine, backend=args.backend)

@@ -194,6 +194,16 @@ class PipelineConfig:
         return dataclasses.asdict(self)
 
 
+def parse_mesh(spec: str) -> "MeshConfig":
+    """``"dp,tp[,sp[,pp]]"`` → MeshConfig (shared by the lmrs/lmrs-train
+    CLIs so the axis order can't drift between them)."""
+    dims = [int(x) for x in spec.split(",")]
+    if not 1 <= len(dims) <= 4:
+        raise ValueError(f"mesh spec {spec!r}: expected 1-4 axes dp,tp[,sp[,pp]]")
+    dims += [1] * (4 - len(dims))
+    return MeshConfig(dp=dims[0], tp=dims[1], sp=dims[2], pp=dims[3])
+
+
 def model_preset(name: str) -> ModelConfig:
     """Named model configurations (L3 model zoo presets)."""
     presets: dict[str, dict] = {

@@ -50,6 +50,7 @@ def make_train_step(
     mesh: Mesh | None = None,
     seq_sharded: bool = False,
     remat: bool = False,
+    masked: bool = False,
 ):
     """Build a jitted (params, opt_state, tokens) -> (params, opt_state, loss)
     step.  With a mesh: params tensor-parallel, batch over dp; when
@@ -66,9 +67,10 @@ def make_train_step(
         def attn_fn(q, k, v, positions):
             return ring_attention_sharded(q, k, v, positions, mesh)
 
-    def step(params, opt_state, tokens):
+    def step(params, opt_state, tokens, loss_mask=None):
         loss, grads = jax.value_and_grad(causal_lm_loss)(
-            params, cfg, tokens, attn_fn=attn_fn, remat=remat)
+            params, cfg, tokens, loss_mask=loss_mask, attn_fn=attn_fn,
+            remat=remat)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -78,11 +80,12 @@ def make_train_step(
 
     pspecs = param_shardings(mesh, cfg.tie_embeddings, moe=cfg.n_experts > 0)
     batch_sh = NamedSharding(mesh, batch_spec(seq_sharded))
+    in_sh = [pspecs, None, batch_sh] + ([batch_sh] if masked else [])
     # opt_state sharding left unconstrained: XLA propagates the param layout
     # into the optimizer tree (adam mu/nu mirror the params).
     return jax.jit(
         step,
-        in_shardings=(pspecs, None, batch_sh),
+        in_shardings=tuple(in_sh),
         out_shardings=(pspecs, None, None),
         donate_argnums=(0, 1),
     )

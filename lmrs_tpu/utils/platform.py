@@ -3,7 +3,18 @@
 
 from __future__ import annotations
 
+import os
+
 import jax
+
+
+def honor_platform_env() -> None:
+    """Some hosts' sitecustomize force-registers an accelerator backend
+    (jax.config.update("jax_platforms", ...)), silently overriding the
+    standard JAX_PLATFORMS env var; re-apply an explicit cpu request.
+    Call before the first backend use."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
 
 def on_tpu() -> bool:
