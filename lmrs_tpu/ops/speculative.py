@@ -22,6 +22,13 @@ serving-stack surface with no reference counterpart.
 
 Everything here is trace-friendly (static k, where-masks, no data-dependent
 shapes) so it runs inside the scheduler's on-device decode block scan.
+
+Cost note: the verify forward currently runs through forward_paged's
+windowed-attention path, which gathers the page window per layer per step —
+fine at moderate windows, but the dominant cost for very long contexts.  A
+multi-query extension of the ragged decode kernel (per-query-row position
+limits) would remove that gather; until then prefer speculation for
+short/medium-context, repetitive workloads where acceptance is high.
 """
 
 from __future__ import annotations
