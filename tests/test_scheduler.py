@@ -180,3 +180,12 @@ def test_tp_sharded_continuous_serving_matches_single_device():
     got = [r.text for r in tp.generate_batch(reqs)]
     tp.shutdown()
     assert got == want
+
+
+def test_pow2_bucket():
+    from lmrs_tpu.engine.scheduler import _pow2_bucket
+
+    assert _pow2_bucket(64, 64) == 64
+    assert _pow2_bucket(65, 64) == 128
+    for n in (1, 64, 100, 1000, 2049, 4096):
+        assert _pow2_bucket(n, 64) >= n
