@@ -481,6 +481,34 @@ class ContinuousScheduler:
 
     def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k, top_p):
         w, table = self._decode_window(slots, self.decode_block)
+        B = self.B
+        # Compact-batch drain: the decode program's cost scales with its
+        # batch dim even for masked rows, so when few slots are live (queue
+        # drained, reduce-tree tails) gather the live rows into one fixed
+        # 8-row batch and scatter results back.  bc is pinned to 8 — exactly
+        # one extra compiled shape per window; a pow2 ladder of compact
+        # sizes would thrash multi-second runtime compiles (see the
+        # quarter-step bucket NOTE above).
+        rows = np.flatnonzero(active)
+        bc = 8 if (B > 8 and len(rows) <= 8) else B
+        if bc < B:
+            n = len(rows)
+            c_tok = np.zeros((bc,), np.int32)
+            c_len = np.zeros((bc,), np.int32)
+            c_act = np.zeros((bc,), bool)
+            c_tab = np.zeros((bc, w), np.int32)  # pad rows: null page table
+            c_tmp = np.zeros((bc,), np.float32)
+            c_tk = np.zeros((bc,), np.int32)
+            c_tp = np.ones((bc,), np.float32)
+            c_tok[:n] = last_tok[rows]
+            c_len[:n] = kv_lens[rows]
+            c_act[:n] = True
+            c_tab[:n] = table[rows, :w]
+            c_tmp[:n] = temps[rows]
+            c_tk[:n] = top_k[rows]
+            c_tp[:n] = top_p[rows]
+            last_tok, kv_lens, active = c_tok, c_len, c_act
+            table, temps, top_k, top_p = c_tab, c_tmp, c_tk, c_tp
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
@@ -496,17 +524,24 @@ class ContinuousScheduler:
             # execution, so args are still valid).  A failure after a shape
             # has run successfully is a real runtime error: re-raise rather
             # than retrying against possibly-donated buffers.
-            if not self._use_ragged or ("decode", w) in self._ran_ok:
+            if not self._use_ragged or ("decode", bc, w) in self._ran_ok:
                 raise
             logger.warning("ragged decode kernel failed to lower; "
                            "falling back to XLA paged decode", exc_info=True)
             self._use_ragged = False
             self._decode_fns.clear()
             out = self._get_decode_fn(w)(*args)
-        self._ran_ok.add(("decode", w))
+        self._ran_ok.add(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
         toks, n_valid = jax.device_get((toks, n_valid))  # one transfer
-        return np.asarray(toks), np.asarray(n_valid)
+        toks, n_valid = np.asarray(toks), np.asarray(n_valid)
+        if bc < B:  # scatter compact results back to full-width slot arrays
+            full_t = np.zeros((B, toks.shape[1]), toks.dtype)
+            full_n = np.zeros((B,), n_valid.dtype)
+            full_t[rows] = toks[: len(rows)]
+            full_n[rows] = n_valid[: len(rows)]
+            return full_t, full_n
+        return toks, n_valid
 
     def _get_decode_fn(self, w: int):
         if w in self._decode_fns:

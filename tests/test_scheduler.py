@@ -189,3 +189,22 @@ def test_pow2_bucket():
     assert _pow2_bucket(65, 64) == 128
     for n in (1, 64, 100, 1000, 2049, 4096):
         assert _pow2_bucket(n, 64) >= n
+
+
+def test_compact_batch_drain_matches_full():
+    """With few live slots the decode dispatch compacts to a small batch;
+    greedy output must be identical to a small-B engine."""
+    mc = tiny_model()
+    reqs = [GenerationRequest(prompt=f"compact drain probe {i}", request_id=i,
+                              temperature=0.0, max_new_tokens=10)
+            for i in range(2)]
+    small = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                   max_tokens=10, max_batch_slots=2, seed=0), mc)
+    want = [r.text for r in small.generate_batch(reqs)]
+    small.shutdown()
+
+    wide = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                  max_tokens=10, max_batch_slots=16, seed=0), mc)
+    got = [r.text for r in wide.generate_batch(reqs)]
+    wide.shutdown()
+    assert got == want
