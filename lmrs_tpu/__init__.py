@@ -26,8 +26,10 @@ from lmrs_tpu.config import (
     DataConfig,
     EngineConfig,
     MeshConfig,
+    ModelConfig,
     PipelineConfig,
     ReduceConfig,
+    model_preset,
 )
 from lmrs_tpu.pipeline import TranscriptSummarizer
 
@@ -36,8 +38,10 @@ __all__ = [
     "DataConfig",
     "EngineConfig",
     "MeshConfig",
+    "ModelConfig",
     "PipelineConfig",
     "ReduceConfig",
     "TranscriptSummarizer",
+    "model_preset",
     "__version__",
 ]
