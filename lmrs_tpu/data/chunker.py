@@ -338,3 +338,21 @@ class TranscriptChunker:
             for i in range(0, len(words), 20):
                 out.append(" ".join(words[i : i + 20]))
         return [c for c in out if c]
+
+
+if __name__ == "__main__":  # stage demo (pattern: big_chunkeroosky.py:570-606)
+    from lmrs_tpu.data.preprocessor import preprocess_transcript
+    from lmrs_tpu.utils.demo import load_demo_transcript
+
+    segs = preprocess_transcript(load_demo_transcript()["segments"])
+    chunker = TranscriptChunker()
+    chunks = chunker.postprocess_chunks(chunker.chunk_transcript(segs))
+    print(f"{len(segs)} segments -> {len(chunks)} chunks")
+    for c in chunks[:3]:
+        print(f"  chunk {c.chunk_index}/{c.total_chunks}: {c.token_count} tok, "
+              f"{c.start_time:.0f}-{c.end_time:.0f}s, pos {c.position_percentage:.1f}%")
+    if chunks:
+        print("--- context header of chunk 0 ---")
+        header = chunks[0].text_with_context[: len(chunks[0].text_with_context)
+                                             - len(chunks[0].text)]
+        print(header.strip()[:400])

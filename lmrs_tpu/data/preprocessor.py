@@ -219,3 +219,16 @@ def get_transcript_duration(segments: list[Segment]) -> float:
     if not segments:
         return 0.0
     return max(s["end"] for s in segments) - min(s["start"] for s in segments)
+
+
+if __name__ == "__main__":  # stage demo (pattern: preprocessor.py:364-441)
+    from lmrs_tpu.utils.demo import load_demo_transcript
+
+    segs = load_demo_transcript()["segments"]
+    out = preprocess_transcript(segs)
+    print(f"segments in : {len(segs)}")
+    print(f"segments out: {len(out)} (merge ratio {len(out) / max(len(segs), 1):.3f})")
+    print(f"speakers    : {extract_speakers(out)}")
+    print(f"duration    : {get_transcript_duration(out) / 3600:.2f} h")
+    if out:
+        print(f"first merged segment:\n  {out[0]['text'][:300]}")

@@ -178,3 +178,20 @@ class MapExecutor:
             "total_requests": self.total_requests,
             "failed_requests": self.failed_requests,
         }
+
+
+if __name__ == "__main__":  # stage demo (pattern: llm_executor.py:460-509)
+    from lmrs_tpu.data.chunker import TranscriptChunker
+    from lmrs_tpu.data.preprocessor import preprocess_transcript
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.prompts import resolve_map_prompt
+    from lmrs_tpu.utils.demo import load_demo_transcript
+
+    segs = preprocess_transcript(load_demo_transcript(max_segments=400)["segments"])
+    chunker = TranscriptChunker()
+    chunks = chunker.postprocess_chunks(chunker.chunk_transcript(segs))[:3]
+    executor = MapExecutor(MockEngine())
+    executor.process_chunks(chunks, resolve_map_prompt())
+    for c in chunks:
+        print(f"chunk {c.chunk_index}: {c.summary[:160]}")
+    print(f"stats: {executor.stats()}")

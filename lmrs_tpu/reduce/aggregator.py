@@ -242,3 +242,24 @@ class SimpleAggregator:
 
 # backwards-compat alias (tests import it from here)
 _safe_format = safe_format
+
+
+if __name__ == "__main__":  # stage demo (pattern: result_aggregator.py:527-583)
+    from lmrs_tpu.engine.mock import MockEngine
+
+    chunks = [
+        Chunk(segments=[], text="", token_count=0, start_time=i * 600.0,
+              end_time=(i + 1) * 600.0, speakers=["SPEAKER_00"], chunk_index=i,
+              total_chunks=12,
+              summary=f"Summary {i}: the team reviewed milestone {i} of the "
+                      f"inference roadmap and assigned follow-ups.")
+        for i in range(12)
+    ]
+    executor = MapExecutor(MockEngine())
+    # small budgets so 12 summaries genuinely form a 2-level tree
+    # (reserve left at default would make the batch budget negative)
+    agg = ResultAggregator(
+        executor, ReduceConfig(max_tokens_per_batch=250, reserve_tokens=50))
+    result = agg.aggregate(chunks)
+    print(f"hierarchical: {result['hierarchical']} (levels={result['levels']})")
+    print(result["final_summary"][:400])
