@@ -1,0 +1,12 @@
+"""HTTP serving front-end.
+
+The reference sits on the CLIENT side of the OpenAI/Anthropic HTTP APIs
+(llm_executor.py:250-409).  This package provides the SERVER side of those
+same wire formats over the in-tree TPU engine, so reference-style clients
+(including the reference itself, pointed at this base URL) run against the
+pod unchanged.
+"""
+
+from lmrs_tpu.serving.server import EngineHTTPServer, serve
+
+__all__ = ["EngineHTTPServer", "serve"]

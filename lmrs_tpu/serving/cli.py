@@ -1,0 +1,87 @@
+"""``lmrs-serve``: stand up the OpenAI/Anthropic-compatible server.
+
+Inverts the reference's deployment: instead of the summarizer calling out to
+``api.openai.com`` (llm_executor.py:292), any OpenAI/Anthropic-format client
+calls in to the TPU pod.
+
+    lmrs-serve --backend mock --port 8000
+    lmrs-serve --backend jax --model gemma-2b --mesh dp2,tp4 --port 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from lmrs_tpu.config import EngineConfig, parse_mesh
+from lmrs_tpu.engine.api import make_engine
+from lmrs_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("lmrs.serving")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lmrs-serve",
+        description="OpenAI/Anthropic-wire-compatible HTTP server over the "
+                    "in-tree TPU engine",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--backend", default="mock", choices=["mock", "jax"])
+    p.add_argument("--model", default="tiny", help="model preset name")
+    p.add_argument("--mesh", default=None, help="e.g. dp2,tp4 (jax backend)")
+    p.add_argument("--checkpoint", default=None, help="Orbax checkpoint dir")
+    p.add_argument("--quantize", default=None, choices=["int8"])
+    p.add_argument("--batch-slots", type=int, default=8,
+                   help="continuous-batching decode slots")
+    p.add_argument("--max-tokens-cap", type=int, default=4096,
+                   help="upper bound on any request's max_tokens")
+    p.add_argument("--batch-window-ms", type=float, default=20.0,
+                   help="micro-batching window for pooling concurrent requests")
+    p.add_argument("--quiet", "-q", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(quiet=args.quiet)
+    engine_cfg = EngineConfig(
+        backend=args.backend,
+        model=args.model,
+        max_batch_slots=args.batch_slots,
+        checkpoint_path=args.checkpoint,
+        quantize=args.quantize,
+        max_tokens=args.max_tokens_cap,
+    )
+    mesh_cfg = parse_mesh(args.mesh) if args.mesh else None
+    try:
+        engine = make_engine(engine_cfg, mesh_cfg=mesh_cfg)
+    except ValueError as e:
+        logger.error("engine init failed: %s", e)
+        return 1
+
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    try:
+        server = EngineHTTPServer(
+            engine, host=args.host, port=args.port, model_name=args.model,
+            max_tokens_cap=args.max_tokens_cap,
+            batch_window_s=args.batch_window_ms / 1000.0,
+        )
+    except OSError as e:
+        logger.error("cannot bind %s:%d: %s", args.host, args.port, e)
+        engine.shutdown()
+        return 1
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        server.shutdown()
+        engine.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,309 @@
+"""OpenAI/Anthropic-wire-compatible HTTP server over the in-tree engine.
+
+Endpoints (the two wire formats the reference's clients speak):
+
+* ``POST /v1/chat/completions`` — OpenAI chat completions
+  (request shape: llm_executor.py:278-289; response fields the reference
+  reads: choices[0].message.content + usage, llm_executor.py:304-317);
+* ``POST /v1/messages`` — Anthropic messages (request: llm_executor.py:343-371
+  modulo its system-role bug, SURVEY.md §2.3.7; response fields read:
+  content[0].text + usage, llm_executor.py:389-400);
+* ``GET /v1/models``, ``GET /healthz``, ``GET /metrics``.
+
+Concurrent requests micro-batch: a dispatcher thread drains the queue and
+hands the whole wave to ``Engine.generate_batch`` — a reference-style client
+fanning out N requests under its semaphore gets them pooled into one engine
+wave instead of N serialized ones (continuous batching across HTTP clients).
+
+stdlib only (``http.server``): the serving runtime must not pull in an async
+web framework this image doesn't have.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+
+logger = logging.getLogger("lmrs.serving")
+
+
+class _Job:
+    __slots__ = ("request", "result", "event")
+
+    def __init__(self, request: GenerationRequest):
+        self.request = request
+        self.result: GenerationResult | None = None
+        self.event = threading.Event()
+
+
+class _Batcher:
+    """Micro-batching dispatcher: collect jobs for up to ``window_s`` (or
+    ``max_batch``), run them as ONE ``generate_batch`` call."""
+
+    def __init__(self, engine: Engine, window_s: float = 0.02, max_batch: int = 256):
+        self.engine = engine
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.queue: queue.Queue[_Job | None] = queue.Queue()
+        self.batches_run = 0
+        self.requests_served = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, request: GenerationRequest) -> GenerationResult:
+        job = _Job(request)
+        self.queue.put(job)
+        job.event.wait()
+        assert job.result is not None
+        return job.result
+
+    def shutdown(self) -> None:
+        self.queue.put(None)
+        self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while True:
+            job = self.queue.get()
+            if job is None:
+                return
+            jobs = [job]
+            deadline = time.monotonic() + self.window_s
+            while len(jobs) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self.queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._run(jobs)
+                    return
+                jobs.append(nxt)
+            self._run(jobs)
+
+    def _run(self, jobs: list[_Job]) -> None:
+        for i, job in enumerate(jobs):  # engine results map back by id
+            job.request.request_id = i
+        try:
+            results = self.engine.generate_batch([j.request for j in jobs])
+        except Exception as e:  # degrade, never kill the dispatcher
+            logger.exception("engine batch failure")
+            results = [
+                GenerationResult(request_id=i, finish_reason="error", error=str(e))
+                for i in range(len(jobs))
+            ]
+        self.batches_run += 1
+        self.requests_served += len(jobs)
+        by_id = {r.request_id: r for r in results}
+        for i, job in enumerate(jobs):
+            job.result = by_id.get(
+                i, GenerationResult(request_id=i, finish_reason="error",
+                                    error="engine returned no result"))
+            job.event.set()
+
+
+def _clamp_max_tokens(value, cap: int) -> int:
+    """0 is a real request for zero completion tokens — only None defaults."""
+    n = 1000 if value is None else int(value)
+    return min(max(n, 0), cap)
+
+
+def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
+    """OpenAI ``messages`` → one GenerationRequest.  System messages join the
+    system prompt; the rest concatenate in order with role tags (multi-turn
+    becomes a single serving prompt — same collapse the reference performs in
+    reverse when it wraps one prompt as a messages array)."""
+    system_parts, user_parts = [], []
+    for msg in body.get("messages", []):
+        role = msg.get("role", "user")
+        content = msg.get("content", "")
+        if isinstance(content, list):  # content-blocks form
+            content = "".join(
+                blk.get("text", "") for blk in content if isinstance(blk, dict))
+        if role == "system":
+            system_parts.append(content)
+        elif role == "user" or role == "tool":
+            user_parts.append(content)
+        else:  # assistant turns are context for the next user turn
+            user_parts.append(f"[assistant]: {content}")
+    stop = body.get("stop") or body.get("stop_sequences") or ()
+    if isinstance(stop, str):
+        stop = (stop,)
+    return GenerationRequest(
+        prompt="\n\n".join(user_parts),
+        system_prompt="\n\n".join(system_parts) or None,
+        max_new_tokens=_clamp_max_tokens(body.get("max_tokens"),
+                                         max_tokens_cap),
+        temperature=float(body.get("temperature", 0.3)),
+        top_p=float(body.get("top_p", 1.0)),
+        stop=tuple(stop),
+        seed=body.get("seed"),
+    )
+
+
+def _messages_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
+    """Anthropic messages → GenerationRequest (top-level ``system`` field —
+    the real API shape, not the reference's system-role-in-messages bug)."""
+    user_parts = []
+    for msg in body.get("messages", []):
+        content = msg.get("content", "")
+        if isinstance(content, list):
+            content = "".join(
+                blk.get("text", "") for blk in content if isinstance(blk, dict))
+        role = msg.get("role", "user")
+        user_parts.append(content if role == "user" else f"[assistant]: {content}")
+    return GenerationRequest(
+        prompt="\n\n".join(user_parts),
+        system_prompt=body.get("system") or None,
+        max_new_tokens=_clamp_max_tokens(body.get("max_tokens"),
+                                         max_tokens_cap),
+        temperature=float(body.get("temperature", 0.3)),
+        top_p=float(body.get("top_p", 1.0)),
+        stop=tuple(body.get("stop_sequences") or ()),
+    )
+
+
+class EngineHTTPServer:
+    """Threaded HTTP server bound to an Engine via the micro-batcher."""
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8000,
+                 model_name: str = "lmrs-tpu", max_tokens_cap: int = 4096,
+                 batch_window_s: float = 0.02):
+        self.engine = engine
+        self.model_name = model_name
+        self.max_tokens_cap = max_tokens_cap
+        self.batcher = _Batcher(engine, window_s=batch_window_s)
+        self.started = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through our logger
+                logger.debug("%s " + fmt, self.address_string(), *args)
+
+            def _send(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _read_json(self) -> dict | None:
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    return json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return None
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"status": "ok",
+                                     "uptime_s": round(time.time() - outer.started, 1)})
+                elif self.path == "/v1/models":
+                    self._send(200, {"object": "list", "data": [
+                        {"id": outer.model_name, "object": "model",
+                         "owned_by": "lmrs-tpu"}]})
+                elif self.path == "/metrics":
+                    self._send(200, {
+                        "engine": outer.engine.engine_metrics(),
+                        "http_batches": outer.batcher.batches_run,
+                        "http_requests": outer.batcher.requests_served,
+                    })
+                else:
+                    self._send(404, {"error": {"message": f"no route {self.path}"}})
+
+            def do_POST(self):
+                body = self._read_json()
+                if body is None:
+                    self._send(400, {"error": {"message": "invalid JSON body"}})
+                    return
+                try:
+                    if self.path == "/v1/chat/completions":
+                        req = _chat_to_request(body, outer.max_tokens_cap)
+                        res = outer.batcher.submit(req)
+                        self._respond_openai(body, res)
+                    elif self.path == "/v1/messages":
+                        req = _messages_to_request(body, outer.max_tokens_cap)
+                        res = outer.batcher.submit(req)
+                        self._respond_anthropic(body, res)
+                    else:
+                        self._send(404, {"error": {"message": f"no route {self.path}"}})
+                except Exception as e:
+                    logger.exception("request handling failed")
+                    self._send(500, {"error": {"message": str(e)}})
+
+            def _respond_openai(self, body: dict, res: GenerationResult) -> None:
+                if res.error is not None:
+                    self._send(500, {"error": {"message": res.error,
+                                               "type": "engine_error"}})
+                    return
+                self._send(200, {
+                    "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+                    "object": "chat.completion",
+                    "created": int(time.time()),
+                    "model": body.get("model") or outer.model_name,
+                    "choices": [{
+                        "index": 0,
+                        "message": {"role": "assistant", "content": res.text},
+                        "finish_reason": res.finish_reason,
+                    }],
+                    "usage": {
+                        "prompt_tokens": res.prompt_tokens,
+                        "completion_tokens": res.completion_tokens,
+                        "total_tokens": res.total_tokens,
+                    },
+                })
+
+            def _respond_anthropic(self, body: dict, res: GenerationResult) -> None:
+                if res.error is not None:
+                    self._send(500, {"type": "error",
+                                     "error": {"type": "api_error",
+                                               "message": res.error}})
+                    return
+                self._send(200, {
+                    "id": f"msg_{uuid.uuid4().hex[:24]}",
+                    "type": "message",
+                    "role": "assistant",
+                    "model": body.get("model") or outer.model_name,
+                    "content": [{"type": "text", "text": res.text}],
+                    "stop_reason": ("end_turn" if res.finish_reason == "stop"
+                                    else "max_tokens"),
+                    "usage": {"input_tokens": res.prompt_tokens,
+                              "output_tokens": res.completion_tokens},
+                })
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self.httpd.server_address[:2]
+
+    def serve_forever(self) -> None:
+        logger.info("serving on http://%s:%d (model=%s)",
+                    self.host, self.port, self.model_name)
+        self.httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.batcher.shutdown()
+
+
+def serve(engine: Engine, host: str = "127.0.0.1", port: int = 8000,
+          **kw) -> EngineHTTPServer:
+    """Build + start (foreground).  Returns on shutdown()."""
+    server = EngineHTTPServer(engine, host, port, **kw)
+    server.serve_forever()
+    return server
