@@ -25,6 +25,7 @@ reused for the whole run.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -97,6 +98,13 @@ class ContinuousScheduler:
         self.decode_steps = (max(1, self.decode_block // (self.spec_k + 1))
                              if self.spec_k else self.decode_block)
         self.prefill_chunk = max(64, engine_cfg.prefill_chunk)
+        # Defer the prefill first-token fetch into the decode block's
+        # transfer (one fewer host RTT per admission wave).  Tradeoff: a
+        # request finishing ON its first token (tok0==EOS, or max_new<=1)
+        # burns one decode-block dispatch whose tokens are trimmed — rare
+        # for summarization workloads.  LMRS_DEFER_TOK0=0 restores the
+        # synchronous fetch for A/B measurement.
+        self.defer_tok0 = os.environ.get("LMRS_DEFER_TOK0", "1") != "0"
         ps = engine_cfg.page_size
         max_pages_per_slot = -(-self.max_len // ps)
         # pool sized so every slot can hold a full-length sequence, or the
@@ -107,7 +115,7 @@ class ContinuousScheduler:
         self._use_ragged = self._pick_kernel()
         # flash prefill: single-device only (same pallas-under-mesh limit as
         # the ragged gate above); also cleared if lowering fails at runtime
-        self._use_flash = mesh is None
+        self._use_flash = self._single_device()
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
@@ -152,10 +160,14 @@ class ContinuousScheduler:
             # single device (under a mesh, XLA auto-partitioning of the
             # pallas_call is not supported — the gather fallback shards fine);
             # the fused write RMWs an 8-row-aligned DMA window, which only
-            # stays inside the page when the page size is a multiple of 8
+            # stays inside the page when the page size is a multiple of 8.
+            # A 1-device mesh (a pinned DP replica) is fine: no partitioning.
             return (on_tpu() and self.model_cfg.hd % 128 == 0
-                    and self.cfg.page_size % 8 == 0 and self.mesh is None)
+                    and self.cfg.page_size % 8 == 0 and self._single_device())
         return False
+
+    def _single_device(self) -> bool:
+        return self.mesh is None or self.mesh.devices.size == 1
 
     # ----------------------------------------------------------- public API
 
@@ -221,19 +233,36 @@ class ContinuousScheduler:
             # advance every prefilling slot by ONE prompt chunk, then give
             # decode a turn — long prompts never monopolize the device.
             # Same-shape chunks batch into one dispatch (a [N,S] prefill
-            # feeds the MXU far better than N serialized [1,S] programs),
-            # and all first tokens come back in ONE device_get: each extra
-            # host-link round trip costs a full RTT.
-            for b, tok0 in self._advance_prefills(slots):
-                st = slots[b]
-                st.phase = "decode"
-                st.kv_len = len(st.prompt_ids)
-                st.generated.append(tok0)
-                last_tok[b] = tok0
-                kv_lens[b] = st.kv_len
-                active[b] = True
-                self.seed_history(b, st)
-                self._maybe_finish(b, slots, results, active)
+            # feeds the MXU far better than N serialized [1,S] programs).
+            # First tokens are NOT fetched here: every host bookkeeping step
+            # except generated.append(tok0) is tok0-independent, so tok0
+            # stays on device, is scattered into the decode dispatch's
+            # last_tok input, and rides back in the decode block's single
+            # device_get — one fewer ~full-RTT host sync per admission wave.
+            pending = self._advance_prefills(slots)
+            deferred: list[tuple[int, int, int]] = []  # (slot, pend idx, row)
+            for p, (tok0_dev, rows) in enumerate(pending):
+                for b, row in rows:
+                    st = slots[b]
+                    st.phase = "decode"
+                    st.kv_len = len(st.prompt_ids)
+                    kv_lens[b] = st.kv_len
+                    active[b] = True
+                    deferred.append((b, p, row))
+            if pending and (self.spec_k or not self.defer_tok0):
+                # speculation seeds a host-built history row per admission —
+                # it needs tok0 values now, so it keeps the synchronous
+                # fetch (also selectable via LMRS_DEFER_TOK0=0 for A/B runs)
+                fetched = jax.device_get([t for t, _ in pending])
+                for (b, p, row) in deferred:
+                    st = slots[b]
+                    tok0 = int(fetched[p][row])
+                    st.generated.append(tok0)
+                    last_tok[b] = tok0
+                    self.seed_history(b, st)
+                    self._maybe_finish(b, slots, results, active)
+                deferred = []
+                pending = []
             if not any(active):
                 continue
             self.metrics["occupancy_sum"] += float(np.mean(active))
@@ -242,8 +271,13 @@ class ContinuousScheduler:
                 emitted = self._spec_decode_block(
                     slots, last_tok, kv_lens, active, temps, top_k, top_p)
             else:
-                toks, n_valid = self._decode_block(
-                    slots, last_tok, kv_lens, active, temps, top_k, top_p)
+                toks, n_valid, tok0s = self._decode_block(
+                    slots, last_tok, kv_lens, active, temps, top_k, top_p,
+                    pending)
+                for (b, p, row) in deferred:
+                    tok0 = int(tok0s[p][row])
+                    slots[b].generated.append(tok0)
+                    last_tok[b] = tok0
                 emitted = [toks[b, : int(n_valid[b])].tolist()
                            for b in range(self.B)]
             for b in range(self.B):
@@ -306,9 +340,13 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------- prefill
 
-    def _advance_prefills(self, slots) -> list[tuple[int, int]]:
+    def _advance_prefills(self, slots) -> list[tuple[object, list[tuple[int, int]]]]:
         """Advance every prefilling slot by one prompt chunk and return
-        [(slot, first_token)] for the slots whose whole prompt is now in KV.
+        [(tok0_device_array, [(slot, row)])] for the slots whose whole prompt
+        is now in KV.  The first-token arrays are NOT fetched — the caller
+        threads them into the decode dispatch and fetches them with the
+        decode block's own transfer (each device_get on a tunneled chip
+        costs a full host-link RTT).
 
         Prompts that fit one chunk take the fresh-prefill program (attends
         the chunk directly); longer prompts run the windowed continuation
@@ -394,11 +432,7 @@ class ContinuousScheduler:
             if rows:
                 pending.append((tok0, rows))
 
-        if not pending:
-            return []
-        fetched = jax.device_get([t for t, _ in pending])  # one transfer
-        return [(b, int(t0[row])) for t0, (_, rows) in zip(fetched, pending)
-                for b, row in rows]
+        return pending
 
     def _get_prefill_fn(self, s_bucket: int):
         if s_bucket in self._prefill_fns:
@@ -479,7 +513,12 @@ class ContinuousScheduler:
         w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
         return w, self.cache.page_table_array(decode_seqs)
 
-    def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k, top_p):
+    def _decode_block(self, slots, last_tok, kv_lens, active, temps, top_k,
+                      top_p, pending=()):
+        """One decode-block dispatch.  ``pending`` carries unfetched
+        first-token arrays from this iteration's prefills: their values are
+        scattered into the ``last_tok`` input on device (no host sync) and
+        fetched together with the block's outputs in the one device_get."""
         w, table = self._decode_window(slots, self.decode_block)
         B = self.B
         # Compact-batch drain: the decode program's cost scales with its
@@ -488,9 +527,10 @@ class ContinuousScheduler:
         # 8-row batch and scatter results back.  bc is pinned to 8 — exactly
         # one extra compiled shape per window; a pow2 ladder of compact
         # sizes would thrash multi-second runtime compiles (see the
-        # quarter-step bucket NOTE above).
+        # quarter-step bucket NOTE above).  Skipped while prefill tok0s are
+        # pending: those live on device and the compact gather is host-side.
         rows = np.flatnonzero(active)
-        bc = 8 if (B > 8 and len(rows) <= 8) else B
+        bc = 8 if (B > 8 and len(rows) <= 8 and not pending) else B
         if bc < B:
             n = len(rows)
             c_tok = np.zeros((bc,), np.int32)
@@ -509,10 +549,15 @@ class ContinuousScheduler:
             c_tp[:n] = top_p[rows]
             last_tok, kv_lens, active = c_tok, c_len, c_act
             table, temps, top_k, top_p = c_tab, c_tmp, c_tk, c_tp
+        lt = jnp.asarray(last_tok)
+        for tok0_dev, prows in pending:  # on-device scatter, no host sync
+            idx = jnp.asarray(np.array([b for b, _ in prows], np.int32))
+            src = tok0_dev[jnp.asarray(np.array([r for _, r in prows], np.int32))]
+            lt = lt.at[idx].set(src)
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
-            jnp.asarray(last_tok), jnp.asarray(kv_lens),
+            lt, jnp.asarray(kv_lens),
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
@@ -533,15 +578,16 @@ class ContinuousScheduler:
             out = self._get_decode_fn(w)(*args)
         self._ran_ok.add(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
-        toks, n_valid = jax.device_get((toks, n_valid))  # one transfer
+        toks, n_valid, *tok0s = jax.device_get(  # one transfer
+            (toks, n_valid, *[t for t, _ in pending]))
         toks, n_valid = np.asarray(toks), np.asarray(n_valid)
         if bc < B:  # scatter compact results back to full-width slot arrays
             full_t = np.zeros((B, toks.shape[1]), toks.dtype)
             full_n = np.zeros((B,), n_valid.dtype)
             full_t[rows] = toks[: len(rows)]
             full_n[rows] = n_valid[: len(rows)]
-            return full_t, full_n
-        return toks, n_valid
+            return full_t, full_n, tok0s
+        return toks, n_valid, tok0s
 
     def _get_decode_fn(self, w: int):
         if w in self._decode_fns:
