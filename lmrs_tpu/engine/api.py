@@ -103,5 +103,11 @@ def make_engine(
             model_cfg == ModelConfig() and engine_cfg.model != model_cfg.name
         ):
             model_cfg = model_preset(engine_cfg.model)
+        if mesh_cfg is not None and mesh_cfg.dp > 1:
+            # dp>1 serving = independent replicas, not a dp mesh axis
+            # (engine/replicated.py module doc explains why)
+            from lmrs_tpu.engine.replicated import ReplicatedEngine
+
+            return ReplicatedEngine(engine_cfg, model_cfg, mesh_cfg)
         return JaxEngine(engine_cfg, model_cfg, mesh_cfg)
     raise ValueError(f"unknown engine backend {engine_cfg.backend!r}")

@@ -62,16 +62,20 @@ class JaxEngine:
         mesh_cfg: MeshConfig | None = None,
         params=None,
         tokenizer=None,
+        devices=None,
     ):
         self.cfg = engine_cfg
         self.model_cfg = model_cfg
         self.mesh_cfg = mesh_cfg
         self.tokenizer = tokenizer or self._default_tokenizer()
         self._mesh = None
-        if mesh_cfg is not None and mesh_cfg.n_devices > 1:
+        # An explicit device list always builds a mesh — even a 1-device one —
+        # so params/cache/dispatches PIN to those devices (a DP replica must
+        # not land on the process default device; engine/replicated.py).
+        if mesh_cfg is not None and (devices is not None or mesh_cfg.n_devices > 1):
             from lmrs_tpu.parallel.mesh import build_mesh
 
-            self._mesh = build_mesh(mesh_cfg)
+            self._mesh = build_mesh(mesh_cfg, devices)
         key = jax.random.PRNGKey(engine_cfg.seed)
         t0 = time.time()
         if params is None:

@@ -116,17 +116,20 @@ class PagedKVCache:
         dt = jnp.dtype(model_cfg.dtype)
         shape = (model_cfg.n_kv_heads, model_cfg.n_layers * num_pages,
                  page_size, hd)
-        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        if mesh is not None:
             # tensor-parallel serving: pages shard on the kv-head axis,
             # matching the wk/wv head sharding — each shard's attention and
-            # page writes stay local, no cross-chip KV traffic
+            # page writes stay local, no cross-chip KV traffic.  tp=1 still
+            # places on the mesh (replicated): a DP replica's cache must pin
+            # to ITS devices, not the process default device.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            if model_cfg.n_kv_heads % mesh.shape["tp"]:
+            tp = mesh.shape.get("tp", 1)
+            if tp > 1 and model_cfg.n_kv_heads % tp:
                 raise ValueError(
                     f"n_kv_heads={model_cfg.n_kv_heads} not divisible by "
-                    f"tp={mesh.shape['tp']}")
-            sh = NamedSharding(mesh, P("tp"))
+                    f"tp={tp}")
+            sh = NamedSharding(mesh, P("tp") if tp > 1 else P())
             self.k = jnp.zeros(shape, dt, device=sh)
             self.v = jnp.zeros(shape, dt, device=sh)
         else:

@@ -1,0 +1,155 @@
+"""Data-parallel serving: independent engine replicas over disjoint devices.
+
+SURVEY.md §2.2 row 1: the TPU-native equivalent of the reference's
+request-level fan-out is "continuous batching over DP replicas of the
+model".  Sharding decode's batch dim over a ``dp`` mesh axis would be the
+literal translation, but a paged KV cache has no meaningful batch axis to
+shard — the page pool and the host-side allocator are per-engine state.
+The TPU-idiomatic design is N fully independent engines, each with its own
+(tp × sp) sub-mesh, pool, and scheduler, fed round-robin from one queue:
+
+* within a replica: ICI collectives (TP) + continuous batching;
+* across replicas: no communication at all — pure throughput scaling,
+  exactly like the reference's concurrent HTTP requests but device-local;
+* across hosts: run one process per host (`jax.distributed`,
+  parallel/mesh.py:initialize_distributed) and give each host's engine its
+  local devices — the same class, DCN never carries tensor traffic.
+
+Host-side dispatch runs one thread per replica (device execution is async
+and overlaps; the GIL only serializes Python-side batch assembly).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import replace
+
+import jax
+
+from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+
+logger = logging.getLogger("lmrs.replicated")
+
+
+class ReplicatedEngine:
+    """dp independent JaxEngines over disjoint device subsets."""
+
+    schedules_internally = True  # each replica admission-controls itself
+
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        model_cfg: ModelConfig,
+        mesh_cfg: MeshConfig,
+        devices=None,
+    ):
+        from lmrs_tpu.engine.jax_engine import JaxEngine
+
+        devices = list(devices) if devices is not None else jax.devices()
+        dp = mesh_cfg.dp
+        per = mesh_cfg.n_devices // dp  # tp*sp*ep*pp per replica
+        if dp < 2:
+            raise ValueError("ReplicatedEngine needs mesh dp >= 2")
+        if dp * per > len(devices):
+            raise ValueError(
+                f"mesh {mesh_cfg} needs {dp * per} devices, "
+                f"have {len(devices)}")
+        sub_cfg = replace(mesh_cfg, dp=1)
+
+        # Load/init (and quantize) the weights ONCE on host; every replica
+        # device_puts the same tree onto its own sub-mesh — dp identical
+        # checkpoint reads would serialize startup on disk I/O.
+        if engine_cfg.checkpoint_path:
+            from lmrs_tpu.models.loader import load_checkpoint
+
+            shared = load_checkpoint(engine_cfg.checkpoint_path, model_cfg)
+        else:
+            from lmrs_tpu.models.transformer import init_params
+
+            logger.warning("no checkpoint for %s: replicas share random-init "
+                           "weights", model_cfg.name)
+            shared = init_params(model_cfg, jax.random.PRNGKey(engine_cfg.seed))
+        if engine_cfg.quantize:
+            from lmrs_tpu.ops.quant import quantize_params
+
+            shared = quantize_params(shared)
+
+        self._pool = ThreadPoolExecutor(max_workers=dp,
+                                        thread_name_prefix="lmrs-dp")
+
+        def build(i: int) -> JaxEngine:
+            # per-replica sampling seed: identical weights, decorrelated
+            # sampling streams (same prompt on two replicas must not emit
+            # identical tokens at temperature > 0)
+            cfg_i = replace(engine_cfg, seed=engine_cfg.seed + i,
+                            checkpoint_path=None, quantize=None)
+            return JaxEngine(cfg_i, model_cfg, sub_cfg, params=shared,
+                             devices=devices[i * per: (i + 1) * per])
+
+        self.replicas = list(self._pool.map(build, range(dp)))
+        logger.info("replicated engine: dp=%d replicas x %d device(s)", dp, per)
+
+    # ------------------------------------------------------------------ API
+
+    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+        dp = len(self.replicas)
+        # round-robin keeps shard sizes balanced for any request count
+        shards: list[list[tuple[int, GenerationRequest]]] = [[] for _ in range(dp)]
+        for i, req in enumerate(requests):
+            shards[i % dp].append((i, req))
+
+        def run(replica, shard):
+            return replica.generate_batch([req for _, req in shard])
+
+        futures = [
+            (shard, self._pool.submit(run, replica, shard))
+            for replica, shard in zip(self.replicas, shards) if shard
+        ]
+        out: list[GenerationResult | None] = [None] * len(requests)
+        for shard, fut in futures:
+            try:
+                results = fut.result()
+            except Exception as e:  # degrade-and-continue per replica
+                logger.exception("replica batch failure")
+                results = [
+                    GenerationResult(request_id=req.request_id,
+                                     finish_reason="error", error=str(e))
+                    for _, req in shard
+                ]
+            for (pos, _), res in zip(shard, results):
+                out[pos] = res
+        return [r for r in out if r is not None]
+
+    def shutdown(self) -> None:
+        for replica in self.replicas:
+            replica.shutdown()
+        self._pool.shutdown(wait=False)
+
+    def engine_metrics(self) -> dict:
+        """Fleet metrics in the same shape as one scheduler's report
+        (engine/scheduler.py:metrics_report) so downstream consumers — the
+        pipeline stats banner, /metrics — need no replica-awareness."""
+        per = [r.engine_metrics() for r in self.replicas]
+        per = [m for m in per if m]
+        if not per:
+            return {}
+        # replicas run concurrently: aggregate rate = total work / the
+        # longest replica's scheduler time
+        secs = max((m.get("scheduler_seconds", 0.0) for m in per), default=0.0)
+        prefill = sum(m.get("prefill_tokens", 0) for m in per)
+        decode = sum(m.get("decode_tokens", 0) for m in per)
+        return {
+            "replicas": len(per),
+            "prefill_tokens": prefill,
+            "decode_tokens": decode,
+            "prefill_tokens_per_sec": round(prefill / max(secs, 1e-9), 1),
+            "decode_tokens_per_sec": round(decode / max(secs, 1e-9), 1),
+            "mean_decode_occupancy": round(
+                sum(m.get("mean_decode_occupancy", 0.0) for m in per) / len(per), 3),
+            "peak_kv_page_utilization": max(
+                m.get("peak_kv_page_utilization", 0.0) for m in per),
+            "scheduler_seconds": round(secs, 3),
+            "per_replica": per,
+        }

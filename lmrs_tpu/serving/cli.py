@@ -5,7 +5,7 @@ Inverts the reference's deployment: instead of the summarizer calling out to
 calls in to the TPU pod.
 
     lmrs-serve --backend mock --port 8000
-    lmrs-serve --backend jax --model gemma-2b --mesh dp2,tp4 --port 8000
+    lmrs-serve --backend jax --model gemma-2b --mesh 2,4 --port 8000
 """
 
 from __future__ import annotations
@@ -30,7 +30,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--backend", default="mock", choices=["mock", "jax"])
     p.add_argument("--model", default="tiny", help="model preset name")
-    p.add_argument("--mesh", default=None, help="e.g. dp2,tp4 (jax backend)")
+    p.add_argument("--mesh", default=None,
+                   help="device mesh axes as dp,tp[,sp[,pp]], e.g. 2,4")
     p.add_argument("--checkpoint", default=None, help="Orbax checkpoint dir")
     p.add_argument("--quantize", default=None, choices=["int8"])
     p.add_argument("--batch-slots", type=int, default=8,

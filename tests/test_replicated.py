@@ -1,0 +1,89 @@
+"""DP serving replicas (engine/replicated.py) on the virtual 8-device mesh."""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest, make_engine
+from lmrs_tpu.engine.replicated import ReplicatedEngine
+
+TINY = ModelConfig(name="tiny-test", vocab_size=512, dim=64, n_layers=2,
+                   n_heads=4, n_kv_heads=2, hidden_dim=128, max_seq_len=512)
+
+ECFG = EngineConfig(backend="jax", max_tokens=16, max_batch_slots=4,
+                    retry_delay=0.0, seed=0, decode_block=4, prefill_chunk=128,
+                    num_pages=64, page_size=16)
+
+
+def _reqs(n: int) -> list[GenerationRequest]:
+    return [
+        GenerationRequest(prompt=f"summarize item {i}: the plan shipped.",
+                          request_id=i, max_new_tokens=8)
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def dp2tp2():
+    eng = ReplicatedEngine(ECFG, TINY, MeshConfig(dp=2, tp=2))
+    yield eng
+    eng.shutdown()
+
+
+def test_replicas_use_disjoint_devices(dp2tp2):
+    sets = [frozenset(r._mesh.devices.flat) for r in dp2tp2.replicas]
+    assert len(sets) == 2
+    assert not (sets[0] & sets[1])
+
+
+def test_results_align_with_request_order(dp2tp2):
+    reqs = _reqs(7)  # odd count: shards of 4 and 3
+    results = dp2tp2.generate_batch(reqs)
+    assert len(results) == 7
+    for req, res in zip(reqs, results):
+        assert res.request_id == req.request_id
+        assert res.error is None
+        assert res.completion_tokens > 0
+
+
+def test_single_device_replicas_pin_to_distinct_devices():
+    eng = ReplicatedEngine(ECFG, TINY, MeshConfig(dp=2, tp=1))
+    try:
+        devs = [set(r._mesh.devices.flat) for r in eng.replicas]
+        assert devs[0] != devs[1]
+        # cache pinned to the replica's device, not the default device
+        for r, dset in zip(eng.replicas, devs):
+            cache_devs = set(r._scheduler.cache.k.devices())
+            assert cache_devs == dset
+        results = eng.generate_batch(_reqs(4))
+        assert all(r.error is None for r in results)
+    finally:
+        eng.shutdown()
+
+
+def test_metrics_merge(dp2tp2):
+    m = dp2tp2.engine_metrics()
+    assert m["replicas"] == 2
+    assert m["decode_tokens"] > 0
+    assert len(m["per_replica"]) == 2
+
+
+def test_make_engine_routes_dp_to_replicated():
+    eng = make_engine(
+        EngineConfig(backend="jax", model="tiny", max_batch_slots=2,
+                     retry_delay=0.0, num_pages=64, page_size=16,
+                     decode_block=4),
+        TINY,
+        MeshConfig(dp=2, tp=1),
+    )
+    try:
+        assert isinstance(eng, ReplicatedEngine)
+    finally:
+        eng.shutdown()
+
+
+def test_dp1_rejected():
+    with pytest.raises(ValueError):
+        ReplicatedEngine(ECFG, TINY, MeshConfig(dp=1, tp=2))
