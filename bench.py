@@ -69,9 +69,11 @@ def main() -> int:
         # flash-attention dispatch (no window-gather continuation path)
         # 24 slots: decode's per-dispatch host RTT amortizes over 3x more
         # rows (measured 3.0 -> 5.2 req/s vs 8 slots on the bench chip)
+        # decode_block == max_tokens: a request's whole decode is ONE
+        # dispatch (sweep: 8.0 req/s vs 3.6-6.8 for block 64, docs/PERF.md)
         engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=24,
                             retry_delay=0.0, seed=0,
-                            decode_block=64, prefill_chunk=4096),
+                            decode_block=128, prefill_chunk=4096),
         model=model,
         reduce=ReduceConfig(max_tokens_per_batch=6000),
     )

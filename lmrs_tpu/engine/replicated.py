@@ -76,8 +76,13 @@ class ReplicatedEngine:
 
             shared = quantize_params(shared)
 
-        self._pool = ThreadPoolExecutor(max_workers=dp,
-                                        thread_name_prefix="lmrs-dp")
+        # ONE single-worker executor PER replica: a replica's scheduler is
+        # not thread-safe, so everything aimed at it — construction, user
+        # shards, health probes — funnels through its own queue and can
+        # never run concurrently, while distinct replicas run in parallel.
+        self._pools = [ThreadPoolExecutor(max_workers=1,
+                                          thread_name_prefix=f"lmrs-dp{i}")
+                       for i in range(dp)]
 
         def build(i: int) -> JaxEngine:
             # per-replica sampling seed: identical weights, decorrelated
@@ -88,34 +93,87 @@ class ReplicatedEngine:
             return JaxEngine(cfg_i, model_cfg, sub_cfg, params=shared,
                              devices=devices[i * per: (i + 1) * per])
 
-        self.replicas = list(self._pool.map(build, range(dp)))
+        self.replicas = [
+            fut.result() for fut in
+            [self._pools[i].submit(build, i) for i in range(dp)]
+        ]
+        # failure detection / elastic recovery (SURVEY.md §5.3): a replica
+        # whose batch raises is marked unhealthy and routed around, so the
+        # executor's retry of the failed requests lands on live replicas
+        # instead of round-robining back onto the dead one.  Unhealthy
+        # replicas get a tiny SYNTHETIC probe each wave (never user
+        # traffic); a probe that completes re-admits the replica.  Probing
+        # also bounds the poison-request case — a request that
+        # deterministically crashes its batch marks replicas unhealthy as
+        # it burns retries, but the probes (which are not the poison)
+        # revive them right after.
+        self._healthy = [True] * dp
+        self._probes: dict[int, object] = {}  # replica idx -> Future
         logger.info("replicated engine: dp=%d replicas x %d device(s)", dp, per)
 
     # ------------------------------------------------------------------ API
 
+    def _reap_probes(self) -> None:
+        for ri in list(self._probes):
+            fut = self._probes[ri]
+            if not fut.done():
+                continue
+            del self._probes[ri]
+            if fut.exception() is None:
+                self._healthy[ri] = True
+                logger.info("replica %d probe succeeded: re-admitted", ri)
+            else:
+                logger.warning("replica %d probe failed: still unhealthy", ri)
+
+    def _launch_probes(self) -> None:
+        for ri, ok in enumerate(self._healthy):
+            if not ok and ri not in self._probes:
+                probe = GenerationRequest(prompt="health probe",
+                                          request_id=-1, max_new_tokens=1)
+                self._probes[ri] = self._pools[ri].submit(
+                    self.replicas[ri].generate_batch, [probe])
+
     def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
-        dp = len(self.replicas)
+        # route over healthy replicas only; if every replica is marked dead,
+        # optimistically try them all again (a transient fault should not
+        # permanently brick the fleet)
+        self._reap_probes()
+        targets = [i for i, ok in enumerate(self._healthy) if ok]
+        if not targets:
+            logger.warning("all %d replicas marked unhealthy; retrying all",
+                           len(self.replicas))
+            targets = list(range(len(self.replicas)))
         # round-robin keeps shard sizes balanced for any request count
-        shards: list[list[tuple[int, GenerationRequest]]] = [[] for _ in range(dp)]
+        shards: list[list[tuple[int, GenerationRequest]]] = [[] for _ in targets]
         for i, req in enumerate(requests):
-            shards[i % dp].append((i, req))
+            shards[i % len(targets)].append((i, req))
 
         def run(replica, shard):
             return replica.generate_batch([req for _, req in shard])
 
         futures = [
-            (shard, self._pool.submit(run, replica, shard))
-            for replica, shard in zip(self.replicas, shards) if shard
+            (ri, shard, self._pools[ri].submit(run, self.replicas[ri], shard))
+            for ri, shard in zip(targets, shards) if shard
         ]
+        self._launch_probes()  # concurrent with the wave, on unhealthy replicas
         out: list[GenerationResult | None] = [None] * len(requests)
-        for shard, fut in futures:
+        for ri, shard, fut in futures:
             try:
+                # blocking wait, no timeout: a shard that WEDGES inside a
+                # device call can't be abandoned anyway (the worker thread
+                # would stay stuck and hang interpreter exit) — a hung chip
+                # is a process-level fault handled by slice restart, while
+                # this health layer handles the faults JAX surfaces as
+                # exceptions, which it raises promptly
                 results = fut.result()
+                self._healthy[ri] = True
             except Exception as e:  # degrade-and-continue per replica
-                logger.exception("replica batch failure")
+                logger.exception("replica %d batch failure: marked unhealthy", ri)
+                self._healthy[ri] = False
                 results = [
                     GenerationResult(request_id=req.request_id,
-                                     finish_reason="error", error=str(e))
+                                     finish_reason="error",
+                                     error=str(e) or type(e).__name__)
                     for _, req in shard
                 ]
             for (pos, _), res in zip(shard, results):
@@ -125,7 +183,8 @@ class ReplicatedEngine:
     def shutdown(self) -> None:
         for replica in self.replicas:
             replica.shutdown()
-        self._pool.shutdown(wait=False)
+        for pool in self._pools:
+            pool.shutdown(wait=False)
 
     def engine_metrics(self) -> dict:
         """Fleet metrics in the same shape as one scheduler's report
@@ -142,6 +201,7 @@ class ReplicatedEngine:
         decode = sum(m.get("decode_tokens", 0) for m in per)
         return {
             "replicas": len(per),
+            "healthy_replicas": sum(self._healthy),
             "prefill_tokens": prefill,
             "decode_tokens": decode,
             "prefill_tokens_per_sec": round(prefill / max(secs, 1e-9), 1),

@@ -87,3 +87,72 @@ def test_make_engine_routes_dp_to_replicated():
 def test_dp1_rejected():
     with pytest.raises(ValueError):
         ReplicatedEngine(ECFG, TINY, MeshConfig(dp=1, tp=2))
+
+
+def test_failed_replica_is_routed_around_then_probed_back(dp2tp2):
+    """SURVEY §5.3 elastic recovery: after a replica-level fault, user
+    retries land on healthy replicas only (the dead one sees nothing but
+    synthetic health probes), and a successful probe re-admits it."""
+    import time
+
+    victim = dp2tp2.replicas[0]
+    orig = victim.generate_batch
+    seen_prompts: list[str] = []
+
+    def dying(requests):
+        seen_prompts.extend(r.prompt for r in requests)
+        raise RuntimeError("injected device failure")
+
+    victim.generate_batch = dying
+    try:
+        first = dp2tp2.generate_batch(_reqs(4))
+        errs = [r for r in first if r.error is not None]
+        assert errs, "victim replica's shard should have failed"
+        assert dp2tp2._healthy == [False, True]
+        user_calls = len(seen_prompts)
+        # retry wave: user requests route to the surviving replica only
+        second = dp2tp2.generate_batch(_reqs(4))
+        assert all(r.error is None for r in second)
+        new = seen_prompts[user_calls:]
+        assert all(p == "health probe" for p in new), \
+            f"dead replica received user traffic: {new}"
+    finally:
+        victim.generate_batch = orig
+    # recovery: keep driving waves until a probe re-admits the replica
+    deadline = time.time() + 60
+    while not all(dp2tp2._healthy) and time.time() < deadline:
+        assert all(r.error is None
+                   for r in dp2tp2.generate_batch(_reqs(2)))
+        time.sleep(0.2)
+    assert all(dp2tp2._healthy), "probe never re-admitted the replica"
+    assert dp2tp2.engine_metrics()["healthy_replicas"] == 2
+
+
+def test_executor_retry_completes_over_surviving_replica(dp2tp2):
+    """End-to-end degrade-and-continue: MapExecutor retry + unhealthy
+    routing yields zero failed requests despite a dead replica."""
+    from lmrs_tpu.engine.executor import MapExecutor
+
+    victim = dp2tp2.replicas[1]
+    orig = victim.generate_batch
+
+    def dying(requests):
+        raise RuntimeError("injected device failure")
+
+    victim.generate_batch = dying
+    try:
+        ex = MapExecutor(dp2tp2, EngineConfig(retry_attempts=2, retry_delay=0.0,
+                                              max_tokens=8))
+        results = ex.run_requests(_reqs(6))
+        assert all(r.error is None for r in results)
+        assert ex.failed_requests == 0
+    finally:
+        victim.generate_batch = orig
+        # drain any in-flight probe against the restored replica, then reset
+        for fut in dp2tp2._probes.values():
+            try:
+                fut.result(timeout=30)
+            except Exception:
+                pass
+        dp2tp2._probes.clear()
+        dp2tp2._healthy = [True, True]
