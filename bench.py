@@ -80,12 +80,12 @@ def main() -> int:
     s = TranscriptSummarizer(cfg)
 
     # Warm-up outside the timed region, covering every shape the timed run
-    # uses.  With the byte tokenizer a chunk is ~21 segments, so ~900
-    # segments -> ~45 chunks: fills all 24 decode slots (full-width decode +
-    # n=B batched prefill) AND pushes the summary total past the reduce
-    # batch budget, compiling the HIERARCHICAL reduce programs (batch +
-    # final prompts, n=1 prefill) — a sub-40-chunk warm-up takes the
-    # single-pass reduce and leaves those to compile inside the timed run.
+    # uses.  900 segments = 53 chunks measured with this chunker config:
+    # fills all 24 decode slots (full-width decode + n=B batched prefill)
+    # AND pushes the summary total past the reduce batch budget, compiling
+    # the HIERARCHICAL reduce programs (batch + final prompts, n=1
+    # prefill) — a sub-40-chunk warm-up takes the single-pass reduce and
+    # leaves those to compile inside the timed run.
     s.summarize({"segments": transcript["segments"][:900]})
 
     # counters are cumulative over the summarizer's lifetime; snapshot so

@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="re-bucket segments into fixed intervals (seconds)")
     p.add_argument("--no-merge", action="store_true", help="skip same-speaker merging")
     p.add_argument("--no-hierarchical", action="store_true", help="single-pass reduce only")
+    p.add_argument("--stream-reduce", action="store_true",
+                   help="feed reduce batches into the map stage's engine "
+                        "stream as summaries complete (best for long-decode "
+                        "workloads; see ReduceConfig.streaming)")
     p.add_argument("--limit-segments", type=int, default=None)
     p.add_argument("--report", action="store_true", help="write <output>.report.json stats")
     p.add_argument("--prompt-file", help="map prompt file ({transcript} placeholder)")
@@ -103,7 +107,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         ),
         engine=engine,
         mesh=mesh,
-        reduce=ReduceConfig(hierarchical=not args.no_hierarchical),
+        reduce=ReduceConfig(hierarchical=not args.no_hierarchical,
+                            streaming=args.stream_reduce),
     )
 
 

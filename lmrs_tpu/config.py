@@ -172,6 +172,15 @@ class ReduceConfig:
     hierarchical: bool = True
     reserve_tokens: int = 1000
     max_summaries_per_batch: int = 10
+    # stream reduce batches into the map stage's engine stream as their
+    # member summaries complete (reduce/streaming.py) instead of the
+    # reference's hard map→reduce barrier (main.py:169-236).  Default OFF:
+    # measured a ~2% LOSS on the bench workload (in-process ABBA,
+    # docs/PERF.md) — with short decodes the reduce share is too small to
+    # hide and the mixed-shape admissions cost more than the overlap wins.
+    # Worth enabling for long-decode workloads (max_tokens ~1000) or deep
+    # reduce trees, where the tail is a real fraction of the run.
+    streaming: bool = False
     max_levels: int = 4
     temperature: float = 0.2  # reference hardcodes 0.2 (result_aggregator.py:238)
 

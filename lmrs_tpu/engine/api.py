@@ -60,7 +60,19 @@ class Engine(Protocol):
     degrade-and-continue contract (llm_executor.py:219-225) depends on it.
     """
 
-    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]: ...
+    def generate_batch(self, requests: list[GenerationRequest],
+                       on_result=None) -> list[GenerationResult]:
+        """Generate all requests (plus any the callback submits).
+
+        ``on_result(result, submit)``, when given, fires once per completed
+        request; ``submit(more)`` feeds new requests into the same run.  The
+        continuous scheduler interleaves submissions with in-flight work
+        (map→reduce overlap); other backends deliver post-hoc and loop on
+        submissions (``drain_with_callback``) — same results, no overlap.
+        The returned list covers initial + submitted requests, in
+        submission order.  request_ids must be unique per call.
+        """
+        ...
 
     def shutdown(self) -> None: ...
 
@@ -77,6 +89,28 @@ class Engine(Protocol):
     # Protocol data member: runtime_checkable isinstance would then require
     # it on every implementation, and a Protocol class default is not
     # inherited structurally anyway.
+
+
+def drain_with_callback(run_batch, requests: list["GenerationRequest"],
+                        on_result) -> list["GenerationResult"]:
+    """Streaming semantics for backends without a mid-run hook: run a wave,
+    deliver each result, collect callback submissions, repeat until dry.
+    Same results/ordering contract as the continuous scheduler's streaming
+    path, minus the in-flight overlap."""
+    all_results: list[GenerationResult] = []
+    pending = list(requests)
+    submitted: list[GenerationRequest] = []
+
+    def submit(new_requests: list["GenerationRequest"]) -> None:
+        submitted.extend(new_requests)
+
+    while pending:
+        results = run_batch(pending)
+        all_results.extend(results)
+        for res in results:
+            on_result(res, submit)
+        pending, submitted = submitted, []
+    return all_results
 
 
 def make_engine(

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import time
+from dataclasses import replace
 from typing import Sequence
 
 from lmrs_tpu.config import EngineConfig
@@ -75,23 +76,9 @@ class MapExecutor:
         flat: list[Chunk] = []
         for chunks in groups:
             for chunk in chunks:
-                # safe_format, not str.format: user prompt files may contain
-                # literal braces (JSON examples) that str.format would choke on
-                prompt = safe_format(
-                    prompt_template,
-                    transcript=chunk.text_with_context,
-                    summary_type=summary_type,
-                )
-                requests.append(
-                    GenerationRequest(
-                        prompt=prompt,
-                        request_id=len(flat),  # pool-unique, not chunk_index
-                        system_prompt=chunk.system_prompt or system_prompt,
-                        max_new_tokens=self.config.max_tokens,
-                        temperature=self.config.temperature,
-                        seed=self.config.seed,
-                    )
-                )
+                requests.append(self.build_map_request(
+                    chunk, prompt_template, summary_type, system_prompt,
+                    request_id=len(flat)))  # pool-unique, not chunk_index
                 flat.append(chunk)
 
         results = self.run_requests(requests)
@@ -108,6 +95,33 @@ class MapExecutor:
         logger.info(
             "map stage: %d chunks (%d groups) in %.2fs (%d failed)",
             len(flat), len(groups), time.time() - t0, failed,
+        )
+
+    def build_map_request(
+        self,
+        chunk: Chunk,
+        prompt_template: str,
+        summary_type: str = "summary",
+        system_prompt: str | None = None,
+        request_id: int = 0,
+    ) -> GenerationRequest:
+        """One chunk → one map request — the single source of truth for how
+        map prompts and generation params are assembled (used by both the
+        barrier path here and reduce/streaming.py)."""
+        # safe_format, not str.format: user prompt files may contain
+        # literal braces (JSON examples) that str.format would choke on
+        prompt = safe_format(
+            prompt_template,
+            transcript=chunk.text_with_context,
+            summary_type=summary_type,
+        )
+        return GenerationRequest(
+            prompt=prompt,
+            request_id=request_id,
+            system_prompt=chunk.system_prompt or system_prompt,
+            max_new_tokens=self.config.max_tokens,
+            temperature=self.config.temperature,
+            seed=self.config.seed,
         )
 
     # ----------------------------------------------------- request plumbing
@@ -168,6 +182,81 @@ class MapExecutor:
             pending = failed
             attempt += 1
         return [done[r.request_id] for r in requests]
+
+    def run_requests_streaming(self, requests: list[GenerationRequest],
+                               on_final) -> None:
+        """Streaming analog of ``run_requests``: one engine stream, results
+        delivered through ``on_final(result, submit)`` as they complete, and
+        ``submit(more)`` feeds new requests into the SAME stream (the
+        map→reduce overlap hook).
+
+        Retries: a failed request is resubmitted into the stream
+        immediately — device faults don't need the HTTP-style
+        ``retry_delay`` spacing — up to ``retry_attempts``, then delivered
+        with its error (degrade-and-continue).  Retried copies get fresh
+        NEGATIVE request_ids internally (the scheduler's stream requires
+        unique ids) and are delivered under the original id; callers must
+        use ids >= 0.
+        """
+        by_id: dict[int, GenerationRequest] = {}
+        attempts: dict[int, int] = {}
+        orig_of: dict[int, int] = {}  # retry clone id -> original id
+        finals: set[int] = set()
+        retry_seq = [0]
+
+        def register(reqs: list[GenerationRequest]) -> None:
+            for r in reqs:
+                if r.request_id < 0:
+                    raise ValueError("streaming request_ids must be >= 0")
+                by_id[r.request_id] = r
+                attempts[r.request_id] = 1
+
+        register(requests)
+
+        def wrapper(res: GenerationResult, submit) -> None:
+            rid = orig_of.pop(res.request_id, res.request_id)
+            self.total_requests += 1
+            req = by_id.get(rid)
+            if (res.error is not None and req is not None
+                    and attempts[rid] < self.config.retry_attempts):
+                attempts[rid] += 1
+                retry_seq[0] -= 1
+                clone = replace(req, request_id=retry_seq[0])
+                orig_of[clone.request_id] = rid
+                logger.warning("streaming retry %d/%d for request %d",
+                               attempts[rid], self.config.retry_attempts, rid)
+                submit([clone])
+                return
+            if res.error is not None:
+                self.failed_requests += 1
+            else:
+                self.total_tokens_used += res.total_tokens
+                self.total_device_seconds += res.device_seconds
+            if res.request_id != rid:
+                res = replace(res, request_id=rid)
+            finals.add(rid)
+
+            def submit_user(new_reqs: list[GenerationRequest]) -> None:
+                register(new_reqs)
+                submit(new_reqs)
+
+            on_final(res, submit_user)
+
+        try:
+            self.engine.generate_batch(requests, on_result=wrapper)
+        except Exception as e:
+            # engine-level fault mid-stream: the same degrade-and-continue
+            # contract run_requests enforces (every registered request gets
+            # an error result; no exception escapes to the pipeline)
+            logger.exception("engine stream failure")
+            msg = str(e) or type(e).__name__
+            for rid in [r for r in by_id if r not in finals]:
+                self.total_requests += 1
+                self.failed_requests += 1
+                finals.add(rid)
+                on_final(GenerationResult(request_id=rid, finish_reason="error",
+                                          error=msg),
+                         lambda new_reqs: None)
 
     # ------------------------------------------------------------ reporting
 

@@ -141,11 +141,22 @@ class JaxEngine:
 
     # -------------------------------------------------------------- generate
 
-    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+    def generate_batch(self, requests: list[GenerationRequest],
+                       on_result=None) -> list[GenerationResult]:
         if not requests:
             return []
         if self._scheduler is not None:
-            return self._scheduler.run(requests)
+            return self._scheduler.run(requests, on_result=on_result)
+        if on_result is not None:
+            # static scheduler has no mid-run hook: run wave-by-wave,
+            # deliver post-hoc, and loop on whatever the callbacks submit
+            # (semantically identical to streaming, without the overlap)
+            from lmrs_tpu.engine.api import drain_with_callback
+
+            return drain_with_callback(self._generate_static, requests, on_result)
+        return self._generate_static(requests)
+
+    def _generate_static(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
         t0 = time.time()
         # Sort by tokenized length to minimize padding waste per bucket.
         encoded = []

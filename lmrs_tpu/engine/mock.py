@@ -34,7 +34,13 @@ class MockEngine:
         self.fail_pattern = fail_pattern
         self._tok = ApproxTokenizer()
 
-    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+    def generate_batch(self, requests: list[GenerationRequest],
+                       on_result=None) -> list[GenerationResult]:
+        if on_result is not None:
+            from lmrs_tpu.engine.api import drain_with_callback
+
+            return drain_with_callback(
+                lambda reqs: [self._one(r) for r in reqs], requests, on_result)
         return [self._one(r) for r in requests]
 
     def shutdown(self) -> None:

@@ -133,7 +133,17 @@ class ReplicatedEngine:
                 self._probes[ri] = self._pools[ri].submit(
                     self.replicas[ri].generate_batch, [probe])
 
-    def generate_batch(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+    def generate_batch(self, requests: list[GenerationRequest],
+                       on_result=None) -> list[GenerationResult]:
+        if on_result is not None:
+            # replicas have no cross-replica mid-run hook: deliver per wave
+            # and loop on callback submissions (engine/api.py)
+            from lmrs_tpu.engine.api import drain_with_callback
+
+            return drain_with_callback(self._generate_wave, requests, on_result)
+        return self._generate_wave(requests)
+
+    def _generate_wave(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
         # route over healthy replicas only; if every replica is marked dead,
         # optimistically try them all again (a transient fault should not
         # permanently brick the fleet)

@@ -171,9 +171,30 @@ class ContinuousScheduler:
 
     # ----------------------------------------------------------- public API
 
-    def run(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+    def run(self, requests: list[GenerationRequest],
+            on_result=None) -> list[GenerationResult]:
+        """Run the stream to completion and return results in request order.
+
+        ``on_result(result, submit)``, when given, is invoked INSIDE the
+        scheduling loop as each request completes; the callback may call
+        ``submit(more_requests)`` to feed new work into the same stream —
+        this is how the reduce tree rides the map stage's batch slots
+        instead of waiting behind a full-queue barrier (map→reduce
+        overlap).  Single-threaded: callbacks run between dispatches, so
+        they need no locking but must be quick.  request_ids must be
+        unique across everything submitted to one run().
+        """
         t_run = time.time()
         queue: deque[tuple[GenerationRequest, list[int], int]] = deque()
+        all_requests = list(requests)
+
+        def submit(new_requests: list[GenerationRequest]) -> None:
+            for req in new_requests:
+                ids, max_new = self._encode(req)
+                queue.append((req, ids, max_new))
+                all_requests.append(req)
+
+        fresh: deque[int] = deque()  # completed rids awaiting delivery
         for req in requests:
             ids, max_new = self._encode(req)
             queue.append((req, ids, max_new))
@@ -211,6 +232,7 @@ class ContinuousScheduler:
                         error=f"request needs {need} KV pages; pool has "
                               f"{usable_pages}",
                     )
+                    fresh.append(req.request_id)
                     continue
                 if need > self.cache.allocator.free_count:
                     break  # back-pressure: wait for pages to free up
@@ -228,7 +250,15 @@ class ContinuousScheduler:
                 self.metrics["peak_pages_in_use"] = max(
                     self.metrics["peak_pages_in_use"], in_use)
 
-        while queue or any(s is not None for s in slots):
+        while True:
+            # deliver fresh results first: the callback may submit new work,
+            # which the loop-exit check below must see (a reduce batch
+            # submitted by the LAST map result must still run)
+            if on_result is not None:
+                while fresh:
+                    on_result(results[fresh.popleft()], submit)
+            if not (queue or any(s is not None for s in slots)):
+                break
             admit()
             # advance every prefilling slot by ONE prompt chunk, then give
             # decode a turn — long prompts never monopolize the device.
@@ -260,7 +290,7 @@ class ContinuousScheduler:
                     st.generated.append(tok0)
                     last_tok[b] = tok0
                     self.seed_history(b, st)
-                    self._maybe_finish(b, slots, results, active)
+                    self._maybe_finish(b, slots, results, active, fresh)
                 deferred = []
                 pending = []
             if not any(active):
@@ -290,10 +320,10 @@ class ContinuousScheduler:
                 kv_lens[b] = st.kv_len
                 last_tok[b] = st.generated[-1] if st.generated else 0
                 self.metrics["decode_tokens"] += len(new)
-                self._maybe_finish(b, slots, results, active)
+                self._maybe_finish(b, slots, results, active, fresh)
 
         self.metrics["run_seconds"] += time.time() - t_run
-        return [results[r.request_id] for r in requests]
+        return [results[r.request_id] for r in all_requests]
 
     # ------------------------------------------------------------ internals
 
@@ -307,7 +337,7 @@ class ContinuousScheduler:
             ids = ids[:head] + ids[-tail:]
         return ids, max_new
 
-    def _maybe_finish(self, b, slots, results, active):
+    def _maybe_finish(self, b, slots, results, active, fresh=None):
         st = slots[b]
         # decode runs in fixed blocks, so a slot can overshoot its budget by
         # up to decode_block-1 tokens between host syncs — trim to budget
@@ -334,6 +364,8 @@ class ContinuousScheduler:
                 finish_reason=finish,
                 device_seconds=time.time() - st.t_start,
             )
+            if fresh is not None:
+                fresh.append(st.req.request_id)
             self.cache.close_sequence(st.seq)
             slots[b] = None
             active[b] = False

@@ -175,22 +175,43 @@ class TranscriptSummarizer:
             resumed_chunks, todo = _load_resume(resume_from, chunks)
             resumed = len(resumed_chunks)
 
-        with timer.stage("map"):
-            if todo:
-                self.executor.process_chunks(todo, map_prompt, summary_type, sys_prompt)
-        processed_chunks = sorted(chunks, key=lambda c: c.chunk_index)
-
-        if save_chunks:
-            _dump_chunks(save_chunks, processed_chunks)
-
         reduce_prompt = resolve_reduce_prompt(aggregator_prompt, aggregator_prompt_file)
         metadata = {
             "duration": format_duration(duration),
             "speakers": ", ".join(speakers),
             "num_chunks": len(chunks),
         }
-        with timer.stage("reduce"):
-            agg = self.aggregator.aggregate(processed_chunks, reduce_prompt, metadata)
+
+        if self.config.reduce.streaming and todo:
+            # one engine stream: reduce batches ride the map stage's batch
+            # slots as their member summaries complete (reduce/streaming.py)
+            from lmrs_tpu.reduce.streaming import StreamingMapReduce
+
+            smr = StreamingMapReduce(self.executor, self.aggregator)
+            # dump inside the stream at map-complete, like the barrier
+            # path's between-stage dump: an interrupt during the reduce
+            # tail must still leave a resumable artifact
+            on_map_complete = (
+                (lambda cs: _dump_chunks(save_chunks, list(cs)))
+                if save_chunks else None)
+            agg = smr.run(chunks, map_prompt, summary_type, sys_prompt,
+                          reduce_prompt, metadata,
+                          on_map_complete=on_map_complete)
+            # map = start → last map summary; reduce = the tail beyond it
+            timer.spans["map"] = round(agg["map_seconds"], 4)
+            timer.spans["reduce"] = round(agg["reduce_tail_seconds"], 4)
+            processed_chunks = sorted(chunks, key=lambda c: c.chunk_index)
+        else:
+            with timer.stage("map"):
+                if todo:
+                    self.executor.process_chunks(todo, map_prompt, summary_type,
+                                                 sys_prompt)
+            processed_chunks = sorted(chunks, key=lambda c: c.chunk_index)
+            if save_chunks:
+                _dump_chunks(save_chunks, processed_chunks)
+            with timer.stage("reduce"):
+                agg = self.aggregator.aggregate(processed_chunks, reduce_prompt,
+                                                metadata)
 
         stats = {
             "summary": agg["final_summary"],

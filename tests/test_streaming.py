@@ -1,0 +1,214 @@
+"""Streamed map→reduce (reduce/streaming.py + scheduler on_result hook)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from lmrs_tpu.config import (
+    ChunkConfig, EngineConfig, ModelConfig, PipelineConfig, ReduceConfig,
+)
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.pipeline import TranscriptSummarizer
+
+from conftest import make_segments
+
+TINY = ModelConfig(name="tiny-test", vocab_size=512, dim=64, n_layers=2,
+                   n_heads=4, n_kv_heads=2, hidden_dim=128, max_seq_len=512)
+
+
+# ------------------------------------------------------------ scheduler hook
+
+def test_scheduler_streaming_submit_chain():
+    """A result callback can submit new requests into the running stream."""
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    eng = JaxEngine(
+        EngineConfig(backend="jax", max_tokens=8, max_batch_slots=2,
+                     retry_delay=0.0, decode_block=4, num_pages=64,
+                     page_size=16, temperature=0.0),
+        TINY,
+    )
+    seen: list[int] = []
+
+    def on_result(res, submit):
+        seen.append(res.request_id)
+        assert res.error is None
+        if res.request_id == 0:
+            submit([GenerationRequest(prompt="second wave", request_id=10,
+                                      max_new_tokens=4)])
+        elif res.request_id == 10:
+            submit([GenerationRequest(prompt="third wave", request_id=20,
+                                      max_new_tokens=4)])
+
+    results = eng.generate_batch(
+        [GenerationRequest(prompt="first", request_id=0, max_new_tokens=4)],
+        on_result=on_result,
+    )
+    eng.shutdown()
+    assert sorted(seen) == [0, 10, 20]
+    assert [r.request_id for r in results] == [0, 10, 20]
+    assert all(r.error is None for r in results)
+
+
+def test_mock_drain_with_callback():
+    eng = MockEngine()
+    seen = []
+
+    def on_result(res, submit):
+        seen.append(res.request_id)
+        if res.request_id == 0:
+            submit([GenerationRequest(prompt="more", request_id=1)])
+
+    out = eng.generate_batch([GenerationRequest(prompt="go", request_id=0)],
+                             on_result=on_result)
+    assert seen == [0, 1]
+    assert len(out) == 2
+
+
+# ------------------------------------------------------- executor streaming
+
+class FlakyEngine:
+    """Fails each distinct request id once, then succeeds."""
+
+    schedules_internally = True
+
+    def __init__(self):
+        self.inner = MockEngine()
+        self.failed_once: set[str] = set()
+
+    def generate_batch(self, requests, on_result=None):
+        from lmrs_tpu.engine.api import drain_with_callback
+
+        def wave(reqs):
+            out = []
+            for r, res in zip(reqs, self.inner.generate_batch(reqs)):
+                if r.prompt not in self.failed_once:
+                    self.failed_once.add(r.prompt)
+                    res = dataclasses.replace(
+                        res, error="transient fault", finish_reason="error")
+                out.append(res)
+            return out
+
+        if on_result is not None:
+            return drain_with_callback(wave, requests, on_result)
+        return wave(requests)
+
+    def shutdown(self):
+        pass
+
+    def engine_metrics(self):
+        return {}
+
+
+def test_streaming_retry_resubmits_into_stream():
+    ex = MapExecutor(FlakyEngine(), EngineConfig(retry_attempts=3,
+                                                 retry_delay=0.0))
+    finals = {}
+
+    def on_final(res, submit):
+        finals[res.request_id] = res
+
+    ex.run_requests_streaming(
+        [GenerationRequest(prompt=f"p{i}", request_id=i) for i in range(3)],
+        on_final,
+    )
+    assert sorted(finals) == [0, 1, 2]
+    assert all(r.error is None for r in finals.values())
+    assert ex.failed_requests == 0
+    assert ex.total_requests == 6  # 3 failures + 3 retried successes
+
+
+def test_streaming_retry_exhaustion_degrades():
+    ex = MapExecutor(MockEngine(fail_pattern="poison"),
+                     EngineConfig(retry_attempts=2, retry_delay=0.0))
+    finals = {}
+    ex.run_requests_streaming(
+        [GenerationRequest(prompt="fine", request_id=0),
+         GenerationRequest(prompt="has poison inside", request_id=1)],
+        lambda res, submit: finals.__setitem__(res.request_id, res),
+    )
+    assert finals[0].error is None
+    assert finals[1].error is not None
+    assert ex.failed_requests == 1
+
+
+def test_streaming_rejects_negative_ids():
+    ex = MapExecutor(MockEngine(), EngineConfig())
+    with pytest.raises(ValueError):
+        ex.run_requests_streaming(
+            [GenerationRequest(prompt="x", request_id=-5)], lambda r, s: None)
+
+
+# ------------------------------------------------------- pipeline end-to-end
+
+def _cfg(streaming: bool, max_tokens_per_batch: int = 6000) -> PipelineConfig:
+    return PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=400, context_tokens=100,
+                          overlap_tokens=0),
+        engine=EngineConfig(backend="mock", retry_delay=0.0, seed=0),
+        reduce=ReduceConfig(max_tokens_per_batch=max_tokens_per_batch,
+                            reserve_tokens=200, streaming=streaming),
+    )
+
+
+def test_pipeline_streaming_single_pass_matches_barrier():
+    """Under-budget totals must produce the EXACT barrier-path result
+    (single-pass decision + prompt are identical)."""
+    data = {"segments": make_segments(40)}
+    a = TranscriptSummarizer(_cfg(streaming=True)).summarize(data)
+    b = TranscriptSummarizer(_cfg(streaming=False)).summarize(data)
+    assert a["hierarchical"] is False and b["hierarchical"] is False
+    assert a["summary"] == b["summary"]
+    assert a["num_chunks"] == b["num_chunks"]
+
+
+def test_pipeline_streaming_hierarchical():
+    data = {"segments": make_segments(400)}
+    cfg = _cfg(streaming=True, max_tokens_per_batch=700)
+    stats = TranscriptSummarizer(cfg).summarize(data)
+    assert stats["hierarchical"] is True
+    assert stats["reduce_levels"] >= 2
+    assert stats["summary"]
+    assert stats["failed_requests"] == 0
+    # stage timing keys still present (map + reduce tail)
+    assert "map" in stats["stage_times"] and "reduce" in stats["stage_times"]
+
+    barrier = TranscriptSummarizer(
+        _cfg(streaming=False, max_tokens_per_batch=700)).summarize(data)
+    assert barrier["hierarchical"] is True
+    assert barrier["summary"]
+
+
+def test_pipeline_streaming_with_resume(tmp_path):
+    data = {"segments": make_segments(120)}
+    dump = str(tmp_path / "chunks.json")
+    s1 = TranscriptSummarizer(_cfg(streaming=True))
+    first = s1.summarize(data, save_chunks=dump)
+    s2 = TranscriptSummarizer(_cfg(streaming=True))
+    second = s2.summarize(data, resume_from=dump)
+    assert second["num_resumed_chunks"] == first["num_chunks"]
+    assert second["summary"]
+
+
+def test_pipeline_streaming_jax_engine():
+    """Full pipeline over the continuous scheduler with live submission."""
+    cfg = PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=300, context_tokens=100,
+                          overlap_tokens=0, tokenizer="byte"),
+        engine=EngineConfig(backend="jax", max_tokens=16, max_batch_slots=4,
+                            retry_delay=0.0, decode_block=8, num_pages=128,
+                            page_size=16, temperature=0.0),
+        model=TINY,
+        reduce=ReduceConfig(max_tokens_per_batch=300, reserve_tokens=100,
+                            streaming=True),
+    )
+    s = TranscriptSummarizer(cfg)
+    stats = s.summarize({"segments": make_segments(60)})
+    s.shutdown()
+    assert stats["summary"] is not None
+    assert stats["failed_requests"] == 0
+    assert stats["num_chunks"] > 1
