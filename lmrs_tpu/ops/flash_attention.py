@@ -40,6 +40,7 @@ def _flash_kernel(
     q_block: int,
     kv_block: int,
     sm_scale: float,
+    skip_padded_q: bool,
 ):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -55,11 +56,17 @@ def _flash_kernel(
     k_start = ki * kv_block
     length = lengths_ref[pl.program_id(0)]
 
-    # A KV block is live iff some query row can see it: k_start <= last query
-    # position, and it intersects the valid prefix.
-    live = jnp.logical_and(
-        k_start <= q_start + q_block - 1, k_start < length
-    )
+    # A (q, kv) block pair is live iff some VALID query row can see it:
+    # the kv block starts at or before the last query position, intersects
+    # the valid prefix, and the q block contains at least one valid row —
+    # padded q blocks (prompt bucketed up past its length) would otherwise
+    # re-compute attention over the whole valid prefix for garbage rows
+    # (~43% of the MXU work for a 2.3k prompt in the 4096 bucket).  Skipped
+    # blocks still init/finalize, so their output rows are well-defined
+    # zeros, and valid rows never attend them (causal + length mask).
+    live = jnp.logical_and(k_start <= q_start + q_block - 1, k_start < length)
+    if skip_padded_q:
+        live = jnp.logical_and(live, q_start < length)
 
     @pl.when(live)
     def _compute():
@@ -99,7 +106,8 @@ def _flash_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("q_block", "kv_block", "interpret")
+    jax.jit, static_argnames=("q_block", "kv_block", "interpret",
+                              "skip_padded_q")
 )
 def flash_attention(
     q: jnp.ndarray,          # [B, Sq, H, hd]
@@ -109,11 +117,15 @@ def flash_attention(
     q_block: int = 256,
     kv_block: int = 256,
     interpret: bool = False,
+    skip_padded_q: bool = True,
 ) -> jnp.ndarray:
     """Causal flash attention over fresh (position-0-based) sequences.
 
     Requires Sq == Skv (self-attention prefill / training).  Returns
-    [B, Sq, H, hd] in q.dtype.
+    [B, Sq, H, hd] in q.dtype.  With ``skip_padded_q`` (default), rows at
+    positions >= lengths[b] are exactly zero — their blocks are predicated
+    off entirely (a bucketed prompt would otherwise burn MXU time computing
+    attention for garbage rows); pass False to compute them anyway.
     """
     b, sq, h, hd = q.shape
     skv, kh = k.shape[1], k.shape[2]
@@ -139,7 +151,8 @@ def flash_attention(
 
     grid = (b, h, sq_p // q_block, skv_p // kv_block)
     kernel = functools.partial(
-        _flash_kernel, q_block=q_block, kv_block=kv_block, sm_scale=hd ** -0.5
+        _flash_kernel, q_block=q_block, kv_block=kv_block,
+        sm_scale=hd ** -0.5, skip_padded_q=skip_padded_q,
     )
     out = pl.pallas_call(
         kernel,

@@ -31,8 +31,8 @@ def test_flash_matches_reference(h, kh):
     got = flash_attention(q, k, v, lengths, q_block=128, kv_block=128,
                           interpret=True)
     want = _ref(q, k, v, lengths)
-    # rows past a sequence's valid length are garbage on both paths; compare
-    # only valid rows
+    # rows past a sequence's valid length are zeros (kernel, skip_padded_q)
+    # vs garbage (XLA reference); compare only valid rows
     for i, n in enumerate([s, s // 3]):
         np.testing.assert_allclose(np.asarray(got[i, :n]),
                                    np.asarray(want[i, :n]),
