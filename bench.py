@@ -71,9 +71,15 @@ def main() -> int:
         # rows (measured 3.0 -> 5.2 req/s vs 8 slots on the bench chip)
         # decode_block == max_tokens: a request's whole decode is ONE
         # dispatch (sweep: 8.0 req/s vs 3.6-6.8 for block 64, docs/PERF.md)
+        # page_size 512: decode is DMA-latency-bound on per-page fetches;
+        # 4x bigger pages halved the per-step cost (8.6 -> 4.2 ms/step,
+        # docs/PERF.md; 1024 fails pallas lowering)
+        # num_pages=1: pool sizing then takes the B*max_pages_per_slot+1
+        # floor (193 pages) instead of the 512-page default that would
+        # cost 2.7x the HBM at this page size
         engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=24,
-                            retry_delay=0.0, seed=0,
-                            decode_block=128, prefill_chunk=4096),
+                            retry_delay=0.0, seed=0, page_size=512,
+                            num_pages=1, decode_block=128, prefill_chunk=4096),
         model=model,
         reduce=ReduceConfig(max_tokens_per_batch=6000),
     )
