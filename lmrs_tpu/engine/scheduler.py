@@ -241,6 +241,13 @@ class ContinuousScheduler:
                 st = _SlotState(req=req, prompt_ids=ids, max_new=max_new,
                                 seq=seq, t_start=time.time())
                 slots[b] = st  # phase="prefill"; device work happens in the loop
+                # a decode dispatch can run while this slot is still
+                # mid-prefill (chunked prefill): its row must carry length
+                # 0, not the previous occupant's stale length — the ragged
+                # kernel derives its page-walk bound from kv_lens and a
+                # stale value over-runs the [B, w] table in SMEM
+                kv_lens[b] = 0
+                last_tok[b] = 0
                 temps[b] = req.temperature
                 top_k[b] = req.top_k
                 top_p[b] = min(max(req.top_p, 0.0), 1.0)
@@ -290,7 +297,8 @@ class ContinuousScheduler:
                     st.generated.append(tok0)
                     last_tok[b] = tok0
                     self.seed_history(b, st)
-                    self._maybe_finish(b, slots, results, active, fresh)
+                    self._maybe_finish(b, slots, results, active, fresh,
+                                       kv_lens, last_tok)
                 deferred = []
                 pending = []
             if not any(active):
@@ -320,7 +328,8 @@ class ContinuousScheduler:
                 kv_lens[b] = st.kv_len
                 last_tok[b] = st.generated[-1] if st.generated else 0
                 self.metrics["decode_tokens"] += len(new)
-                self._maybe_finish(b, slots, results, active, fresh)
+                self._maybe_finish(b, slots, results, active, fresh,
+                                   kv_lens, last_tok)
 
         self.metrics["run_seconds"] += time.time() - t_run
         return [results[r.request_id] for r in all_requests]
@@ -337,7 +346,8 @@ class ContinuousScheduler:
             ids = ids[:head] + ids[-tail:]
         return ids, max_new
 
-    def _maybe_finish(self, b, slots, results, active, fresh=None):
+    def _maybe_finish(self, b, slots, results, active, fresh=None,
+                      kv_lens=None, last_tok=None):
         st = slots[b]
         # decode runs in fixed blocks, so a slot can overshoot its budget by
         # up to decode_block-1 tokens between host syncs — trim to budget
@@ -369,6 +379,13 @@ class ContinuousScheduler:
             self.cache.close_sequence(st.seq)
             slots[b] = None
             active[b] = False
+            # freed rows must carry length 0 (same invariant as admission):
+            # a stale length makes every later decode dispatch walk null
+            # pages for this row, and OOB safety should not rest on the
+            # kernel clamp alone
+            if kv_lens is not None:
+                kv_lens[b] = 0
+                last_tok[b] = 0
 
     # ------------------------------------------------------------- prefill
 

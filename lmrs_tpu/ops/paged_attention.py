@@ -85,7 +85,13 @@ def _ragged_decode_kernel(
 ):
     b = pl.program_id(0)
     length = kv_lens_ref[b]
-    n_pages = jax.lax.div(length + page_size - 1, page_size)
+    # clamp to the table width: a row whose length exceeds its table (e.g.
+    # an inactive row carrying a stale/garbage length) must never index
+    # page_tables_ref out of bounds — SMEM reads are not range-checked
+    n_pages = jnp.minimum(
+        jax.lax.div(length + page_size - 1, page_size),
+        page_tables_ref.shape[1],
+    )
 
     m_scr[:] = jnp.full_like(m_scr, NEG_INF)
     l_scr[:] = jnp.zeros_like(l_scr)
@@ -164,7 +170,11 @@ def _fused_decode_kernel(
     b = pl.program_id(0)
     length = kv_lens_ref[b]
     pos = length - 1
-    page = page_tables_ref[b, jax.lax.div(pos, page_size)]
+    # clamped like the walk bound below: never index the table OOB, even
+    # for rows carrying a degenerate length (inactive slots write page 0)
+    page_idx = jnp.clip(jax.lax.div(pos, page_size), 0,
+                        page_tables_ref.shape[1] - 1)
+    page = page_tables_ref[b, page_idx]
     off = jax.lax.rem(pos, page_size)
 
     # Write the current token's K/V into its page slot IN PLACE (k_out is
