@@ -97,3 +97,52 @@ def test_fused_decode_matches_scatter_plus_xla():
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
     np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
+
+
+def test_ragged_decode_clamps_stale_lengths():
+    """Regression: a row whose kv_len exceeds its page table's width (a
+    freed slot's stale length, or any degenerate input) must clamp its
+    page walk and write index to the table instead of indexing SMEM out
+    of bounds — on real TPUs the unclamped read DMA'd from garbage page
+    ids (fixed alongside scheduler-side zeroing; see scheduler admit()/
+    _maybe_finish)."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas_fused
+
+    b, h, kh, hd, ps, n_pages = 2, 4, 4, 128, 16, 12
+    rng = jax.random.split(jax.random.PRNGKey(1), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
+    from lmrs_tpu.ops.paged_attention import paged_decode_xla
+
+    tables = jnp.asarray([[3, 5], [9, 0]], jnp.int32)  # width 2 = 32 tokens
+    # row 0 normal; row 1 claims 180 tokens (needs 12 pages > width 2)
+    kv_lens = jnp.asarray([20, 180], jnp.int32)
+    W, clamped = tables.shape[1], jnp.minimum(kv_lens, tables.shape[1] * ps)
+
+    got, k_out, v_out = paged_decode_pallas_fused(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+
+    # reference mirrors the kernel's CLAMPED write (page index clipped to
+    # the table width) and attends each tabled page exactly once with the
+    # length capped at the table capacity.  The unclamped kernel would
+    # re-attend its last column's page for every overflow walk step
+    # (interpret-mode ref clamping), shifting row 1's softmax — so output
+    # parity here genuinely discriminates fixed vs broken kernels.
+    pos = kv_lens - 1
+    page = jnp.take_along_axis(tables, jnp.minimum(pos // ps, W - 1)[:, None], 1)[:, 0]
+    off = pos % ps
+    k_ref = k_pages.at[:, page, off].set(k_new.transpose(1, 0, 2))
+    v_ref = v_pages.at[:, page, off].set(v_new.transpose(1, 0, 2))
+    want = paged_decode_xla(q, k_ref, v_ref, tables, clamped)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # writes land ONLY on the two rows' write pages: row 0 -> page 5
+    # (pos 19, column 1), row 1 -> page 0 (clamped column 1); K and V both
+    for name, out_pool, in_pool in (("k", k_out, k_pages), ("v", v_out, v_pages)):
+        touched = set(np.flatnonzero(
+            (np.asarray(out_pool) != np.asarray(in_pool)).any(axis=(0, 2, 3))))
+        assert touched == {5, 0}, f"{name} wrote pages {touched}, want {{5, 0}}"
