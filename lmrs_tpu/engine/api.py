@@ -42,12 +42,35 @@ class GenerationResult:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     finish_reason: str = "stop"  # stop | length | error
+    # which request stop string ended generation, if any — lets wire formats
+    # that distinguish stop-sequence hits from EOS (Anthropic's
+    # stop_reason="stop_sequence") report faithfully
+    stop_sequence: str | None = None
     device_seconds: float = 0.0
     error: str | None = None
 
     @property
     def total_tokens(self) -> int:
         return self.prompt_tokens + self.completion_tokens
+
+
+def apply_stop_sequences(text: str, stops: tuple[str, ...]) -> tuple[str, str | None]:
+    """Truncate ``text`` at the earliest-in-text stop string (ties broken by
+    list order).  Returns (truncated_text, stop_hit_or_None).  One shared
+    implementation so every engine agrees on wire-visible stop semantics —
+    the returned text never contains any requested stop string.  Empty stop
+    strings are skipped (they'd match at position 0 and silently truncate
+    the whole completion; real APIs reject them)."""
+    best_pos, best_stop = len(text) + 1, None
+    for stop in stops:
+        if not stop:
+            continue
+        pos = text.find(stop)
+        if pos != -1 and pos < best_pos:
+            best_pos, best_stop = pos, stop
+    if best_stop is None:
+        return text, None
+    return text[:best_pos], best_stop
 
 
 @runtime_checkable

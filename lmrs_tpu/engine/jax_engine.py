@@ -38,7 +38,8 @@ import numpy as np
 
 from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
 from lmrs_tpu.data.tokenizer import ByteTokenizer, get_tokenizer
-from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
+                                 apply_stop_sequences)
 from lmrs_tpu.models.transformer import forward, init_kv_cache, init_params, param_count
 from lmrs_tpu.ops.sampling import sample_logits
 
@@ -225,11 +226,10 @@ class JaxEngine:
                 gen = gen[: gen.index(self.tokenizer.eos_id)]
             elif len(gen) >= max_new:
                 finish = "length"
-            text = self.tokenizer.decode(gen)
-            for stop in req.stop:
-                if stop in text:
-                    text = text.split(stop, 1)[0]
-                    finish = "stop"
+            text, stop_hit = apply_stop_sequences(
+                self.tokenizer.decode(gen), req.stop)
+            if stop_hit is not None:
+                finish = "stop"
             results.append(
                 (req, GenerationResult(
                     request_id=req.request_id,
@@ -237,6 +237,7 @@ class JaxEngine:
                     prompt_tokens=len(ids),
                     completion_tokens=len(gen),
                     finish_reason=finish,
+                    stop_sequence=stop_hit,
                     device_seconds=per_req_dt,
                 ))
             )

@@ -16,7 +16,8 @@ import re
 import time
 
 from lmrs_tpu.data.tokenizer import ApproxTokenizer
-from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
+                                 apply_stop_sequences)
 
 _TS_RE = re.compile(r"\[(?:\d+:)?\d{2}:\d{2}\]")
 
@@ -58,13 +59,15 @@ class MockEngine:
                 finish_reason="error",
                 error="mock: injected failure",
             )
-        text = self._extractive_sketch(req.prompt)
+        text, stop_hit = apply_stop_sequences(
+            self._extractive_sketch(req.prompt), req.stop)
         return GenerationResult(
             request_id=req.request_id,
             text=text,
             prompt_tokens=self._tok.count(req.prompt),
             completion_tokens=self._tok.count(text),
             finish_reason="stop",
+            stop_sequence=stop_hit,
         )
 
     def _extractive_sketch(self, prompt: str) -> str:

@@ -194,7 +194,9 @@ class ReplicatedEngine:
         for replica in self.replicas:
             replica.shutdown()
         for pool in self._pools:
-            pool.shutdown(wait=False)
+            # cancel_futures: a wedged probe future would otherwise pin a
+            # non-daemon worker thread at interpreter exit
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def engine_metrics(self) -> dict:
         """Fleet metrics in the same shape as one scheduler's report

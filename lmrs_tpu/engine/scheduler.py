@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from lmrs_tpu.config import EngineConfig, ModelConfig
-from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
+                                 apply_stop_sequences)
 from lmrs_tpu.engine.kv_cache import PagedKVCache, SequencePages
 from lmrs_tpu.models.transformer import forward_paged
 from lmrs_tpu.ops.sampling import sample_logits
@@ -356,15 +357,8 @@ class ContinuousScheduler:
         hit_eos = eos in gen
         if hit_eos:
             gen = gen[: gen.index(eos)]
-        text = self.tokenizer.decode(gen)
-        stop_hit = None
-        for stop in st.req.stop:
-            if stop in text:
-                stop_hit = stop
-                break
+        text, stop_hit = apply_stop_sequences(self.tokenizer.decode(gen), st.req.stop)
         if hit_eos or stop_hit or len(gen) >= st.max_new:
-            if stop_hit:
-                text = text.split(stop_hit, 1)[0]
             finish = "stop" if (hit_eos or stop_hit) else "length"
             results[st.req.request_id] = GenerationResult(
                 request_id=st.req.request_id,
@@ -372,6 +366,7 @@ class ContinuousScheduler:
                 prompt_tokens=len(st.prompt_ids),
                 completion_tokens=len(gen),
                 finish_reason=finish,
+                stop_sequence=stop_hit,
                 device_seconds=time.time() - st.t_start,
             )
             if fresh is not None:

@@ -52,6 +52,12 @@ class _Batcher:
         self.window_s = window_s
         self.max_batch = max_batch
         self.queue: queue.Queue[_Job | None] = queue.Queue()
+        self.closed = False
+        # orders every submit() against shutdown(): a job is either enqueued
+        # strictly before the sentinel (the dispatcher's final drain then
+        # completes it) or rejected fast — event.wait() can never hang a
+        # handler thread on a job the dispatcher will never see
+        self._close_lock = threading.Lock()
         self.batches_run = 0
         self.requests_served = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -59,19 +65,26 @@ class _Batcher:
 
     def submit(self, request: GenerationRequest) -> GenerationResult:
         job = _Job(request)
-        self.queue.put(job)
+        with self._close_lock:
+            if self.closed:
+                return GenerationResult(request_id=0, finish_reason="error",
+                                        error="server shutting down")
+            self.queue.put(job)
         job.event.wait()
         assert job.result is not None
         return job.result
 
     def shutdown(self) -> None:
-        self.queue.put(None)
+        with self._close_lock:
+            self.closed = True
+            self.queue.put(None)
         self._thread.join(timeout=5)
 
     def _loop(self) -> None:
         while True:
             job = self.queue.get()
             if job is None:
+                self._drain_on_shutdown()
                 return
             jobs = [job]
             deadline = time.monotonic() + self.window_s
@@ -85,9 +98,26 @@ class _Batcher:
                     break
                 if nxt is None:
                     self._run(jobs)
+                    self._drain_on_shutdown()
                     return
                 jobs.append(nxt)
             self._run(jobs)
+
+    def _drain_on_shutdown(self) -> None:
+        """Jobs enqueued behind the shutdown sentinel (multiple shutdown()
+        calls can race a submit past an earlier sentinel) would otherwise
+        block their submit() forever — complete them with an error result.
+        Only the dispatcher thread runs this, after consuming a sentinel."""
+        while True:
+            try:
+                job = self.queue.get_nowait()
+            except queue.Empty:
+                return
+            if job is None:
+                continue
+            job.result = GenerationResult(
+                request_id=0, finish_reason="error", error="server shutting down")
+            job.event.set()
 
     def _run(self, jobs: list[_Job]) -> None:
         for i, job in enumerate(jobs):  # engine results map back by id
@@ -116,13 +146,14 @@ def _clamp_max_tokens(value, cap: int) -> int:
     return min(max(n, 0), cap)
 
 
-def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
-    """OpenAI ``messages`` → one GenerationRequest.  System messages join the
-    system prompt; the rest concatenate in order with role tags (multi-turn
-    becomes a single serving prompt — same collapse the reference performs in
-    reverse when it wraps one prompt as a messages array)."""
+def _flatten_messages(messages: list) -> tuple[list[str], list[str]]:
+    """Shared messages[] collapse for both wire formats: system messages join
+    the system prompt; user/tool turns concatenate in order; assistant turns
+    become role-tagged context for the next user turn (multi-turn becomes a
+    single serving prompt — same collapse the reference performs in reverse
+    when it wraps one prompt as a messages array)."""
     system_parts, user_parts = [], []
-    for msg in body.get("messages", []):
+    for msg in messages:
         role = msg.get("role", "user")
         content = msg.get("content", "")
         if isinstance(content, list):  # content-blocks form
@@ -134,6 +165,12 @@ def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
             user_parts.append(content)
         else:  # assistant turns are context for the next user turn
             user_parts.append(f"[assistant]: {content}")
+    return system_parts, user_parts
+
+
+def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
+    """OpenAI ``messages`` → one GenerationRequest."""
+    system_parts, user_parts = _flatten_messages(body.get("messages", []))
     stop = body.get("stop") or body.get("stop_sequences") or ()
     if isinstance(stop, str):
         stop = (stop,)
@@ -151,23 +188,25 @@ def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
 
 def _messages_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
     """Anthropic messages → GenerationRequest (top-level ``system`` field —
-    the real API shape, not the reference's system-role-in-messages bug)."""
-    user_parts = []
-    for msg in body.get("messages", []):
-        content = msg.get("content", "")
-        if isinstance(content, list):
-            content = "".join(
-                blk.get("text", "") for blk in content if isinstance(blk, dict))
-        role = msg.get("role", "user")
-        user_parts.append(content if role == "user" else f"[assistant]: {content}")
+    the real API shape; also tolerates the reference's system-role-in-messages
+    bug, llm_executor.py:350-358, by routing those into the system prompt)."""
+    system = body.get("system") or None
+    if isinstance(system, list):  # content-block form of top-level system
+        system = "".join(
+            blk.get("text", "") for blk in system if isinstance(blk, dict))
+    msg_system, user_parts = _flatten_messages(body.get("messages", []))
+    system_parts = ([system] if system else []) + msg_system
+    stop = body.get("stop_sequences") or ()
+    if isinstance(stop, str):  # bare-string form, same guard as the chat path
+        stop = (stop,)
     return GenerationRequest(
         prompt="\n\n".join(user_parts),
-        system_prompt=body.get("system") or None,
+        system_prompt="\n\n".join(system_parts) or None,
         max_new_tokens=_clamp_max_tokens(body.get("max_tokens"),
                                          max_tokens_cap),
         temperature=float(body.get("temperature", 0.3)),
         top_p=float(body.get("top_p", 1.0)),
-        stop=tuple(body.get("stop_sequences") or ()),
+        stop=tuple(stop),
     )
 
 
@@ -227,12 +266,27 @@ class EngineHTTPServer:
                 if body is None:
                     self._send(400, {"error": {"message": "invalid JSON body"}})
                     return
+                # SSE is not implemented; a streaming client would fail to
+                # parse a plain JSON body, so reject loudly (in each wire
+                # format's own error envelope) instead of silently ignoring
+                stream_msg = ("streaming is not supported by this server; "
+                              "retry with stream=false")
                 try:
                     if self.path == "/v1/chat/completions":
+                        if body.get("stream"):
+                            self._send(400, {"error": {
+                                "message": stream_msg,
+                                "type": "invalid_request_error"}})
+                            return
                         req = _chat_to_request(body, outer.max_tokens_cap)
                         res = outer.batcher.submit(req)
                         self._respond_openai(body, res)
                     elif self.path == "/v1/messages":
+                        if body.get("stream"):
+                            self._send(400, {"type": "error", "error": {
+                                "type": "invalid_request_error",
+                                "message": stream_msg}})
+                            return
                         req = _messages_to_request(body, outer.max_tokens_cap)
                         res = outer.batcher.submit(req)
                         self._respond_anthropic(body, res)
@@ -276,8 +330,11 @@ class EngineHTTPServer:
                     "role": "assistant",
                     "model": body.get("model") or outer.model_name,
                     "content": [{"type": "text", "text": res.text}],
-                    "stop_reason": ("end_turn" if res.finish_reason == "stop"
-                                    else "max_tokens"),
+                    "stop_reason": (
+                        "stop_sequence" if res.stop_sequence is not None
+                        else "end_turn" if res.finish_reason == "stop"
+                        else "max_tokens"),
+                    "stop_sequence": res.stop_sequence,
                     "usage": {"input_tokens": res.prompt_tokens,
                               "output_tokens": res.completion_tokens},
                 })

@@ -154,6 +154,116 @@ def test_concurrent_requests_pool_into_one_batch(server):
     assert max(sizes) > 1, f"no pooling happened: {sizes}"
 
 
+def test_anthropic_system_role_in_messages(server):
+    """The reference's own Anthropic client puts the system prompt inside
+    messages[] with role='system' (llm_executor.py:350-358); it must land in
+    the system prompt, not be relabeled as an assistant turn."""
+    status, out = _post(server, "/v1/messages", {
+        "messages": [
+            {"role": "system", "content": "You are a summarizer."},
+            {"role": "user", "content": "Summarize: planning sync."},
+        ],
+        "max_tokens": 64,
+    })
+    assert status == 200
+    assert "[assistant]" not in out["content"][0]["text"]
+
+
+def test_anthropic_system_content_blocks(server):
+    """Top-level system given as a content-block list (valid Anthropic shape)
+    must flatten, not 500 on a TypeError."""
+    status, out = _post(server, "/v1/messages", {
+        "system": [{"type": "text", "text": "You are a summarizer."}],
+        "messages": [{"role": "user", "content": "Summarize: retro notes."}],
+        "max_tokens": 64,
+    })
+    assert status == 200 and out["content"][0]["text"]
+
+
+def test_stream_true_rejected_with_400(server):
+    for path in ("/v1/chat/completions", "/v1/messages"):
+        req = urllib.request.Request(
+            f"http://{server.host}:{server.port}{path}",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+
+
+def test_anthropic_stop_sequence_reason(server):
+    """A stop-sequence hit must report stop_reason='stop_sequence', not
+    'end_turn' (the wire format the server claims to implement)."""
+    status, out = _post(server, "/v1/messages", {
+        "messages": [{"role": "user", "content": "explain the Summary: format"}],
+        "max_tokens": 64,
+        "stop_sequences": ["Summary:"],
+    })
+    assert status == 200
+    assert out["stop_reason"] == "stop_sequence"
+    assert out["stop_sequence"] == "Summary:"
+    assert "Summary:" not in out["content"][0]["text"]
+
+
+def test_apply_stop_sequences_earliest_in_text_wins():
+    from lmrs_tpu.engine.api import apply_stop_sequences
+
+    # earliest occurrence in TEXT wins, regardless of list order — the
+    # returned text never contains any requested stop string
+    text, hit = apply_stop_sequences("a STOP b END c", ("END", "STOP"))
+    assert (text, hit) == ("a ", "STOP")
+    assert apply_stop_sequences("no stops here", ("END",)) == ("no stops here", None)
+    assert apply_stop_sequences("xEND", ()) == ("xEND", None)
+    # empty stop strings must not truncate the whole completion
+    assert apply_stop_sequences("keep me", ("", "END")) == ("keep me", None)
+
+
+def test_anthropic_bare_string_stop_sequences(server):
+    """stop_sequences given as a bare string must not explode into
+    per-character stops."""
+    status, out = _post(server, "/v1/messages", {
+        "messages": [{"role": "user", "content": "explain the Summary: format"}],
+        "max_tokens": 64,
+        "stop_sequences": "Summary:",
+    })
+    assert status == 200
+    # a per-char explosion would truncate at the first 'S'/'u'/... hit and
+    # report a single-character stop_sequence
+    assert out["stop_sequence"] in (None, "Summary:")
+
+
+def test_batcher_drains_jobs_behind_shutdown_sentinel():
+    """Jobs enqueued behind the shutdown sentinel must be completed (with an
+    error), not left blocking submit() forever."""
+    from lmrs_tpu.serving.server import _Batcher, _Job
+
+    class SlowEngine:
+        def generate_batch(self, requests):
+            import time as _t
+            _t.sleep(0.2)
+            return [GenerationResult(request_id=r.request_id) for r in requests]
+
+    b = _Batcher(SlowEngine(), window_s=0.01)
+    # occupy the dispatcher with a real job, then enqueue sentinel + straggler
+    first = threading.Thread(
+        target=b.submit, args=(GenerationRequest(prompt="x", request_id=0),))
+    first.start()
+    import time as _t
+    _t.sleep(0.05)  # let the dispatcher pick up the first job
+    straggler = _Job(GenerationRequest(prompt="y", request_id=1))
+    b.queue.put(None)          # shutdown sentinel
+    b.queue.put(straggler)     # enqueued BEHIND the sentinel
+    b._thread.join(timeout=5)
+    assert not b._thread.is_alive()
+    assert straggler.event.wait(timeout=1)
+    assert straggler.result is not None and straggler.result.error
+    first.join(timeout=5)
+
+
 def test_stop_sequence_and_cap(server):
     status, out = _post(server, "/v1/chat/completions", {
         "messages": [{"role": "user", "content": "hello"}],
