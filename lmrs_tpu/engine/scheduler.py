@@ -113,10 +113,16 @@ class ContinuousScheduler:
         num_pages = max(engine_cfg.num_pages, self.B * max_pages_per_slot + 1)
         self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot,
                                   mesh=mesh)
+        # LMRS_FORCE_KERNELS=interpret: run the Pallas kernels in interpret
+        # mode regardless of platform — the CPU-mesh test path for the
+        # shard_map-wrapped kernels (tests can't see a real TPU)
+        self._interpret = (os.environ.get("LMRS_FORCE_KERNELS", "").lower()
+                           == "interpret")
         self._use_ragged = self._pick_kernel()
-        # flash prefill: single-device only (same pallas-under-mesh limit as
-        # the ragged gate above); also cleared if lowering fails at runtime
-        self._use_flash = self._single_device()
+        # flash prefill: same tp-only-mesh limit as the ragged gate (under a
+        # mesh the kernel runs via shard_map over the tp head axis); also
+        # cleared if lowering fails at runtime
+        self._use_flash = self._tp_only_mesh()
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
@@ -157,18 +163,34 @@ class ContinuousScheduler:
         from lmrs_tpu.utils.platform import on_tpu
 
         if self.cfg.scheduler == "continuous":
-            # ragged kernel wants MXU-friendly head_dim, a TPU backend, and a
-            # single device (under a mesh, XLA auto-partitioning of the
-            # pallas_call is not supported — the gather fallback shards fine);
-            # the fused write RMWs an 8-row-aligned DMA window, which only
-            # stays inside the page when the page size is a multiple of 8.
-            # A 1-device mesh (a pinned DP replica) is fine: no partitioning.
-            return (on_tpu() and self.model_cfg.hd % 128 == 0
-                    and self.cfg.page_size % 8 == 0 and self._single_device())
+            # ragged kernel wants MXU-friendly head_dim, a TPU backend (or
+            # forced interpret mode), and a mesh whose only sharded serving
+            # axis is tp — the kernel then runs per kv-head shard inside
+            # shard_map (ops/paged_attention.paged_decode_fused_sharded);
+            # XLA cannot auto-partition a pallas_call, but pages are already
+            # kv-head-sharded so each shard's walk is local.  The fused
+            # write RMWs an 8-row-aligned DMA window, which only stays
+            # inside the page when the page size is a multiple of 8.
+            return ((on_tpu() or self._interpret)
+                    and self.model_cfg.hd % 128 == 0
+                    and self.cfg.page_size % 8 == 0 and self._tp_only_mesh())
         return False
 
     def _single_device(self) -> bool:
         return self.mesh is None or self.mesh.devices.size == 1
+
+    def _tp_only_mesh(self) -> bool:
+        """True when there is no mesh, a 1-device mesh, or a mesh whose only
+        >1 axis is ``tp`` — the layouts the shard_map-wrapped kernels
+        support (kv-head-sharded pages, replicated tables/lengths)."""
+        if self._single_device():
+            return True
+        return self.mesh.devices.size == self.mesh.shape.get("tp", 1)
+
+    def _kernel_mesh(self):
+        """Mesh to hand the Pallas paths: None on a single device (plain
+        pallas_call), the tp mesh otherwise (shard_map wrapping)."""
+        return None if self._single_device() else self.mesh
 
     # ----------------------------------------------------------- public API
 
@@ -484,6 +506,8 @@ class ContinuousScheduler:
         cfg = self.model_cfg
         rope_max = self.max_len
         use_flash = self._use_flash  # captured: rebuilt fns see the fallback
+        mesh_ = self._kernel_mesh()
+        interp = self._interpret
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill(params, k_pages, v_pages, tokens, start, length,
@@ -498,6 +522,7 @@ class ContinuousScheduler:
             logits, k_pages, v_pages = forward_paged(
                 params, cfg, tokens, write_pos, k_pages, v_pages, table,
                 length, rope_max, use_ragged_kernel=False, use_flash=use_flash,
+                mesh=mesh_, interpret=interp,
             )
             last = jnp.take_along_axis(logits, (length - 1)[:, None, None], axis=1)[:, 0]
             tok0 = sample_logits(last, key, temp, tk, tp)
@@ -642,6 +667,8 @@ class ContinuousScheduler:
         max_len = self.max_len
         rope_max = self.max_len
         use_ragged = self._use_ragged
+        mesh_ = self._kernel_mesh()
+        interp = self._interpret
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode(params, k_pages, v_pages, last_tok, kv_lens, table, active,
@@ -653,6 +680,7 @@ class ContinuousScheduler:
                     params, cfg, tok[:, None], pos, k_pages, v_pages, table,
                     jnp.minimum(lens + 1, max_len), rope_max,
                     use_ragged_kernel=use_ragged,
+                    mesh=mesh_, interpret=interp,
                 )
                 key, sub = jax.random.split(key)
                 nxt = sample_logits(logits[:, 0], sub, temps, tk, tp)

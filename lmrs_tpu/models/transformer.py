@@ -293,14 +293,15 @@ def forward(
     return logits, new_cache
 
 
-def _use_flash_prefill(seq_len: int, hd: int) -> bool:
-    """Route fresh prefill through the Pallas flash kernel: TPU backend, a
-    sequence long enough that O(S²) logits materialization starts to matter,
-    and a lane-aligned head dim (validated on hardware for multiples of 64;
-    smaller head dims fail Mosaic lowering)."""
+def _use_flash_prefill(seq_len: int, hd: int, interpret: bool = False) -> bool:
+    """Route fresh prefill through the Pallas flash kernel: TPU backend (or
+    interpret mode, for CPU-mesh tests), a sequence long enough that O(S²)
+    logits materialization starts to matter, and a lane-aligned head dim
+    (validated on hardware for multiples of 64; smaller head dims fail
+    Mosaic lowering)."""
     from lmrs_tpu.utils.platform import on_tpu
 
-    return seq_len >= 256 and hd % 64 == 0 and on_tpu()
+    return seq_len >= 256 and hd % 64 == 0 and (interpret or on_tpu())
 
 
 def forward_paged(
@@ -316,6 +317,8 @@ def forward_paged(
     use_ragged_kernel: bool = False,
     window_prefill: bool = False,
     use_flash: bool = True,  # allow the flash prefill kernel (when eligible)
+    mesh=None,  # tensor-parallel mesh: Pallas calls run via shard_map over tp
+    interpret: bool = False,  # Pallas interpret mode (CPU-mesh tests)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -334,6 +337,7 @@ def forward_paged(
     == absolute position), masked causally by absolute position + kv_lens.
     """
     from lmrs_tpu.ops.paged_attention import (
+        paged_decode_fused_sharded,
         paged_decode_pallas_fused,
         paged_decode_xla,
     )
@@ -376,9 +380,17 @@ def forward_paged(
             # write-fused ragged kernel: the current token's K/V lands in
             # its page by in-place DMA inside the kernel (pools are i/o
             # aliased), replacing the XLA scatter below — which was measured
-            # copying the whole pool every decode step
-            attn, kp_all, vp_all = paged_decode_pallas_fused(
-                q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables, kv_lens)
+            # copying the whole pool every decode step.  Under a tp mesh the
+            # kernel runs per kv-head shard via shard_map (XLA cannot
+            # auto-partition a pallas_call).
+            if mesh is not None:
+                attn, kp_all, vp_all = paged_decode_fused_sharded(
+                    q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables,
+                    kv_lens, mesh, interpret=interpret)
+            else:
+                attn, kp_all, vp_all = paged_decode_pallas_fused(
+                    q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables,
+                    kv_lens, interpret=interpret)
             attn_out = attn[:, None]  # [B, 1, H, hd]
             return _finish_layer(lp, x, attn_out, kp_all, vp_all)
 
@@ -404,10 +416,16 @@ def forward_paged(
             # position is i (scheduler fresh-prefill contract), which is
             # exactly the flash kernel's implicit layout — use it on TPU for
             # long chunks; XLA reference elsewhere.
-            if use_flash and _use_flash_prefill(s, hd):
-                from lmrs_tpu.ops.flash_attention import flash_attention
+            if use_flash and _use_flash_prefill(s, hd, interpret):
+                from lmrs_tpu.ops.flash_attention import (
+                    flash_attention, flash_attention_sharded)
 
-                attn_out = flash_attention(q, k, v, kv_lens)
+                if mesh is not None:
+                    attn_out = flash_attention_sharded(
+                        q, k, v, kv_lens, mesh, interpret=interpret)
+                else:
+                    attn_out = flash_attention(q, k, v, kv_lens,
+                                               interpret=interpret)
             else:
                 attn_out = attention(q, k, v, positions, kv_lens)
         return _finish_layer(lp, x, attn_out, kp_all, vp_all)

@@ -186,3 +186,29 @@ def flash_attention(
     if pad_q:
         out = out[:, :sq]
     return out
+
+
+def flash_attention_sharded(
+    q: jnp.ndarray,        # [B, Sq, H, hd] (H sharded over tp)
+    k: jnp.ndarray,        # [B, Skv, K, hd] (K sharded over tp)
+    v: jnp.ndarray,        # [B, Skv, K, hd]
+    lengths: jnp.ndarray,  # [B] replicated
+    mesh,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash prefill under a tensor-parallel mesh: ``shard_map`` over the
+    ``tp`` head axis (a pallas_call cannot be auto-partitioned by XLA).
+    Attention is independent per head and Q heads shard together with their
+    kv head (GQA grouping stays shard-local), so each shard runs the
+    unmodified kernel on its local heads."""
+    from jax.sharding import PartitionSpec as P
+
+    head4 = P(None, None, "tp", None)
+    fn = jax.shard_map(
+        functools.partial(flash_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(head4, head4, head4, P(None)),
+        out_specs=head4,
+        check_vma=False,
+    )
+    return fn(q, k, v, lengths)

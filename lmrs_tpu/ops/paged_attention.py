@@ -293,6 +293,40 @@ def paged_decode_pallas_fused(
     return out[:, :, :n_rep].reshape(b, h, hd), k_pages, v_pages
 
 
+def paged_decode_fused_sharded(
+    q: jnp.ndarray,            # [B, H, hd] (H sharded over tp)
+    k_new: jnp.ndarray,        # [B, K, hd] (K sharded over tp)
+    v_new: jnp.ndarray,        # [B, K, hd]
+    k_pages: jnp.ndarray,      # [K, P_total, ps, hd] (kv-head sharded)
+    v_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] replicated
+    kv_lens: jnp.ndarray,      # [B] replicated
+    mesh,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Write-fused ragged decode under a tensor-parallel mesh.
+
+    XLA cannot auto-partition a ``pallas_call``, so the kernel runs inside
+    ``shard_map`` over the ``tp`` (kv-head) axis: the page pools are already
+    kv-head-sharded (engine/kv_cache.py), each shard's page walk and in-place
+    K/V write touch only local HBM, and query heads shard consistently with
+    their kv head (H/tp = (K/tp) * n_rep) — no cross-chip KV traffic, same
+    contract as the single-device kernel per shard.  Page tables and lengths
+    replicate (host-built, O(B*W) ints)."""
+    from jax.sharding import PartitionSpec as P
+
+    head = P(None, "tp", None)
+    pool = P("tp", None, None, None)
+    fn = jax.shard_map(
+        functools.partial(paged_decode_pallas_fused, interpret=interpret),
+        mesh=mesh,
+        in_specs=(head, head, head, pool, pool, P(None, None), P(None)),
+        out_specs=(head, pool, pool),
+        check_vma=False,
+    )
+    return fn(q, k_new, v_new, k_pages, v_pages, page_tables, kv_lens)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_pallas(
     q: jnp.ndarray,            # [B, H, hd]

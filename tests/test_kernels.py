@@ -146,3 +146,65 @@ def test_ragged_decode_clamps_stale_lengths():
         touched = set(np.flatnonzero(
             (np.asarray(out_pool) != np.asarray(in_pool)).any(axis=(0, 2, 3))))
         assert touched == {5, 0}, f"{name} wrote pages {touched}, want {{5, 0}}"
+
+
+def _tp_mesh(tp=2):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:tp]).reshape(1, tp, 1, 1, 1),
+                ("dp", "tp", "sp", "ep", "pp"))
+
+
+def test_fused_decode_sharded_matches_xla():
+    """The shard_map-wrapped fused decode kernel under a tp=2 mesh must
+    match the XLA scatter+gather reference — pools kv-head-sharded, tables
+    and lengths replicated (the TP serving layout, kv_cache.py)."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_fused_sharded,
+        paged_decode_xla,
+    )
+
+    b, h, kh, hd, ps, n_pages = 3, 8, 2, 128, 16, 12
+    rng = jax.random.split(jax.random.PRNGKey(2), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [6, 7, 8, 0]], jnp.int32)
+    kv_lens = jnp.asarray([40, 17, 33], jnp.int32)
+
+    pos = kv_lens - 1
+    page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
+    off = pos % ps
+    k_ref = k_pages.at[:, page, off].set(k_new.transpose(1, 0, 2))
+    v_ref = v_pages.at[:, page, off].set(v_new.transpose(1, 0, 2))
+    want = paged_decode_xla(q, k_ref, v_ref, tables, kv_lens)
+
+    got, k_out, v_out = paged_decode_fused_sharded(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens,
+        _tp_mesh(), interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
+    np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
+
+
+def test_flash_sharded_matches_reference():
+    """The shard_map-wrapped flash prefill kernel under a tp=2 mesh must
+    match the XLA attention reference (GQA heads shard with their kv head)."""
+    from lmrs_tpu.ops.flash_attention import flash_attention_sharded
+
+    b, s, h, kh, hd = 2, 512, 8, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kh, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kh, hd), jnp.float32)
+    lengths = jnp.asarray([s, s // 3], jnp.int32)
+    got = flash_attention_sharded(q, k, v, lengths, _tp_mesh(), interpret=True)
+    want = _ref(q, k, v, lengths)
+    for i, n in enumerate([s, s // 3]):
+        np.testing.assert_allclose(np.asarray(got[i, :n]),
+                                   np.asarray(want[i, :n]),
+                                   rtol=2e-5, atol=2e-5)
