@@ -38,7 +38,7 @@ import numpy as np
 from lmrs_tpu.config import EngineConfig, ModelConfig
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  apply_stop_sequences)
-from lmrs_tpu.engine.kv_cache import PagedKVCache, SequencePages
+from lmrs_tpu.engine.kv_cache import OutOfPages, PagedKVCache, SequencePages
 from lmrs_tpu.models.transformer import forward_paged
 from lmrs_tpu.ops.sampling import sample_logits
 
@@ -61,7 +61,7 @@ def _pow2_bucket(n: int, lo: int) -> int:
 @dataclass
 class _SlotState:
     req: GenerationRequest
-    prompt_ids: list[int]
+    prompt_ids: list[int]  # ids to prefill (after a preemption: prompt + prior)
     max_new: int
     seq: SequencePages
     generated: list[int] = field(default_factory=list)
@@ -74,6 +74,12 @@ class _SlotState:
     # prompt.  ``prefill_pos`` = prompt tokens already written to KV.
     phase: str = "prefill"
     prefill_pos: int = 0
+    # preemption bookkeeping: a preempted slot re-enters the queue with its
+    # generated-so-far tokens folded into ``prompt_ids`` (the continuation
+    # re-prefills them); ``n_prompt`` keeps the ORIGINAL prompt length for
+    # accounting and ``prior`` the tokens generated before the preemption.
+    n_prompt: int = 0
+    prior: list[int] = field(default_factory=list)
 
 
 class ContinuousScheduler:
@@ -108,9 +114,16 @@ class ContinuousScheduler:
         self.defer_tok0 = os.environ.get("LMRS_DEFER_TOK0", "1") != "0"
         ps = engine_cfg.page_size
         max_pages_per_slot = -(-self.max_len // ps)
-        # pool sized so every slot can hold a full-length sequence, or the
-        # configured pool size if larger (+1: page 0 is the reserved null page)
-        num_pages = max(engine_cfg.num_pages, self.B * max_pages_per_slot + 1)
+        # Pool sizing: an explicit num_pages (> 1) is an HBM budget and is
+        # honored, floored at one full-length sequence + the reserved null
+        # page — under pressure, slots grow on demand and the youngest is
+        # preempted (vLLM-style) instead of over-provisioning.  num_pages <= 1
+        # asks for worst-case sizing (every slot can hold a full sequence;
+        # preemption can then never trigger).
+        if engine_cfg.num_pages > 1:
+            num_pages = max(engine_cfg.num_pages, max_pages_per_slot + 1)
+        else:
+            num_pages = self.B * max_pages_per_slot + 1
         self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot,
                                   mesh=mesh)
         # LMRS_FORCE_KERNELS=interpret: run the Pallas kernels in interpret
@@ -134,6 +147,8 @@ class ContinuousScheduler:
             "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
             "occupancy_sum": 0.0, "peak_pages_in_use": 0, "run_seconds": 0.0,
             "spec_accepted_tokens": 0,  # draft tokens accepted (speculation)
+            "preemptions": 0,  # slots evicted to the queue under page pressure
+            "peak_active_slots": 0,  # max simultaneously-occupied slots
         }
 
     def metrics_report(self) -> dict:
@@ -155,6 +170,8 @@ class ContinuousScheduler:
             "peak_kv_page_utilization": round(
                 m["peak_pages_in_use"] / (self.cache.num_pages - 1), 3),
             "scheduler_seconds": round(m["run_seconds"], 3),
+            "preemptions": m["preemptions"],
+            "peak_active_slots": m["peak_active_slots"],
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
         }
@@ -208,19 +225,22 @@ class ContinuousScheduler:
         unique across everything submitted to one run().
         """
         t_run = time.time()
-        queue: deque[tuple[GenerationRequest, list[int], int]] = deque()
+        # queue entries: (req, prefill_ids, max_new, n_prompt,
+        # prior_generated, t_start) — the last three are preemption-
+        # continuation state (len(ids), [], None for fresh requests)
+        queue: deque[tuple] = deque()
         all_requests = list(requests)
 
         def submit(new_requests: list[GenerationRequest]) -> None:
             for req in new_requests:
                 ids, max_new = self._encode(req)
-                queue.append((req, ids, max_new))
+                queue.append((req, ids, max_new, len(ids), [], None))
                 all_requests.append(req)
 
         fresh: deque[int] = deque()  # completed rids awaiting delivery
         for req in requests:
             ids, max_new = self._encode(req)
-            queue.append((req, ids, max_new))
+            queue.append((req, ids, max_new, len(ids), [], None))
 
         slots: list[_SlotState | None] = [None] * self.B
         last_tok = np.zeros((self.B,), np.int32)
@@ -237,32 +257,40 @@ class ContinuousScheduler:
             for b in range(self.B):
                 if slots[b] is not None or not queue:
                     continue
-                req, ids, max_new = queue[0]
-                # + decode_block: decode overshoots the budget by up to a
-                # block between host syncs; those writes need real pages.
-                # Need is capped at max_pages_per_slot (decode write positions
-                # are clamped below max_seq_len, so a capped allocation is
-                # never written past).
+                req, ids, max_new, n_prompt, prior, t0 = queue[0]
+                # Admission reserves PROMPT pages only; decode capacity is
+                # grown per block (_ensure_decode_capacity), with youngest-
+                # slot preemption under pressure — worst-case reservation
+                # here was measured to cap concurrency at fixed pool size.
                 budget = len(ids) + max_new + self.decode_block + self.spec_k
-                need = min(self.cache.pages_needed(budget),
-                           self.cache.max_pages_per_slot)
-                if need > usable_pages:
-                    # can NEVER be admitted: fail the request instead of
-                    # busy-waiting forever (degrade-and-continue contract)
+                worst = min(self.cache.pages_needed(budget),
+                            self.cache.max_pages_per_slot)
+                if worst > usable_pages:
+                    # can NEVER complete even alone in the pool: fail the
+                    # request instead of thrashing forever
+                    # (degrade-and-continue contract)
                     queue.popleft()
                     results[req.request_id] = GenerationResult(
                         request_id=req.request_id, finish_reason="error",
-                        error=f"request needs {need} KV pages; pool has "
+                        error=f"request needs {worst} KV pages; pool has "
                               f"{usable_pages}",
                     )
                     fresh.append(req.request_id)
                     continue
+                need = min(self.cache.pages_needed(len(ids)),
+                           self.cache.max_pages_per_slot)
                 if need > self.cache.allocator.free_count:
                     break  # back-pressure: wait for pages to free up
                 queue.popleft()
-                seq = self.cache.open_sequence(budget)
+                seq = self.cache.open_sequence(len(ids))
+                # a continuation keeps its ORIGINAL t_start: device_seconds
+                # then spans the whole request, and the slot stays "old" for
+                # youngest-victim selection (a refreshed t_start would make
+                # the same request the perpetual preemption victim)
                 st = _SlotState(req=req, prompt_ids=ids, max_new=max_new,
-                                seq=seq, t_start=time.time())
+                                seq=seq,
+                                t_start=t0 if t0 is not None else time.time(),
+                                n_prompt=n_prompt, prior=list(prior))
                 slots[b] = st  # phase="prefill"; device work happens in the loop
                 # a decode dispatch can run while this slot is still
                 # mid-prefill (chunked prefill): its row must carry length
@@ -279,6 +307,9 @@ class ContinuousScheduler:
                 in_use = usable_pages - self.cache.allocator.free_count
                 self.metrics["peak_pages_in_use"] = max(
                     self.metrics["peak_pages_in_use"], in_use)
+                self.metrics["peak_active_slots"] = max(
+                    self.metrics["peak_active_slots"],
+                    sum(s is not None for s in slots))
 
         while True:
             # deliver fresh results first: the callback may submit new work,
@@ -326,6 +357,17 @@ class ContinuousScheduler:
                 pending = []
             if not any(active):
                 continue
+            # grow every decode slot's pages to cover the coming block;
+            # under pool pressure the youngest decode slot is preempted
+            # back to the queue (its pending tok0, if any, is simply
+            # re-sampled when it re-prefills)
+            stalled = self._ensure_decode_capacity(slots, queue, kv_lens,
+                                                   last_tok, active)
+            if not any(active):
+                for b in stalled:  # re-arm before looping back
+                    if slots[b] is not None:
+                        active[b] = True
+                continue
             self.metrics["occupancy_sum"] += float(np.mean(active))
             self.metrics["decode_dispatches"] += 1
             if self.spec_k:
@@ -336,6 +378,8 @@ class ContinuousScheduler:
                     slots, last_tok, kv_lens, active, temps, top_k, top_p,
                     pending)
                 for (b, p, row) in deferred:
+                    if slots[b] is None or not active[b]:
+                        continue  # preempted between prefill and dispatch
                     tok0 = int(tok0s[p][row])
                     slots[b].generated.append(tok0)
                     last_tok[b] = tok0
@@ -353,6 +397,9 @@ class ContinuousScheduler:
                 self.metrics["decode_tokens"] += len(new)
                 self._maybe_finish(b, slots, results, active, fresh,
                                    kv_lens, last_tok)
+            for b in stalled:  # stalled rows rejoin the next dispatch
+                if slots[b] is not None:
+                    active[b] = True
 
         self.metrics["run_seconds"] += time.time() - t_run
         return [results[r.request_id] for r in all_requests]
@@ -369,12 +416,81 @@ class ContinuousScheduler:
             ids = ids[:head] + ids[-tail:]
         return ids, max_new
 
+    # ------------------------------------------- page growth / preemption
+
+    def _ensure_decode_capacity(self, slots, queue, kv_lens, last_tok,
+                                active) -> list[int]:
+        """Grow each active decode slot's pages to cover the coming decode
+        block (admission reserved prompt pages only).  On pool exhaustion,
+        preempt the YOUNGEST decode slot — free its pages and requeue it at
+        the queue head as a continuation (prompt + generated-so-far
+        re-prefills once pages free up) — and retry.  When no OTHER decode
+        slot exists (the pages are held by mid-prefill slots), the slot is
+        STALLED for this dispatch instead of discarding its own progress:
+        its row is masked off, and the masked row's dummy writes land on
+        the null page (unallocated table columns are zero).  Returns the
+        stalled rows; the caller re-activates them after the dispatch.
+        Deadlock-free: the pool holds at least one full-length sequence
+        (pool sizing in __init__), so a slot alone in the pool always
+        grows, and prefill slots always finish without growth."""
+        block = self.decode_block + self.spec_k
+        stalled: list[int] = []
+        for b in range(self.B):
+            st = slots[b]
+            if st is None or not active[b] or st.phase != "decode":
+                continue
+            target = min(st.kv_len + block, self.max_len)
+            while True:
+                try:
+                    self.cache.grow(st.seq, target)
+                    break
+                except OutOfPages:
+                    victim = self._youngest_decode_slot(slots, active,
+                                                        exclude=b)
+                    if victim is None:
+                        stalled.append(b)
+                        active[b] = False
+                        break
+                    self._preempt(victim, slots, queue, kv_lens, last_tok,
+                                  active)
+        return stalled
+
+    def _youngest_decode_slot(self, slots, active, exclude: int) -> int | None:
+        """Latest-admitted active decode slot, or None if only ``exclude``
+        (the slot being grown) qualifies."""
+        best, best_t = None, -1.0
+        for b in range(self.B):
+            st = slots[b]
+            if (b == exclude or st is None or not active[b]
+                    or st.phase != "decode"):
+                continue
+            if st.t_start >= best_t:
+                best, best_t = b, st.t_start
+        return best
+
+    def _preempt(self, b, slots, queue, kv_lens, last_tok, active) -> None:
+        st = slots[b]
+        self.cache.close_sequence(st.seq)
+        # continuation: generated tokens fold into the prefill ids, original
+        # prompt length and prior output ride along for accounting/finish
+        queue.appendleft((st.req, st.prompt_ids + st.generated, st.max_new,
+                          st.n_prompt, st.prior + st.generated, st.t_start))
+        slots[b] = None
+        active[b] = False
+        kv_lens[b] = 0  # same invariant as admission/_maybe_finish: a freed
+        last_tok[b] = 0  # row must never carry a stale length into a kernel
+        self.metrics["preemptions"] += 1
+        logger.debug("preempted slot %d (request %d) under page pressure",
+                     b, st.req.request_id)
+
     def _maybe_finish(self, b, slots, results, active, fresh=None,
                       kv_lens=None, last_tok=None):
         st = slots[b]
         # decode runs in fixed blocks, so a slot can overshoot its budget by
-        # up to decode_block-1 tokens between host syncs — trim to budget
-        gen = st.generated[: st.max_new]
+        # up to decode_block-1 tokens between host syncs — trim to budget.
+        # prior = tokens generated before a preemption (already re-prefilled
+        # as part of prompt_ids; they are still OUTPUT tokens).
+        gen = (st.prior + st.generated)[: st.max_new]
         eos = self.tokenizer.eos_id
         hit_eos = eos in gen
         if hit_eos:
@@ -385,7 +501,7 @@ class ContinuousScheduler:
             results[st.req.request_id] = GenerationResult(
                 request_id=st.req.request_id,
                 text=text,
-                prompt_tokens=len(st.prompt_ids),
+                prompt_tokens=st.n_prompt,
                 completion_tokens=len(gen),
                 finish_reason=finish,
                 stop_sequence=stop_hit,

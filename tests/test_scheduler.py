@@ -218,6 +218,72 @@ def test_tp_sharded_kernels_continuous_serving(monkeypatch):
     assert got == want
 
 
+def _short_ctx_model():
+    # max_seq_len=96 @ page_size=16 -> max_pages_per_slot=6, so a small
+    # explicit num_pages is HONORED (the pool floor is 7), making the page
+    # budgets in the pressure tests below real
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=96,
+                       dtype="float32")
+
+
+def test_prompt_only_admission_raises_concurrency():
+    """Admission reserves prompt pages only (VERDICT r1 item 6): with 6
+    usable pages and ~2-page prompts whose worst-case budget is 3 pages,
+    at least 3 slots must run concurrently — worst-case reservation would
+    cap at 2."""
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=24, max_batch_slots=4, seed=0,
+                                 page_size=16, num_pages=7, decode_block=4),
+                    _short_ctx_model())
+    assert eng._scheduler.cache.num_pages == 7  # budget honored, not floored
+    # ~20 byte-token prompts -> 2 pages each; budget 20+24+4 = 48 -> 3 pages
+    reqs = [GenerationRequest(prompt=f"concurrency probe {i}", request_id=i,
+                              temperature=0.0, max_new_tokens=24)
+            for i in range(4)]
+    out = eng.generate_batch(reqs)
+    assert all(r.error is None for r in out)
+    m = eng._scheduler.metrics
+    assert m["peak_active_slots"] >= 3, m
+    eng.shutdown()
+
+
+def test_preemption_under_page_pressure_preserves_output():
+    """Under a pool too small for every admitted slot's decode growth, the
+    youngest slot is preempted and requeued; every request must still
+    complete with output identical to an abundant-pool run (continuation
+    re-prefills prompt + generated-so-far), and no deadlock."""
+    mc = _short_ctx_model()
+    reqs = [GenerationRequest(prompt=f"pressure probe {i} " * 3, request_id=i,
+                              temperature=0.0, max_new_tokens=40)
+            for i in range(4)]
+    roomy = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                   max_tokens=40, max_batch_slots=4, seed=0,
+                                   page_size=16, num_pages=1, decode_block=4),
+                      mc)
+    want = roomy.generate_batch(reqs)
+    assert all(r.error is None for r in want)
+    roomy.shutdown()
+
+    # 9 usable pages: four ~4-page prompts can't all fit worst-case (~6
+    # pages each through a 40-token decode) -> growth collides, preemption
+    tight = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                   max_tokens=40, max_batch_slots=4, seed=0,
+                                   page_size=16, num_pages=10, decode_block=4),
+                      mc)
+    assert tight._scheduler.cache.num_pages == 10
+    got = tight.generate_batch(reqs)
+    m = tight._scheduler.metrics
+    tight.shutdown()
+    assert all(r.error is None for r in got)
+    assert m["preemptions"] > 0, f"pressure never materialized: {m}"
+    assert [r.text for r in got] == [r.text for r in want]
+    # accounting must not double-count re-prefilled continuation tokens
+    for g, w in zip(got, want):
+        assert g.prompt_tokens == w.prompt_tokens
+        assert g.completion_tokens == w.completion_tokens
+
+
 def test_pow2_bucket():
     from lmrs_tpu.engine.scheduler import _pow2_bucket
 
