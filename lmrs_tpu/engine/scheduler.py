@@ -136,9 +136,16 @@ class ContinuousScheduler:
         # mesh the kernel runs via shard_map over the tp head axis); also
         # cleared if lowering fails at runtime
         self._use_flash = self._tp_only_mesh()
+        # Packed prefill: concatenate same-wave fresh prompts into one [1, S]
+        # row with segment-id masking — the dense matmuls (QKV/FFN/head) then
+        # run on real tokens only instead of ~pow2-bucket padding per prompt
+        # (measured ~43% padded q rows at the bench shape).  LMRS_PACK_PREFILL=0
+        # restores per-prompt prefill for A/B measurement.
+        self._pack_prefill = os.environ.get("LMRS_PACK_PREFILL", "1") != "0"
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
+        self._packed_prefill_fns: dict[int, object] = {}
         self._decode_fns: dict[int, object] = {}
         self._ran_ok: set = set()  # fn-cache keys that have executed once
         self._spec_buf = None  # device token-history buffer (speculation)
@@ -539,6 +546,7 @@ class ContinuousScheduler:
         per-group-size shape zoo would thrash the cache at runtime.
         """
         groups: dict[tuple, list] = {}
+        fresh_pack: list[tuple[int, object, list[int]]] = []
         for b in range(self.B):
             st = slots[b]
             if st is None or st.phase != "prefill":
@@ -548,6 +556,9 @@ class ContinuousScheduler:
             chunk = ids[pos: pos + self.prefill_chunk]
             is_final = pos + len(chunk) >= len(ids)
             fresh = pos == 0 and is_final  # whole prompt in one dispatch
+            if fresh and self._pack_prefill:
+                fresh_pack.append((b, st, chunk))
+                continue
             s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
             if fresh:
                 w = self.cache.max_pages_per_slot
@@ -559,6 +570,19 @@ class ContinuousScheduler:
 
         # dispatch each group (async), collecting unfetched [N] token arrays
         pending: list[tuple[object, list[tuple[int, int]]]] = []
+
+        # packed fresh prompts: bins of <= max_len tokens, each ONE [1, S]
+        # dispatch; a bin left with a single prompt takes the per-prompt
+        # program (identical work, already compiled for the common case)
+        for bin_items in self._pack_bins(fresh_pack, self.max_len):
+            if len(bin_items) == 1:
+                b, st, chunk = bin_items[0]
+                s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
+                groups.setdefault(
+                    (True, s_bucket, self.cache.max_pages_per_slot), []
+                ).append((b, st, chunk, 0, True))
+            else:
+                pending.append(self._dispatch_packed(bin_items))
         for (fresh, s_bucket, w), items in groups.items():
             n = 1 if len(items) == 1 else self.B
             tokens = np.full((n, s_bucket), self.tokenizer.pad_id, np.int32)
@@ -605,6 +629,7 @@ class ContinuousScheduler:
                 self._use_flash = False
                 self._prefill_fns.clear()
                 self._prefill_window_fns.clear()
+                self._packed_prefill_fns.clear()
                 fn = (self._get_prefill_fn(s_bucket) if fresh
                       else self._get_prefill_window_fn(s_bucket, w))
                 tok0, self.cache.k, self.cache.v = fn(*args)
@@ -615,6 +640,116 @@ class ContinuousScheduler:
                 pending.append((tok0, rows))
 
         return pending
+
+    @staticmethod
+    def _pack_bins(items: list, capacity: int) -> list[list]:
+        """First-fit-decreasing bin packing of (slot, state, chunk) items by
+        chunk length.  Segment count per bin is bounded by B (items are
+        slots), so the packed program's shapes stay (s_bucket, B)."""
+        bins: list[tuple[int, list]] = []  # (used, items)
+        for it in sorted(items, key=lambda t: -len(t[2])):
+            n = len(it[2])
+            for i, (used, lst) in enumerate(bins):
+                if used + n <= capacity:
+                    lst.append(it)
+                    bins[i] = (used + n, lst)
+                    break
+            else:
+                bins.append((n, [it]))
+        return [lst for _, lst in bins]
+
+    def _dispatch_packed(self, items: list) -> tuple[object, list[tuple[int, int]]]:
+        """One packed prefill dispatch: concatenate the items' prompts into a
+        [1, S] row (segment ids, within-segment positions, host-built
+        per-token page ids) and sample each segment's first token from its
+        last row.  Returns the (unfetched tok0 [B], [(slot, segment)])
+        pending entry, same contract as the per-prompt programs."""
+        ps = self.cache.page_size
+        s_real = sum(len(c) for _, _, c in items)
+        # bins are capped at max_len tokens, so the clamp never truncates
+        s_bucket = min(_pow2_bucket(s_real, 64), self.max_len)
+        tokens = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        positions = np.zeros((1, s_bucket), np.int32)
+        seg_ids = np.full((1, s_bucket), -1, np.int32)  # pad: matches nothing
+        token_pages = np.zeros((1, s_bucket), np.int32)  # pad -> null page
+        last_idx = np.zeros((self.B,), np.int32)
+        temps = np.ones((self.B,), np.float32)
+        tks = np.zeros((self.B,), np.int32)
+        tps = np.ones((self.B,), np.float32)
+        off = 0
+        for si, (b, st, chunk) in enumerate(items):
+            n = len(chunk)
+            within = np.arange(n, dtype=np.int32)
+            tokens[0, off: off + n] = chunk
+            positions[0, off: off + n] = within
+            seg_ids[0, off: off + n] = si
+            token_pages[0, off: off + n] = np.asarray(
+                st.seq.pages, np.int32)[within // ps]
+            last_idx[si] = off + n - 1
+            temps[si] = st.req.temperature
+            tks[si] = st.req.top_k
+            tps[si] = min(max(st.req.top_p, 0.0), 1.0)
+            st.prefill_pos = n
+            self.metrics["prefill_tokens"] += n
+            off += n
+        self._key, sub = jax.random.split(self._key)
+        args = (
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(token_pages), jnp.asarray(seg_ids),
+            jnp.asarray(last_idx), jnp.asarray([s_real], np.int32), sub,
+            jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
+        )
+        key_ = ("packed", s_bucket)
+        try:
+            tok0, self.cache.k, self.cache.v = \
+                self._get_packed_prefill_fn(s_bucket)(*args)
+        except Exception:
+            # same contract as the fresh-prefill fallback: only degrade on a
+            # first-run lowering failure of the flash kernel (the packed XLA
+            # attention then serves); a failure on a proven shape re-raises
+            if not self._use_flash or key_ in self._ran_ok:
+                raise
+            logger.warning("packed flash prefill failed to lower; "
+                           "falling back to XLA packed attention",
+                           exc_info=True)
+            self._use_flash = False
+            self._prefill_fns.clear()
+            self._prefill_window_fns.clear()
+            self._packed_prefill_fns.clear()
+            tok0, self.cache.k, self.cache.v = \
+                self._get_packed_prefill_fn(s_bucket)(*args)
+        self._ran_ok.add(key_)
+        return tok0, [(b, si) for si, (b, _, _) in enumerate(items)]
+
+    def _get_packed_prefill_fn(self, s_bucket: int):
+        if s_bucket in self._packed_prefill_fns:
+            return self._packed_prefill_fns[s_bucket]
+        cfg = self.model_cfg
+        rope_max = self.max_len
+        use_flash = self._use_flash
+        mesh_ = self._kernel_mesh()
+        interp = self._interpret
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def packed_prefill(params, k_pages, v_pages, tokens, positions,
+                           token_pages, seg_ids, last_idx, length, key,
+                           temp, tk, tp):
+            logits, k_pages, v_pages = forward_paged(
+                params, cfg, tokens, positions, k_pages, v_pages,
+                jnp.zeros((1, 1), jnp.int32),  # tables unused: token_pages
+                length, rope_max, use_ragged_kernel=False,
+                use_flash=use_flash, mesh=mesh_, interpret=interp,
+                token_pages=token_pages, segment_ids=seg_ids,
+                packed_last_idx=last_idx,
+            )
+            tok0 = sample_logits(logits[0], key, temp, tk, tp)  # [B]
+            return tok0, k_pages, v_pages
+
+        logger.info("compiling packed prefill: bucket=%d segments<=%d "
+                    "(flash=%s)", s_bucket, self.B, use_flash)
+        self._packed_prefill_fns[s_bucket] = packed_prefill
+        return packed_prefill
 
     def _get_prefill_fn(self, s_bucket: int):
         if s_bucket in self._prefill_fns:

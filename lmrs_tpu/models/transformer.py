@@ -319,6 +319,9 @@ def forward_paged(
     use_flash: bool = True,  # allow the flash prefill kernel (when eligible)
     mesh=None,  # tensor-parallel mesh: Pallas calls run via shard_map over tp
     interpret: bool = False,  # Pallas interpret mode (CPU-mesh tests)
+    token_pages: jnp.ndarray | None = None,   # [B, S] per-token LOGICAL page
+    segment_ids: jnp.ndarray | None = None,   # [B, S] packed-prompt segments
+    packed_last_idx: jnp.ndarray | None = None,  # [N] last-token row indices
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -335,6 +338,15 @@ def forward_paged(
     written by EARLIER chunks of the same prompt — attention runs against
     the gathered page window (pages are in logical order, so window index
     == absolute position), masked causally by absolute position + kv_lens.
+
+    PACKED prefill (``segment_ids`` given): several fresh prompts
+    concatenated into one [1, S] row — each token's page comes from
+    ``token_pages`` (host-built per segment; ``page_tables`` is then
+    ignored for writes), ``positions`` restart at 0 per segment (RoPE),
+    attention is same-segment causal, and ``kv_lens`` holds the TOTAL
+    packed length.  With ``packed_last_idx``, the LM head runs only on the
+    gathered last-token rows (logits [B, N, V]) — the padding rows' vocab
+    matmul is the FLOP waste packing exists to eliminate.
     """
     from lmrs_tpu.ops.paged_attention import (
         paged_decode_fused_sharded,
@@ -354,9 +366,13 @@ def forward_paged(
     sin, cos = rope_table(rope_max, hd, cfg.rope_theta)
     is_decode = s == 1
 
-    page_idx = jnp.take_along_axis(
-        page_tables, jnp.clip(positions // ps, 0, page_tables.shape[1] - 1), axis=1
-    )  # [B, S] logical page per token
+    if token_pages is not None:
+        page_idx = token_pages  # packed path: host-built per-token pages
+    else:
+        page_idx = jnp.take_along_axis(
+            page_tables, jnp.clip(positions // ps, 0, page_tables.shape[1] - 1),
+            axis=1,
+        )  # [B, S] logical page per token
     offsets = positions % ps
     batch_r = jnp.arange(b)[:, None]
 
@@ -402,6 +418,25 @@ def forward_paged(
         if is_decode:
             attn = paged_decode_xla(q[:, 0], kp_all, vp_all, g_tables, kv_lens)
             attn_out = attn[:, None]  # [B, 1, H, hd]
+        elif segment_ids is not None:
+            # packed fresh prefill: same-segment causal attention over the
+            # concatenated prompts (current tokens ARE the whole context)
+            if use_flash and _use_flash_prefill(s, hd, interpret):
+                from lmrs_tpu.ops.flash_attention import (
+                    flash_attention, flash_attention_sharded)
+
+                if mesh is not None:
+                    attn_out = flash_attention_sharded(
+                        q, k, v, kv_lens, mesh, interpret=interpret,
+                        segment_ids=segment_ids)
+                else:
+                    attn_out = flash_attention(q, k, v, kv_lens,
+                                               interpret=interpret,
+                                               segment_ids=segment_ids)
+            else:
+                from lmrs_tpu.ops.attention import packed_attention
+
+                attn_out = packed_attention(q, k, v, segment_ids, kv_lens)
         elif window_prefill:
             # continuation prefill: attend the page window (self K/V included
             # — this chunk was scattered into its pages above)
@@ -440,6 +475,9 @@ def forward_paged(
         layer_fn, (x, k_pages, v_pages),
         (params["layers"], jnp.arange(cfg.n_layers)),
     )
+    if packed_last_idx is not None:
+        # LM head only where tokens are sampled: [B, S, D] -> [B, N, D]
+        x = x[:, packed_last_idx]
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])

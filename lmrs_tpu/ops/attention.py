@@ -61,3 +61,35 @@ def attention(
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out
+
+
+def packed_attention(
+    q: jnp.ndarray,  # [B, S, H, hd]
+    k: jnp.ndarray,  # [B, S, K, hd]
+    v: jnp.ndarray,  # [B, S, K, hd]
+    segment_ids: jnp.ndarray,  # [B, S] per-token segment (pad: any id < 0)
+    length: jnp.ndarray | None = None,  # [B] total valid (packed) tokens
+    logit_softcap: float | None = None,
+) -> jnp.ndarray:
+    """Packed-prompt self-attention (XLA reference / fallback): several
+    prompts concatenated into one row, masked to same-segment pairs with
+    causality on the global row index (segments are contiguous, so this is
+    per-segment causal attention).  The correctness contract for the flash
+    kernel's ``segment_ids`` path (tests/test_kernels.py)."""
+    b, s, h, hd = q.shape
+    kh = k.shape[2]
+    k = _repeat_kv(k, h // kh)
+    v = _repeat_kv(v, h // kh)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * hd**-0.5
+    if logit_softcap is not None:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+    idx = jnp.arange(s)
+    causal = idx[None, :] <= idx[:, None]  # [Sq, Skv]: k at or before q
+    same_seg = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B,Sq,Skv]
+    mask = jnp.logical_and(causal[None], same_seg)
+    if length is not None:
+        mask = jnp.logical_and(mask, (idx[None, None, :] < length[:, None, None]))
+    logits = jnp.where(mask[:, None], logits, NEG_INF)
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

@@ -32,16 +32,22 @@ def _flash_kernel(
     q_ref,        # VMEM [1, 1, QB, hd]
     k_ref,        # VMEM [1, 1, KB, hd]
     v_ref,        # VMEM [1, 1, KB, hd]
-    o_ref,        # VMEM [1, 1, QB, hd]
-    m_scr,        # VMEM [QB, 128] f32 running max
-    l_scr,        # VMEM [QB, 128] f32 running sum
-    acc_scr,      # VMEM [QB, hd] f32 accumulator
-    *,
+    *args,        # [sq_ref (1, QB), sk_ref (1, KB) when has_segs;]
+                  # o_ref, m_scr, l_scr, acc_scr
     q_block: int,
     kv_block: int,
     sm_scale: float,
     skip_padded_q: bool,
+    has_segs: bool = False,
 ):
+    if has_segs:
+        # packed-prompt prefill: per-token segment ids; a key is visible to
+        # a query only within the same segment (cross-segment attention is
+        # the packing bug this mask exists to prevent)
+        sq_ref, sk_ref, o_ref, m_scr, l_scr, acc_scr = args
+    else:
+        sq_ref = sk_ref = None
+        o_ref, m_scr, l_scr, acc_scr = args
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -79,6 +85,8 @@ def _flash_kernel(
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, kv_block), 0)
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, kv_block), 1)
         mask = jnp.logical_and(k_pos <= q_pos, k_pos < length)
+        if has_segs:
+            mask = jnp.logical_and(mask, sq_ref[0][:, None] == sk_ref[0][None, :])
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                      # [QB, 1]
@@ -118,6 +126,7 @@ def flash_attention(
     kv_block: int = 256,
     interpret: bool = False,
     skip_padded_q: bool = True,
+    segment_ids: jnp.ndarray | None = None,  # [B, S] packed-prompt segments
 ) -> jnp.ndarray:
     """Causal flash attention over fresh (position-0-based) sequences.
 
@@ -126,6 +135,11 @@ def flash_attention(
     positions >= lengths[b] are exactly zero — their blocks are predicated
     off entirely (a bucketed prompt would otherwise burn MXU time computing
     attention for garbage rows); pass False to compute them anyway.
+
+    ``segment_ids`` enables packed-prompt prefill (several prompts
+    concatenated into one row): attention is additionally masked to
+    same-segment pairs, so causal masking on the global row index becomes
+    per-segment causality (segments are contiguous).
     """
     b, sq, h, hd = q.shape
     skv, kh = k.shape[1], k.shape[2]
@@ -142,6 +156,11 @@ def flash_attention(
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
         k = jnp.pad(k, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+        if segment_ids is not None:
+            # pad tokens get segment -1: matches nothing valid (and the
+            # length mask already excludes them as keys)
+            segment_ids = jnp.pad(segment_ids, ((0, 0), (0, pad_q)),
+                                  constant_values=-1)
     sq_p, skv_p = q.shape[1], k.shape[1]
 
     # head-major layout for blocking
@@ -149,25 +168,35 @@ def flash_attention(
     kt = k.transpose(0, 2, 1, 3)  # [B, K, S, hd]
     vt = v.transpose(0, 2, 1, 3)
 
+    has_segs = segment_ids is not None
     grid = (b, h, sq_p // q_block, skv_p // kv_block)
     kernel = functools.partial(
         _flash_kernel, q_block=q_block, kv_block=kv_block,
-        sm_scale=hd ** -0.5, skip_padded_q=skip_padded_q,
+        sm_scale=hd ** -0.5, skip_padded_q=skip_padded_q, has_segs=has_segs,
     )
+    in_specs = [
+        # whole [B] array in SMEM (rank-1 blocking is restricted on real
+        # TPU lowering); the kernel indexes it by program_id(0)
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, q_block, hd),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, kv_block, hd),
+                     lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+        pl.BlockSpec((1, 1, kv_block, hd),
+                     lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
+    ]
+    operands = [lengths.astype(jnp.int32), qt, kt, vt]
+    if has_segs:
+        segs = segment_ids.astype(jnp.int32)
+        in_specs += [
+            pl.BlockSpec((1, q_block), lambda bi, hi, qi, ki: (bi, qi)),
+            pl.BlockSpec((1, kv_block), lambda bi, hi, qi, ki: (bi, ki)),
+        ]
+        operands += [segs, segs]
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            # whole [B] array in SMEM (rank-1 blocking is restricted on real
-            # TPU lowering); the kernel indexes it by program_id(0)
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, q_block, hd),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, kv_block, hd),
-                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
-            pl.BlockSpec((1, 1, kv_block, hd),
-                         lambda bi, hi, qi, ki: (bi, hi // n_rep, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, q_block, hd),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, hd), q.dtype),
@@ -180,7 +209,7 @@ def flash_attention(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), qt, kt, vt)
+    )(*operands)
 
     out = out.transpose(0, 2, 1, 3)  # back to [B, S, H, hd]
     if pad_q:
@@ -195,6 +224,7 @@ def flash_attention_sharded(
     lengths: jnp.ndarray,  # [B] replicated
     mesh,
     interpret: bool = False,
+    segment_ids: jnp.ndarray | None = None,  # [B, S] replicated
 ) -> jnp.ndarray:
     """Flash prefill under a tensor-parallel mesh: ``shard_map`` over the
     ``tp`` head axis (a pallas_call cannot be auto-partitioned by XLA).
@@ -204,11 +234,21 @@ def flash_attention_sharded(
     from jax.sharding import PartitionSpec as P
 
     head4 = P(None, None, "tp", None)
+    if segment_ids is None:
+        fn = jax.shard_map(
+            functools.partial(flash_attention, interpret=interpret),
+            mesh=mesh,
+            in_specs=(head4, head4, head4, P(None)),
+            out_specs=head4,
+            check_vma=False,
+        )
+        return fn(q, k, v, lengths)
     fn = jax.shard_map(
-        functools.partial(flash_attention, interpret=interpret),
+        lambda q_, k_, v_, l_, s_: flash_attention(
+            q_, k_, v_, l_, interpret=interpret, segment_ids=s_),
         mesh=mesh,
-        in_specs=(head4, head4, head4, P(None)),
+        in_specs=(head4, head4, head4, P(None), P(None, None)),
         out_specs=head4,
         check_vma=False,
     )
-    return fn(q, k, v, lengths)
+    return fn(q, k, v, lengths, segment_ids)

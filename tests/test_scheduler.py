@@ -218,6 +218,60 @@ def test_tp_sharded_kernels_continuous_serving(monkeypatch):
     assert got == want
 
 
+def test_packed_prefill_matches_unpacked(monkeypatch):
+    """Packed prompt prefill (VERDICT r1 item 3): same-wave fresh prompts
+    concatenate into one [1, S] segment-masked dispatch; greedy output must
+    be identical to per-prompt prefill (cross-segment leakage would change
+    it), and the packed program must actually have run."""
+    mc = tiny_model()
+    reqs = [GenerationRequest(prompt=f"pack probe {i} " * (2 + 3 * i),
+                              request_id=i, temperature=0.0, max_new_tokens=8)
+            for i in range(4)]
+    ec = lambda: EngineConfig(backend="jax", scheduler="continuous",
+                              max_tokens=8, max_batch_slots=4, seed=0)
+    monkeypatch.setenv("LMRS_PACK_PREFILL", "0")
+    plain = JaxEngine(ec(), mc)
+    want = [r.text for r in plain.generate_batch(reqs)]
+    plain.shutdown()
+
+    monkeypatch.setenv("LMRS_PACK_PREFILL", "1")
+    packed = JaxEngine(ec(), mc)
+    got = [r.text for r in packed.generate_batch(reqs)]
+    assert packed._scheduler._packed_prefill_fns, "packed path not exercised"
+    packed.shutdown()
+    assert got == want
+
+
+def test_packed_prefill_with_tp_kernels(monkeypatch):
+    """Packing composes with the TP kernel path: segment-masked flash
+    prefill via shard_map (interpret) must match the single-device
+    unpacked XLA run."""
+    from lmrs_tpu.config import MeshConfig
+
+    mc = ModelConfig(vocab_size=512, dim=512, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=256, max_seq_len=1024,
+                     dtype="float32")
+    reqs = [GenerationRequest(prompt=f"tp pack probe {i} " * 12, request_id=i,
+                              temperature=0.0, max_new_tokens=4)
+            for i in range(3)]
+    ec = lambda: EngineConfig(backend="jax", scheduler="continuous",
+                              max_tokens=4, max_batch_slots=4, seed=0,
+                              decode_block=2, prefill_chunk=1024)
+    monkeypatch.setenv("LMRS_PACK_PREFILL", "0")
+    single = JaxEngine(ec(), mc)
+    want = [r.text for r in single.generate_batch(reqs)]
+    single.shutdown()
+
+    monkeypatch.setenv("LMRS_PACK_PREFILL", "1")
+    monkeypatch.setenv("LMRS_FORCE_KERNELS", "interpret")
+    tp = JaxEngine(ec(), mc, mesh_cfg=MeshConfig(dp=1, tp=2))
+    got = [r.text for r in tp.generate_batch(reqs)]
+    assert tp._scheduler._packed_prefill_fns, "packed path not exercised"
+    assert tp._scheduler._use_flash, "flash kernel silently degraded"
+    tp.shutdown()
+    assert got == want
+
+
 def _short_ctx_model():
     # max_seq_len=96 @ page_size=16 -> max_pages_per_slot=6, so a small
     # explicit num_pages is HONORED (the pool floor is 7), making the page
