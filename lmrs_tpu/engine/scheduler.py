@@ -142,6 +142,22 @@ class ContinuousScheduler:
         # (measured ~43% padded q rows at the bench shape).  LMRS_PACK_PREFILL=0
         # restores per-prompt prefill for A/B measurement.
         self._pack_prefill = os.environ.get("LMRS_PACK_PREFILL", "1") != "0"
+        # Serving-side context parallelism (SURVEY.md §5.7 tier b): under an
+        # sp>1 mesh, LONG fresh prefills run cache-aware ring attention —
+        # the sequence shards over sp, K/V still scatter into the page pool.
+        # Short prompts (< _ring_min) keep the packed/flash path: at those
+        # lengths ring hops buy no memory and cost ppermute latency.
+        # Chunked (window) prefill cannot ride the ring (the window K/V is
+        # pool-side, not sequence-sharded), so under sp the whole prompt
+        # prefills in ONE ring dispatch: ring replaces chunking as the
+        # long-prompt strategy.
+        self._sp = 1 if mesh is None else mesh.shape.get("sp", 1)
+        self._use_ring = self._sp > 1
+        self._ring_min = 1024
+        if self._use_ring and self.prefill_chunk < self.max_len:
+            logger.info("sp=%d mesh: chunked prefill disabled in favor of "
+                        "one-dispatch ring prefill", self._sp)
+            self.prefill_chunk = self.max_len
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
@@ -205,11 +221,16 @@ class ContinuousScheduler:
 
     def _tp_only_mesh(self) -> bool:
         """True when there is no mesh, a 1-device mesh, or a mesh whose only
-        >1 axis is ``tp`` — the layouts the shard_map-wrapped kernels
-        support (kv-head-sharded pages, replicated tables/lengths)."""
+        >1 axes are ``tp``/``sp`` — the layouts the shard_map-wrapped
+        kernels support.  Pages shard over tp and replicate over sp, so
+        each sp replica runs the kernel on identical inputs (duplicated
+        but parallel work — same wall time as sp=1, and decode keeps the
+        fused kernel instead of regressing to the gather fallback just
+        because sp was enabled for prefill CP)."""
         if self._single_device():
             return True
-        return self.mesh.devices.size == self.mesh.shape.get("tp", 1)
+        return self.mesh.devices.size == (self.mesh.shape.get("tp", 1)
+                                          * self.mesh.shape.get("sp", 1))
 
     def _kernel_mesh(self):
         """Mesh to hand the Pallas paths: None on a single device (plain
@@ -556,7 +577,9 @@ class ContinuousScheduler:
             chunk = ids[pos: pos + self.prefill_chunk]
             is_final = pos + len(chunk) >= len(ids)
             fresh = pos == 0 and is_final  # whole prompt in one dispatch
-            if fresh and self._pack_prefill:
+            # long prompts under an sp mesh go to the ring path un-packed
+            if (fresh and self._pack_prefill
+                    and not (self._use_ring and len(chunk) >= self._ring_min)):
                 fresh_pack.append((b, st, chunk))
                 continue
             s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
@@ -573,8 +596,11 @@ class ContinuousScheduler:
 
         # packed fresh prompts: bins of <= max_len tokens, each ONE [1, S]
         # dispatch; a bin left with a single prompt takes the per-prompt
-        # program (identical work, already compiled for the common case)
-        for bin_items in self._pack_bins(fresh_pack, self.max_len):
+        # program (identical work, already compiled for the common case).
+        # Under an sp mesh, bins cap at _ring_min so packed rows stay short
+        # enough that skipping the ring is the right call for them.
+        cap = self._ring_min if self._use_ring else self.max_len
+        for bin_items in self._pack_bins(fresh_pack, cap):
             if len(bin_items) == 1:
                 b, st, chunk = bin_items[0]
                 s_bucket = min(_pow2_bucket(len(chunk), 64), self.max_len)
@@ -759,6 +785,16 @@ class ContinuousScheduler:
         use_flash = self._use_flash  # captured: rebuilt fns see the fallback
         mesh_ = self._kernel_mesh()
         interp = self._interpret
+        # ring prefill: long buckets only (short ones keep packed/flash),
+        # and the bucket must divide over sp (pow2 buckets and pow2 sp
+        # always do; odd sp sizes fall back to plain attention)
+        use_ring = (self._use_ring and s_bucket >= self._ring_min
+                    and s_bucket % self._sp == 0)
+        if self._use_ring and s_bucket >= self._ring_min and not use_ring:
+            logger.warning(
+                "ring prefill skipped: bucket %d not divisible by sp=%d — "
+                "long-chunk prefill will materialize full attention",
+                s_bucket, self._sp)
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill(params, k_pages, v_pages, tokens, start, length,
@@ -773,7 +809,7 @@ class ContinuousScheduler:
             logits, k_pages, v_pages = forward_paged(
                 params, cfg, tokens, write_pos, k_pages, v_pages, table,
                 length, rope_max, use_ragged_kernel=False, use_flash=use_flash,
-                mesh=mesh_, interpret=interp,
+                mesh=mesh_, interpret=interp, use_ring=use_ring,
             )
             last = jnp.take_along_axis(logits, (length - 1)[:, None, None], axis=1)[:, 0]
             tok0 = sample_logits(last, key, temp, tk, tp)

@@ -322,6 +322,7 @@ def forward_paged(
     token_pages: jnp.ndarray | None = None,   # [B, S] per-token LOGICAL page
     segment_ids: jnp.ndarray | None = None,   # [B, S] packed-prompt segments
     packed_last_idx: jnp.ndarray | None = None,  # [N] last-token row indices
+    use_ring: bool = False,  # sp-mesh fresh prefill: ring attention over sp
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -347,6 +348,15 @@ def forward_paged(
     packed length.  With ``packed_last_idx``, the LM head runs only on the
     gathered last-token rows (logits [B, N, V]) — the padding rows' vocab
     matmul is the FLOP waste packing exists to eliminate.
+
+    RING prefill (``use_ring`` + ``mesh``): serving-side context
+    parallelism (SURVEY.md §5.7 tier b) — fresh-prefill attention runs as
+    ring attention with the sequence sharded over the ``sp`` axis, so a
+    chunk longer than one chip's attention budget prefills with O(S/sp)
+    attention memory per device; the SAME program scatters K/V into the
+    page pool (cache-aware: what the training-only ring path could not
+    do), so decode then proceeds against the pages as usual.  Pad keys are
+    masked positionally (kv position pushed past every real query).
     """
     from lmrs_tpu.ops.paged_attention import (
         paged_decode_fused_sharded,
@@ -446,6 +456,16 @@ def forward_paged(
             v_win = vp_all[:, g_tables].transpose(1, 2, 3, 0, 4).reshape(
                 b, w * ps, cfg.n_kv_heads, hd)
             attn_out = attention(q, k_win, v_win, positions, kv_lens)
+        elif use_ring and mesh is not None:
+            # serving CP: ring attention over the sp-sharded sequence; pad
+            # keys get a position past every real query (ring attention has
+            # no kv_length mask, so masking is purely positional)
+            from lmrs_tpu.parallel.ring_attention import ring_attention_sharded
+
+            idx = jnp.arange(s)[None, :]
+            kvp = jnp.where(idx < kv_lens[:, None], positions, jnp.int32(1 << 30))
+            attn_out = ring_attention_sharded(q, k, v, positions, mesh,
+                                              kv_pos=kvp)
         else:
             # fresh prefill: current tokens ARE the whole context.  Row i's
             # position is i (scheduler fresh-prefill contract), which is

@@ -102,11 +102,18 @@ def ring_attention_sharded(
     batch_axis: str = "dp",
     head_axis: str | None = "tp",
     logit_softcap: float | None = None,
+    kv_pos: jnp.ndarray | None = None,  # [B, S] key positions, default q_pos
 ) -> jnp.ndarray:
     """shard_map wrapper: sequence over ``seq_axis``, batch over
     ``batch_axis``, heads over ``head_axis`` (composes with tensor
     parallelism — Q heads and KV heads shard together, so GQA grouping stays
-    local to each tp shard)."""
+    local to each tp shard).
+
+    ``kv_pos`` lets callers mask ragged/padded keys positionally (ring
+    attention has no kv_length mask): give pad keys a position larger than
+    any real query position and the causal rule excludes them — the serving
+    ring-prefill path (models/transformer.forward_paged) relies on this.
+    """
     qkv_spec = P(batch_axis, seq_axis, head_axis, None)
     pos_spec = P(batch_axis, seq_axis)
     fn = jax.shard_map(
@@ -115,4 +122,4 @@ def ring_attention_sharded(
         in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),
         out_specs=qkv_spec,
     )
-    return fn(q, k, v, q_pos, q_pos)
+    return fn(q, k, v, q_pos, kv_pos if kv_pos is not None else q_pos)

@@ -95,3 +95,27 @@ def test_ring_gradients_match_dense():
     for w, g in zip(flat_w, flat_g):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_ring_kv_pos_masks_padded_keys():
+    """Serving ring prefill masks pad keys positionally (kv_pos pushed past
+    every query): valid rows must match XLA attention with kv_length."""
+    from lmrs_tpu.ops.attention import attention
+
+    b, s, h, kh, hd = 2, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kh, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kh, hd), jnp.float32)
+    lengths = jnp.asarray([s, s // 4], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    kv_pos = jnp.where(jnp.arange(s)[None] < lengths[:, None], pos,
+                       jnp.int32(1 << 30))
+
+    mesh = build_mesh(MeshConfig(dp=1, tp=1, sp=4), jax.devices()[:4])
+    got = ring_attention_sharded(q, k, v, pos, mesh, kv_pos=kv_pos)
+    want = attention(q, k, v, pos, lengths)
+    for i, n in enumerate([s, s // 4]):
+        np.testing.assert_allclose(np.asarray(got[i, :n]),
+                                   np.asarray(want[i, :n]),
+                                   rtol=2e-5, atol=2e-5)

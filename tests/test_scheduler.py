@@ -272,6 +272,37 @@ def test_packed_prefill_with_tp_kernels(monkeypatch):
     assert got == want
 
 
+def test_ring_prefill_serving_cp_matches_single_device():
+    """Cache-aware ring prefill (VERDICT r1 item 5, SURVEY §5.7 tier b):
+    under an sp=4 mesh, a long chunk's fresh prefill runs ring attention
+    with the sequence sharded over sp while K/V scatter into the page pool;
+    greedy output must match the single-device run (decode then reads the
+    pages as usual)."""
+    from lmrs_tpu.config import MeshConfig
+
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=2048,
+                     dtype="float32")
+    # one LONG chunk (~1.5k tokens) + a short one sharing the stream
+    reqs = [GenerationRequest(prompt="long context line " * 80, request_id=0,
+                              temperature=0.0, max_new_tokens=8),
+            GenerationRequest(prompt="short probe", request_id=1,
+                              temperature=0.0, max_new_tokens=8)]
+    ec = lambda: EngineConfig(backend="jax", scheduler="continuous",
+                              max_tokens=8, max_batch_slots=2, seed=0,
+                              prefill_chunk=2048, decode_block=4)
+    single = JaxEngine(ec(), mc)
+    want = [r.text for r in single.generate_batch(reqs)]
+    single.shutdown()
+
+    cp = JaxEngine(ec(), mc, mesh_cfg=MeshConfig(dp=1, tp=1, sp=4))
+    sched = cp._scheduler
+    assert sched._use_ring, "ring prefill not selected under sp mesh"
+    got = [r.text for r in cp.generate_batch(reqs)]
+    cp.shutdown()
+    assert got == want
+
+
 def _short_ctx_model():
     # max_seq_len=96 @ page_size=16 -> max_pages_per_slot=6, so a small
     # explicit num_pages is HONORED (the pool floor is 7), making the page
