@@ -1,0 +1,118 @@
+"""Offline quality gate (VERDICT r1 item 4): the stack must demonstrably
+SUMMARIZE, not just stream tokens.
+
+Two layers, both scored with the in-tree ROUGE harness against stored /
+ground-truth baselines:
+
+1. ``test_parity_vs_committed_baseline`` — the full pipeline on the real
+   7.4 h example transcript scored against the committed curated baseline
+   (examples/baseline_summary.json).  The mock engine is extractive, so
+   the absolute score is modest; the gate is a calibrated regression
+   tripwire (measured 0.042 ROUGE-L / 0.084 ROUGE-1 on 2026-07-30 — a
+   format or content collapse drops it to ~0).
+2. ``test_trained_model_beats_extractive_baseline`` — the REAL gate: a
+   model is fine-tuned through the production training stack on synthetic
+   transcript→summary pairs (eval/synthetic.py), held-out prompts are
+   decoded through the production continuous-batching engine, and the
+   mean ROUGE-L against ground truth must clear a non-trivial threshold
+   AND beat the trivial lead-1 extractive baseline by a wide margin.
+   Calibration (2026-07-30, CPU, fixed seeds): model 0.396, extractive
+   0.048 — gates set at 0.30 and 3x.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+BASELINE_FIXTURE = (Path(__file__).parent.parent / "examples"
+                    / "baseline_summary.json")
+
+
+def test_parity_vs_committed_baseline(example_transcript):
+    from lmrs_tpu.config import EngineConfig, PipelineConfig
+    from lmrs_tpu.eval.parity import load_baseline, run_parity
+
+    baseline = load_baseline(BASELINE_FIXTURE)
+    assert len(baseline.split()) > 150  # a real summary, not a stub
+    cfg = PipelineConfig(engine=EngineConfig(backend="mock"))
+    report = run_parity(example_transcript, baseline, cfg, threshold=0.02)
+    assert report.passed, report.to_dict()
+    assert report.rouge1_f >= 0.04, report.to_dict()
+
+
+@pytest.fixture(scope="module")
+def trained_summarizer():
+    """Fine-tune the tiny byte-level model on synthetic pairs through the
+    production path: JSONL -> training.cli.load_examples (loss masked to
+    the summary) -> make_train_step."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from lmrs_tpu.config import ModelConfig
+    from lmrs_tpu.data.tokenizer import ByteTokenizer
+    from lmrs_tpu.eval.synthetic import make_dataset
+    from lmrs_tpu.models.transformer import init_params
+    from lmrs_tpu.training.cli import batches, load_examples
+    from lmrs_tpu.training.train import make_train_step
+
+    cfg = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                      dtype="float32")
+    tok = ByteTokenizer()
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = Path(td) / "train.jsonl"
+        data_path.write_text("\n".join(
+            json.dumps({"prompt": ex["prompt"], "summary": ex["summary"]})
+            for ex in make_dataset(192, seed=0)))
+        seqs, masks = load_examples(str(data_path), tok)
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    optimizer = optax.adamw(4e-3)
+    opt_state = optimizer.init(params)
+    step_fn = make_train_step(cfg, optimizer, None, masked=True)
+    it = batches(seqs, masks, 16, 320, 0)
+    loss = None
+    for _ in range(200):
+        t, m = next(it)
+        params, opt_state, loss = step_fn(params, opt_state,
+                                          jnp.asarray(t), jnp.asarray(m))
+    assert float(loss) < 0.5, f"training failed to converge: loss {float(loss)}"
+    return cfg, tok, params
+
+
+def test_trained_model_beats_extractive_baseline(trained_summarizer):
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+    from lmrs_tpu.eval.rouge import rouge_l
+    from lmrs_tpu.eval.synthetic import extractive_baseline, make_dataset
+
+    cfg, tok, params = trained_summarizer
+    engine = JaxEngine(
+        EngineConfig(backend="jax", scheduler="continuous", max_tokens=48,
+                     max_batch_slots=4, seed=0, decode_block=8),
+        cfg, params=params, tokenizer=tok)
+    held = make_dataset(8, seed=999)  # disjoint from the training seed
+    reqs = [GenerationRequest(prompt=ex["prompt"], request_id=i,
+                              temperature=0.0, max_new_tokens=48)
+            for i, ex in enumerate(held)]
+    outs = engine.generate_batch(reqs)
+    engine.shutdown()
+
+    model_f = [rouge_l(o.text, ex["summary"])["f"]
+               for ex, o in zip(held, outs)]
+    extract_f = [rouge_l(extractive_baseline(ex["prompt"]), ex["summary"])["f"]
+                 for ex in held]
+    mean_model = float(np.mean(model_f))
+    mean_extract = float(np.mean(extract_f))
+    # non-trivial absolute gate + wide margin over the trivial baseline
+    assert mean_model >= 0.30, (mean_model, model_f)
+    assert mean_model > 3 * mean_extract, (mean_model, mean_extract)
