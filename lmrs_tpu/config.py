@@ -243,6 +243,16 @@ def model_preset(name: str) -> ModelConfig:
             tie_embeddings=True, embed_scale=True, head_dim=256,  # != dim/heads
             activation="gelu", norm_eps=1e-6,
         ),
+        "bench-1b": dict(
+            # ~1.03B params, Llama-3 proportions at 1B scale (GQA 16q/8kv,
+            # head_dim 128 engages the ragged decode kernel), byte vocab so
+            # the bench needs no downloaded tokenizer.  The scale exists so
+            # bench.py measures the MXU/HBM, not the host link (a 45M model
+            # under-utilizes the chip ~20x; VERDICT r1).
+            vocab_size=512, dim=2048, n_layers=18, n_heads=16, n_kv_heads=8,
+            hidden_dim=7168, max_seq_len=2048, rope_theta=500000.0,
+            tie_embeddings=True,
+        ),
         "tiny-moe": dict(
             hidden_dim=512, n_experts=4, n_experts_per_token=2,
         ),

@@ -158,6 +158,11 @@ class ContinuousScheduler:
             logger.info("sp=%d mesh: chunked prefill disabled in favor of "
                         "one-dispatch ring prefill", self._sp)
             self.prefill_chunk = self.max_len
+        # LMRS_TRACE_DISPATCH=1: record a host timestamp per decode
+        # dispatch (decode-latency benchmarking — the gap between decode
+        # dispatches is the per-block token latency active slots see)
+        self._trace_dispatch: list[float] | None = (
+            [] if os.environ.get("LMRS_TRACE_DISPATCH") == "1" else None)
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         self._prefill_fns: dict[int, object] = {}
         self._prefill_window_fns: dict[tuple[int, int], object] = {}
@@ -398,6 +403,8 @@ class ContinuousScheduler:
                 continue
             self.metrics["occupancy_sum"] += float(np.mean(active))
             self.metrics["decode_dispatches"] += 1
+            if self._trace_dispatch is not None:
+                self._trace_dispatch.append(time.time())
             if self.spec_k:
                 emitted = self._spec_decode_block(
                     slots, last_tok, kv_lens, active, temps, top_k, top_p)
@@ -443,6 +450,111 @@ class ContinuousScheduler:
             head, tail = limit // 2, limit - limit // 2
             ids = ids[:head] + ids[-tail:]
         return ids, max_new
+
+    # ---------------------------------------------------- roofline probe
+
+    def roofline_microbench(self, prefill_reps: int = 8,
+                            decode_reps: int = 4) -> dict:
+        """Device-level prefill MFU + decode HBM utilization on the live
+        engine (bench.py detail block; VERDICT r1 item 1).
+
+        Lives here, next to the compiled programs it measures, so the
+        dispatch-tuple contract stays in one file.  Chains R dispatches
+        through the donated KV pools (each call consumes the previous
+        call's pools) and fetches ONE dependent value at the end, so the
+        host RTT amortizes over the chain — ``block_until_ready`` does NOT
+        synchronize through tunneled chips (docs/PERF.md); RTT is measured
+        separately and subtracted.  The pool must be idle (no live slots).
+        """
+        from lmrs_tpu.utils.perf_model import (
+            chip_spec, decode_step_bytes, kv_bytes_per_token, prefill_flops,
+            weight_bytes,
+        )
+
+        cfg_m = self.model_cfg
+        spec = chip_spec()
+        # median trivial dependent fetch = host<->device round trip
+        x = jnp.zeros((8,), jnp.float32)
+        np.asarray(jax.device_get(x + 1))  # warm the tiny program
+        rtts = []
+        for _ in range(3):
+            t0 = time.time()
+            np.asarray(jax.device_get(x + 1))
+            rtts.append(time.time() - t0)
+        rtt = sorted(rtts)[1]
+        out: dict = {"chip": spec.kind, "chip_known": spec.known,
+                     "host_rtt_ms": round(rtt * 1e3, 1)}
+
+        # ---- prefill: one [1, S] fresh dispatch at the full bucket ------
+        S = self.max_len
+        fn = self._get_prefill_fn(S)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(1, 255, (1, S), dtype=np.int32))
+        seq = self.cache.open_sequence(S)
+        try:
+            table = jnp.asarray(self.cache.page_table_array([seq]))
+            ones = jnp.ones((1,), jnp.float32)
+            args = (tokens, jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), S, jnp.int32),
+                    jnp.full((1,), seq.capacity(self.cache.page_size),
+                             jnp.int32),
+                    table, jax.random.PRNGKey(7), ones,
+                    jnp.zeros((1,), jnp.int32), ones)
+            k, v = self.cache.k, self.cache.v
+            tok0, k, v = fn(self.params, k, v, *args)  # warm/compile
+            np.asarray(jax.device_get(tok0))
+            t0 = time.time()
+            for _ in range(prefill_reps):
+                tok0, k, v = fn(self.params, k, v, *args)
+            np.asarray(jax.device_get(tok0))
+            per_prefill = max((time.time() - t0 - rtt) / prefill_reps, 1e-9)
+            self.cache.k, self.cache.v = k, v
+        finally:
+            self.cache.close_sequence(seq)
+
+        fl = prefill_flops(cfg_m, S)
+        out["prefill_tokens_per_sec"] = round(S / per_prefill, 1)
+        out["model_flops_utilization"] = round(
+            fl / per_prefill / spec.peak_flops, 4)
+        out["prefill_ms"] = round(per_prefill * 1e3, 2)
+
+        # ---- decode: full-width batched steps at steady-state context ---
+        B = self.B
+        live = int(S * 0.75)
+        seqs = [self.cache.open_sequence(S) for _ in range(B)]
+        try:
+            w = self.cache.max_pages_per_slot
+            onesB = jnp.ones((B,), jnp.float32)
+            dargs = (jnp.asarray(rng.integers(1, 255, (B,), dtype=np.int32)),
+                     jnp.full((B,), live, jnp.int32),
+                     jnp.asarray(self.cache.page_table_array(seqs)[:, :w]),
+                     jnp.ones((B,), bool), jax.random.PRNGKey(8), onesB,
+                     jnp.zeros((B,), jnp.int32), onesB)
+            dfn = self._get_decode_fn(w)
+            k, v = self.cache.k, self.cache.v
+            toks, n_valid, k, v = dfn(self.params, k, v, *dargs)  # warm
+            np.asarray(jax.device_get(n_valid))
+            t0 = time.time()
+            for _ in range(decode_reps):
+                toks, n_valid, k, v = dfn(self.params, k, v, *dargs)
+            np.asarray(jax.device_get(n_valid))
+            wall = time.time() - t0 - rtt
+            self.cache.k, self.cache.v = k, v
+        finally:
+            for s_ in seqs:
+                self.cache.close_sequence(s_)
+
+        per_step = max(wall / (decode_reps * self.decode_block), 1e-9)
+        step_bytes = decode_step_bytes(cfg_m, B * live,
+                                       quantized=bool(self.cfg.quantize))
+        out["decode_tokens_per_sec"] = round(B / per_step, 1)
+        out["decode_step_ms"] = round(per_step * 1e3, 3)
+        out["hbm_bw_utilization"] = round(
+            step_bytes / per_step / spec.peak_hbm_bw, 4)
+        out["decode_step_gb"] = round(step_bytes / 1e9, 2)
+        out["weight_gb"] = round(weight_bytes(cfg_m) / 1e9, 2)
+        out["kv_kb_per_token"] = round(kv_bytes_per_token(cfg_m) / 1e3, 1)
+        return out
 
     # ------------------------------------------- page growth / preemption
 
@@ -692,8 +804,13 @@ class ContinuousScheduler:
         pending entry, same contract as the per-prompt programs."""
         ps = self.cache.page_size
         s_real = sum(len(c) for _, _, c in items)
-        # bins are capped at max_len tokens, so the clamp never truncates
-        s_bucket = min(_pow2_bucket(s_real, 64), self.max_len)
+        # bins are capped at max_len tokens, so the clamp never truncates.
+        # Bucket floor max_len//4: tail bins otherwise mint a fresh pow2
+        # shape per wave, and at real model sizes each novel shape is a
+        # multi-second XLA compile mid-run (same tradeoff as the quarter-
+        # step bucket NOTE above) — at most 3 packed shapes ever compile.
+        s_bucket = min(max(_pow2_bucket(s_real, 64), self.max_len // 4),
+                       self.max_len)
         tokens = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         positions = np.zeros((1, s_bucket), np.int32)
         seg_ids = np.full((1, s_bucket), -1, np.int32)  # pad: matches nothing

@@ -1,0 +1,106 @@
+"""Roofline accounting: FLOP / byte counts + chip peaks for MFU and
+HBM-bandwidth utilization reporting (bench.py, docs/PERF.md).
+
+The reference publishes no perf model at all (its compute is a vendor API);
+these counts are the standard decoder-transformer roofline: dense-matmul
+FLOPs dominate prefill (MFU vs the MXU peak), weight+KV bytes dominate
+decode (utilization vs the HBM peak).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from lmrs_tpu.config import ModelConfig
+
+# Public peak numbers per chip generation (bf16 TFLOP/s, HBM GB/s).
+# device_kind strings as reported by jax.devices()[0].device_kind.
+_CHIP_PEAKS = {
+    "v5 lite": (197e12, 819e9),   # v5e
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v5": (459e12, 2765e9),       # bare "TPU v5" -> assume v5p
+    "v4": (275e12, 1228e9),
+    "v6 lite": (918e12, 1640e9),  # Trillium
+    "v6e": (918e12, 1640e9),
+}
+
+
+@dataclass
+class ChipSpec:
+    kind: str
+    peak_flops: float  # bf16 FLOP/s
+    peak_hbm_bw: float  # bytes/s
+    known: bool
+
+
+def chip_spec() -> ChipSpec:
+    """Peak specs of the default device (v5e fallback when unrecognized)."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    low = kind.lower()
+    for key, (fl, bw) in _CHIP_PEAKS.items():
+        if key in low:
+            return ChipSpec(kind, fl, bw, True)
+    return ChipSpec(kind, 197e12, 819e9, False)
+
+
+def matmul_params(cfg: ModelConfig) -> int:
+    """Parameters that participate in per-token matmuls (embedding lookup
+    excluded; the LM head included — tied or not, it is a [D, V] matmul)."""
+    d, hd = cfg.dim, cfg.hd
+    per_layer = (
+        d * cfg.n_heads * hd          # wq
+        + 2 * d * cfg.n_kv_heads * hd  # wk, wv
+        + cfg.n_heads * hd * d        # wo
+    )
+    if cfg.n_experts:
+        # only the activated experts' FFN weights do per-token work
+        per_layer += 3 * d * cfg.hidden_dim * cfg.n_experts_per_token
+    else:
+        per_layer += 3 * d * cfg.hidden_dim
+    return cfg.n_layers * per_layer + d * cfg.vocab_size
+
+
+def prefill_flops(cfg: ModelConfig, n_tokens: int, head_tokens: int | None = None) -> float:
+    """Forward FLOPs for a fresh causal prefill of ``n_tokens``.
+
+    Dense matmuls: 2 FLOPs per param per token.  Causal attention:
+    2 * S^2 * hd * H per layer (QK^T + PV, averaged S/2 keys per query,
+    2 FLOPs per MAC).  ``head_tokens`` restricts the LM-head matmul to the
+    sampled rows (the packed-prefill gather, forward_paged)."""
+    d = cfg.dim
+    body = matmul_params(cfg) - d * cfg.vocab_size
+    fl = 2.0 * body * n_tokens
+    fl += 2.0 * (head_tokens if head_tokens is not None else n_tokens) \
+        * d * cfg.vocab_size
+    fl += 2.0 * cfg.n_layers * float(n_tokens) ** 2 * cfg.hd * cfg.n_heads
+    return fl
+
+
+def weight_bytes(cfg: ModelConfig, quantized: bool = False) -> float:
+    """Bytes of weights a decode step streams from HBM (all of them)."""
+    import jax.numpy as jnp
+
+    itemsize = 1 if quantized else jnp.dtype(cfg.dtype).itemsize
+    return matmul_params(cfg) * itemsize + cfg.vocab_size * cfg.dim * (
+        jnp.dtype(cfg.dtype).itemsize if not cfg.tie_embeddings else 0)
+
+
+def kv_bytes_per_token(cfg: ModelConfig) -> float:
+    """KV-cache bytes per cached token (K + V, all layers, all kv heads)."""
+    import jax.numpy as jnp
+
+    return (2 * cfg.n_layers * cfg.n_kv_heads * cfg.hd
+            * jnp.dtype(cfg.dtype).itemsize)
+
+
+def decode_step_bytes(cfg: ModelConfig, total_live_tokens: int,
+                      quantized: bool = False) -> float:
+    """HBM bytes one batched decode step moves: every weight once (batch
+    amortized — one read serves all rows) + every live KV token's K and V."""
+    return weight_bytes(cfg, quantized) + kv_bytes_per_token(cfg) * total_live_tokens

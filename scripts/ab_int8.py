@@ -1,0 +1,61 @@
+"""ABBA: int8 weight-only quantization vs bf16 at bench-1b scale.
+
+Two engines (params differ), alternating decode-heavy waves A B B A.
+Run: python scripts/ab_int8.py
+"""
+import time
+
+import numpy as np
+
+from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.utils.logging import setup_logging
+
+
+def wave(engine, n, max_new, tag):
+    rng = np.random.default_rng(hash(tag) % 2**31)
+    reqs = [GenerationRequest(
+        prompt=f"[{i:02d}:00] " + " ".join(
+            f"word{rng.integers(0, 997)}" for _ in range(160)),
+        request_id=i, temperature=0.3, max_new_tokens=max_new)
+        for i in range(n)]
+    t0 = time.time()
+    out = engine.generate_batch(reqs)
+    dt = time.time() - t0
+    assert all(r.error is None for r in out)
+    return dt
+
+
+def main():
+    setup_logging(quiet=True)
+    model = model_preset("bench-1b")
+
+    def make(quant):
+        return JaxEngine(EngineConfig(
+            backend="jax", max_tokens=128, max_batch_slots=24,
+            retry_delay=0.0, seed=0, page_size=512, num_pages=1,
+            decode_block=128, prefill_chunk=4096, quantize=quant), model)
+
+    a = make(None)     # bf16
+    b = make("int8")
+    n, max_new = 48, 128  # decode-heavy: int8 pays in the weight stream
+    wave(a, n, max_new, "warmA")
+    wave(b, n, max_new, "warmB")
+
+    rounds = []
+    for r in range(3):
+        res = {}
+        for arm, eng in (("A", a), ("B", b), ("B2", b), ("A2", a)):
+            res[arm] = wave(eng, n, max_new, f"{r}{arm}")
+        am = (res["A"] + res["A2"]) / 2
+        bm = (res["B"] + res["B2"]) / 2
+        rounds.append((am, bm))
+        print(f"round {r}: bf16={am:.2f}s int8={bm:.2f}s "
+              f"int8 wins {100*(am-bm)/am:+.1f}% ({res})", flush=True)
+    am = np.mean([r[0] for r in rounds]); bm = np.mean([r[1] for r in rounds])
+    print(f"MEAN bf16={am:.2f}s int8={bm:.2f}s  int8 wins {100*(am-bm)/am:+.1f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Speculation overhead at bench-1b scale (random weights => ~zero draft
+acceptance: this measures pure speculation cost; acceptance upside needs a
+real checkpoint and is demonstrated separately on the trained tiny model).
+
+Three engines: speculate_k in {0, 4, 8}; interleaved A B C C B A waves.
+Run: python scripts/ab_spec.py
+"""
+import time
+
+import numpy as np
+
+from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.utils.logging import setup_logging
+
+
+def wave(engine, n, max_new, tag):
+    rng = np.random.default_rng(hash(tag) % 2**31)
+    reqs = [GenerationRequest(
+        prompt=f"[{i:02d}:00] " + " ".join(
+            f"word{rng.integers(0, 997)}" for _ in range(160)),
+        request_id=i, temperature=0.3, max_new_tokens=max_new)
+        for i in range(n)]
+    t0 = time.time()
+    out = engine.generate_batch(reqs)
+    dt = time.time() - t0
+    assert all(r.error is None for r in out)
+    return dt
+
+
+def main():
+    setup_logging(quiet=True)
+    model = model_preset("bench-1b")
+
+    def make(k):
+        return JaxEngine(EngineConfig(
+            backend="jax", max_tokens=128, max_batch_slots=24,
+            retry_delay=0.0, seed=0, page_size=512, num_pages=1,
+            decode_block=128, prefill_chunk=4096, speculate_k=k), model)
+
+    engines = {0: make(0), 4: make(4), 8: make(8)}
+    n, max_new = 48, 128
+    for k, e in engines.items():
+        wave(e, n, max_new, f"warm{k}")
+
+    sums = {k: [] for k in engines}
+    for r in range(3):
+        order = [0, 4, 8, 8, 4, 0]
+        for k in order:
+            dt = wave(engines[k], n, max_new, f"{r}-{k}-{len(sums[k])}")
+            sums[k].append(dt)
+        line = "  ".join(f"k={k}: {np.mean(v):.2f}s" for k, v in sums.items())
+        print(f"round {r}: {line}", flush=True)
+    for k, v in sums.items():
+        acc = engines[k]._scheduler.metrics.get("spec_accepted_tokens", 0)
+        print(f"k={k}: mean {np.mean(v):.2f}s  accepted={acc}")
+
+
+if __name__ == "__main__":
+    main()

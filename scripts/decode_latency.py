@@ -1,0 +1,60 @@
+"""Decode-latency benchmark for the prefill_chunk default (VERDICT r1
+item 10): distribution of decode-dispatch gaps for already-active slots
+while a long prompt admits mid-stream, chunked (512) vs one-dispatch
+(4096) prefill.  Run: python scripts/decode_latency.py
+"""
+import os
+import time
+
+import numpy as np
+
+os.environ["LMRS_TRACE_DISPATCH"] = "1"
+
+from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.utils.logging import setup_logging
+
+
+def run(prefill_chunk, label):
+    model = model_preset("bench-1b")
+    eng = JaxEngine(EngineConfig(
+        backend="jax", max_tokens=256, max_batch_slots=8,
+        retry_delay=0.0, seed=0, page_size=512, num_pages=1,
+        decode_block=8, prefill_chunk=prefill_chunk), model)
+    sched = eng._scheduler
+    rng = np.random.default_rng(0)
+    # 6 active decoders (short prompts, long decodes)
+    active = [GenerationRequest(
+        prompt=" ".join(f"w{rng.integers(0, 97)}" for _ in range(30)),
+        request_id=i, temperature=0.5, max_new_tokens=256) for i in range(6)]
+    # 8 long prompts that admit mid-stream as slots churn
+    longs = [GenerationRequest(
+        prompt=" ".join(f"word{rng.integers(0, 997)}" for _ in range(230)),
+        request_id=100 + i, temperature=0.5, max_new_tokens=8)
+        for i in range(8)]
+    eng.generate_batch(active[:2])  # warm compile
+    sched._trace_dispatch.clear()
+    t0 = time.time()
+    eng.generate_batch(active + longs)
+    wall = time.time() - t0
+    ts = np.asarray(sched._trace_dispatch)
+    gaps = np.diff(ts) * 1e3
+    print(f"{label}: wall={wall:.1f}s dispatches={len(ts)} "
+          f"gap p50={np.percentile(gaps, 50):.0f}ms "
+          f"p90={np.percentile(gaps, 90):.0f}ms "
+          f"p99={np.percentile(gaps, 99):.0f}ms max={gaps.max():.0f}ms",
+          flush=True)
+    eng.shutdown()
+    return gaps
+
+
+def main():
+    setup_logging(quiet=True)
+    for pc, label in ((512, "chunked-512"), (4096, "one-dispatch"),
+                      (4096, "one-dispatch-2"), (512, "chunked-512-2")):
+        run(pc, label)
+
+
+if __name__ == "__main__":
+    main()

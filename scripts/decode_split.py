@@ -1,0 +1,73 @@
+"""Where does the decode step spend its 15 ms?  Sweep live context at
+bench-1b scale: per-step time vs live tokens separates the weight-stream
+cost (intercept) from the KV-walk cost (slope).
+Run: python scripts/decode_split.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.utils.logging import setup_logging
+from lmrs_tpu.utils.perf_model import decode_step_bytes, kv_bytes_per_token, weight_bytes
+
+
+def main():
+    setup_logging(quiet=True)
+    model = model_preset("bench-1b")
+    eng = JaxEngine(EngineConfig(
+        backend="jax", max_tokens=128, max_batch_slots=24,
+        retry_delay=0.0, seed=0, page_size=512, num_pages=1,
+        decode_block=128, prefill_chunk=4096), model)
+    sched = eng._scheduler
+    rng = np.random.default_rng(0)
+    B, S = sched.B, model.max_seq_len
+    w = sched.cache.max_pages_per_slot
+    dfn = sched._get_decode_fn(w)
+
+    x = jnp.zeros((8,), jnp.float32)
+    np.asarray(jax.device_get(x + 1))
+    t0 = time.time(); np.asarray(jax.device_get(x + 1)); rtt = time.time() - t0
+
+    seqs = [sched.cache.open_sequence(S) for _ in range(B)]
+    table = jnp.asarray(sched.cache.page_table_array(seqs)[:, :w])
+    onesB = jnp.ones((B,), jnp.float32)
+    results = []
+    for live in (64, 512, 1024, 1536, 1920):
+        dargs = (jnp.asarray(rng.integers(1, 255, (B,), dtype=np.int32)),
+                 jnp.full((B,), live, jnp.int32), table,
+                 jnp.ones((B,), bool), jax.random.PRNGKey(8), onesB,
+                 jnp.zeros((B,), jnp.int32), onesB)
+        k, v = sched.cache.k, sched.cache.v
+        toks, n_valid, k, v = dfn(sched.params, k, v, *dargs)
+        np.asarray(jax.device_get(n_valid))
+        t0 = time.time()
+        for _ in range(3):
+            toks, n_valid, k, v = dfn(sched.params, k, v, *dargs)
+        np.asarray(jax.device_get(n_valid))
+        wall = time.time() - t0 - rtt
+        sched.cache.k, sched.cache.v = k, v
+        per_step = wall / (3 * sched.decode_block)
+        gb = decode_step_bytes(model, B * live) / 1e9
+        results.append((live, per_step, gb))
+        print(f"live={live:5d}  {per_step*1e3:7.3f} ms/step  "
+              f"{gb:5.2f} GB/step  {gb/per_step:6.0f} GB/s", flush=True)
+    # linear fit: intercept = weight+fixed cost, slope = per-KV-token cost
+    lv = np.array([r[0] for r in results], float)
+    ms = np.array([r[1] for r in results], float) * 1e3
+    A = np.vstack([lv, np.ones_like(lv)]).T
+    slope, intercept = np.linalg.lstsq(A, ms, rcond=None)[0]
+    kvgb = B * kv_bytes_per_token(model) / 1e9
+    print(f"fit: intercept {intercept:.2f} ms (weights {weight_bytes(model)/1e9:.2f} GB "
+          f"-> floor {weight_bytes(model)/819e9*1e3:.2f} ms), "
+          f"slope {slope*1e3:.3f} us/live-token "
+          f"(KV floor {kvgb/819*1e6:.3f} us/token)")
+    for s_ in seqs:
+        sched.cache.close_sequence(s_)
+
+
+if __name__ == "__main__":
+    main()

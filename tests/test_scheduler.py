@@ -369,6 +369,22 @@ def test_preemption_under_page_pressure_preserves_output():
         assert g.completion_tokens == w.completion_tokens
 
 
+def test_roofline_microbench_smoke(cont_engine):
+    """The roofline probe shares the compiled-program arg contract with the
+    scheduler; this smoke run catches signature drift off-chip (the real
+    numbers only mean something on TPU — bench.py)."""
+    out = cont_engine._scheduler.roofline_microbench(prefill_reps=2,
+                                                     decode_reps=1)
+    for key in ("prefill_tokens_per_sec", "decode_tokens_per_sec"):
+        assert out[key] > 0, out
+    for key in ("model_flops_utilization", "hbm_bw_utilization"):
+        # tiny CPU model: utilization rounds to ~0; presence + range only
+        assert 0 <= out[key] < 1.5, out
+    # pool must be fully released afterwards
+    cache = cont_engine._scheduler.cache
+    assert cache.allocator.free_count == cache.num_pages - 1
+
+
 def test_pow2_bucket():
     from lmrs_tpu.engine.scheduler import _pow2_bucket
 
