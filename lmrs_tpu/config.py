@@ -141,7 +141,13 @@ class EngineConfig:
     max_batch_slots: int = 8
     page_size: int = 128
     num_pages: int = 512
-    prefill_chunk: int = 512
+    # Prompt tokens prefilled per scheduler turn.  Default = one-dispatch
+    # prefill: chunking (e.g. 512) was ABBA-measured a throughput LOSS and,
+    # per the decode-latency histogram (docs/PERF.md round 2), DOUBLES p50
+    # decode latency for active slots (272-302 vs 140-144 ms/block) while
+    # only trimming p90 (330 vs 443-485 ms).  Set a small value only when
+    # worst-case tail fairness under very long prompts outweighs both.
+    prefill_chunk: int = 4096
     decode_block: int = 16  # decode steps per host sync (see scheduler)
     # prompt-lookup speculative decoding: draft length per step (0 = off).
     # Exact-distribution verify (ops/speculative.py) — output quality is

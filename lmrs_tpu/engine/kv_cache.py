@@ -142,6 +142,14 @@ class PagedKVCache:
             2 * np.prod(shape) * dt.itemsize / 2**20,
         )
 
+    def reallocate(self) -> None:
+        """Fresh zeroed pools with the same shape/dtype/sharding.  Recovery
+        hook for a failed DONATED dispatch chain (roofline_microbench): the
+        old buffers may already be consumed, leaving self.k/v unusable.
+        Only valid while no sequence is live (content is discarded)."""
+        self.k = jnp.zeros(self.k.shape, self.k.dtype, device=self.k.sharding)
+        self.v = jnp.zeros(self.v.shape, self.v.dtype, device=self.v.sharding)
+
     def pages_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 

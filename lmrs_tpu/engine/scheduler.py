@@ -176,6 +176,7 @@ class ContinuousScheduler:
             "occupancy_sum": 0.0, "peak_pages_in_use": 0, "run_seconds": 0.0,
             "spec_accepted_tokens": 0,  # draft tokens accepted (speculation)
             "preemptions": 0,  # slots evicted to the queue under page pressure
+            "stalls": 0,  # dispatches a slot sat out waiting for pages
             "peak_active_slots": 0,  # max simultaneously-occupied slots
         }
 
@@ -397,6 +398,19 @@ class ContinuousScheduler:
             stalled = self._ensure_decode_capacity(slots, queue, kv_lens,
                                                    last_tok, active)
             if not any(active):
+                if deferred:
+                    # no dispatch will carry these first tokens: fetch them
+                    # now — a stalled slot's tok0 is real output and must
+                    # not be dropped (preempted slots resample theirs)
+                    fetched = jax.device_get([t for t, _ in pending])
+                    for (b, p, row) in deferred:
+                        if slots[b] is None:
+                            continue
+                        tok0 = int(fetched[p][row])
+                        slots[b].generated.append(tok0)
+                        last_tok[b] = tok0
+                        self._maybe_finish(b, slots, results, active, fresh,
+                                           kv_lens, last_tok)
                 for b in stalled:  # re-arm before looping back
                     if slots[b] is not None:
                         active[b] = True
@@ -413,11 +427,18 @@ class ContinuousScheduler:
                     slots, last_tok, kv_lens, active, temps, top_k, top_p,
                     pending)
                 for (b, p, row) in deferred:
-                    if slots[b] is None or not active[b]:
-                        continue  # preempted between prefill and dispatch
+                    if slots[b] is None:
+                        continue  # preempted: tok0 is resampled on re-prefill
                     tok0 = int(tok0s[p][row])
                     slots[b].generated.append(tok0)
                     last_tok[b] = tok0
+                    if not active[b]:
+                        # STALLED this dispatch (no pages to grow): the slot
+                        # emitted nothing, but its first token is real output
+                        # — record it and check for an early finish; the
+                        # emitted loop below skips inactive rows
+                        self._maybe_finish(b, slots, results, active, fresh,
+                                           kv_lens, last_tok)
                 emitted = [toks[b, : int(n_valid[b])].tolist()
                            for b in range(self.B)]
             for b in range(self.B):
@@ -465,7 +486,20 @@ class ContinuousScheduler:
         host RTT amortizes over the chain — ``block_until_ready`` does NOT
         synchronize through tunneled chips (docs/PERF.md); RTT is measured
         separately and subtracted.  The pool must be idle (no live slots).
+
+        On ANY failure the pools are reallocated before re-raising: a
+        mid-chain error leaves ``cache.k/v`` pointing at donated buffers,
+        and without recovery every later dispatch — including the caller's
+        primary workload — would fail on them.
         """
+        try:
+            return self._roofline_microbench(prefill_reps, decode_reps)
+        except Exception:
+            self.cache.reallocate()
+            raise
+
+    def _roofline_microbench(self, prefill_reps: int,
+                             decode_reps: int) -> dict:
         from lmrs_tpu.utils.perf_model import (
             chip_spec, decode_step_bytes, kv_bytes_per_token, prefill_flops,
             weight_bytes,
@@ -590,6 +624,7 @@ class ContinuousScheduler:
                     if victim is None:
                         stalled.append(b)
                         active[b] = False
+                        self.metrics["stalls"] += 1
                         break
                     self._preempt(victim, slots, queue, kv_lens, last_tok,
                                   active)

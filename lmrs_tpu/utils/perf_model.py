@@ -83,12 +83,14 @@ def prefill_flops(cfg: ModelConfig, n_tokens: int, head_tokens: int | None = Non
 
 
 def weight_bytes(cfg: ModelConfig, quantized: bool = False) -> float:
-    """Bytes of weights a decode step streams from HBM (all of them)."""
+    """Bytes of MATMUL weights a decode step streams from HBM (all of
+    them, once — one read serves the whole batch).  The embedding lookup
+    gathers only B rows per step and is excluded (negligible; counting
+    the full table would overstate untied models' bandwidth)."""
     import jax.numpy as jnp
 
     itemsize = 1 if quantized else jnp.dtype(cfg.dtype).itemsize
-    return matmul_params(cfg) * itemsize + cfg.vocab_size * cfg.dim * (
-        jnp.dtype(cfg.dtype).itemsize if not cfg.tie_embeddings else 0)
+    return matmul_params(cfg) * itemsize
 
 
 def kv_bytes_per_token(cfg: ModelConfig) -> float:

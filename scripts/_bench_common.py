@@ -1,0 +1,25 @@
+"""Shared harness for the chip A/B scripts: one wave() so every arm in
+every script measures the identical workload (drift between copies would
+silently bias the comparison)."""
+import time
+
+import numpy as np
+
+from lmrs_tpu.engine.api import GenerationRequest
+
+
+def wave(engine, n, max_new, tag, words=(160, 161), temperature=0.3):
+    """One timed generate_batch of n requests; prompt lengths drawn from
+    ``words`` = (lo, hi) range (uniform ~1.3k-byte prompts by default)."""
+    rng = np.random.default_rng(hash(tag) % 2**31)
+    reqs = [GenerationRequest(
+        prompt=f"[{i:02d}:00] " + " ".join(
+            f"word{rng.integers(0, 997)}"
+            for _ in range(int(rng.integers(*words)))),
+        request_id=i, temperature=temperature, max_new_tokens=max_new)
+        for i in range(n)]
+    t0 = time.time()
+    out = engine.generate_batch(reqs)
+    dt = time.time() - t0
+    assert all(r.error is None for r in out)
+    return dt

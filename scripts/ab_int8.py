@@ -8,23 +8,15 @@ import time
 import numpy as np
 
 from lmrs_tpu.config import EngineConfig, model_preset
-from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 
+import sys as _sys
+from pathlib import Path as _Path
+_sys.path.insert(0, str(_Path(__file__).parent))
+from _bench_common import wave
 
-def wave(engine, n, max_new, tag):
-    rng = np.random.default_rng(hash(tag) % 2**31)
-    reqs = [GenerationRequest(
-        prompt=f"[{i:02d}:00] " + " ".join(
-            f"word{rng.integers(0, 997)}" for _ in range(160)),
-        request_id=i, temperature=0.3, max_new_tokens=max_new)
-        for i in range(n)]
-    t0 = time.time()
-    out = engine.generate_batch(reqs)
-    dt = time.time() - t0
-    assert all(r.error is None for r in out)
-    return dt
+
 
 
 def main():
@@ -40,14 +32,14 @@ def main():
     a = make(None)     # bf16
     b = make("int8")
     n, max_new = 48, 128  # decode-heavy: int8 pays in the weight stream
-    wave(a, n, max_new, "warmA")
-    wave(b, n, max_new, "warmB")
+    wave(a, n, max_new, "warmA", words=(160, 161))
+    wave(b, n, max_new, "warmB", words=(160, 161))
 
     rounds = []
     for r in range(3):
         res = {}
         for arm, eng in (("A", a), ("B", b), ("B2", b), ("A2", a)):
-            res[arm] = wave(eng, n, max_new, f"{r}{arm}")
+            res[arm] = wave(eng, n, max_new, f"{r}{arm}", words=(160, 161))
         am = (res["A"] + res["A2"]) / 2
         bm = (res["B"] + res["B2"]) / 2
         rounds.append((am, bm))

@@ -10,24 +10,15 @@ import time
 import numpy as np
 
 from lmrs_tpu.config import EngineConfig, model_preset
-from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 
+import sys as _sys
+from pathlib import Path as _Path
+_sys.path.insert(0, str(_Path(__file__).parent))
+from _bench_common import wave
 
-def wave(engine, n, max_new, tag):
-    rng = np.random.default_rng(hash(tag) % 2**31)
-    # ~1850-byte transcript-like prompts, varied so no trivial cache reuse
-    reqs = [GenerationRequest(
-        prompt=f"[{i:02d}:00] " + " ".join(
-            f"word{rng.integers(0, 997)}" for _ in range(230)),
-        request_id=i, temperature=0.3, max_new_tokens=max_new)
-        for i in range(n)]
-    t0 = time.time()
-    out = engine.generate_batch(reqs)
-    dt = time.time() - t0
-    assert all(r.error is None for r in out)
-    return dt
+
 
 
 def main():
@@ -43,16 +34,16 @@ def main():
 
     # warm BOTH paths (compile everything)
     sched._pack_prefill = True
-    wave(eng, n, max_new, "warmA")
+    wave(eng, n, max_new, "warmA", words=(60, 231))
     sched._pack_prefill = False
-    wave(eng, n, max_new, "warmB")
+    wave(eng, n, max_new, "warmB", words=(60, 231))
 
     rounds = []
     for r in range(3):
         res = {}
         for arm in ("A", "B", "B2", "A2"):
             sched._pack_prefill = arm.startswith("A")
-            res[arm] = wave(eng, n, max_new, f"{r}{arm}")
+            res[arm] = wave(eng, n, max_new, f"{r}{arm}", words=(60, 231))
         a = (res["A"] + res["A2"]) / 2
         b = (res["B"] + res["B2"]) / 2
         rounds.append((a, b))

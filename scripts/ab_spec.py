@@ -10,23 +10,15 @@ import time
 import numpy as np
 
 from lmrs_tpu.config import EngineConfig, model_preset
-from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 
+import sys as _sys
+from pathlib import Path as _Path
+_sys.path.insert(0, str(_Path(__file__).parent))
+from _bench_common import wave
 
-def wave(engine, n, max_new, tag):
-    rng = np.random.default_rng(hash(tag) % 2**31)
-    reqs = [GenerationRequest(
-        prompt=f"[{i:02d}:00] " + " ".join(
-            f"word{rng.integers(0, 997)}" for _ in range(160)),
-        request_id=i, temperature=0.3, max_new_tokens=max_new)
-        for i in range(n)]
-    t0 = time.time()
-    out = engine.generate_batch(reqs)
-    dt = time.time() - t0
-    assert all(r.error is None for r in out)
-    return dt
+
 
 
 def main():
@@ -39,16 +31,19 @@ def main():
             retry_delay=0.0, seed=0, page_size=512, num_pages=1,
             decode_block=128, prefill_chunk=4096, speculate_k=k), model)
 
-    engines = {0: make(0), 4: make(4), 8: make(8)}
+    import sys
+    spec_k = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    # pairwise (0 vs spec_k): three 1B engines OOM a 16 GB chip
+    engines = {0: make(0), spec_k: make(spec_k)}
     n, max_new = 48, 128
     for k, e in engines.items():
-        wave(e, n, max_new, f"warm{k}")
+        wave(e, n, max_new, f"warm{k}", words=(160, 161))
 
     sums = {k: [] for k in engines}
     for r in range(3):
-        order = [0, 4, 8, 8, 4, 0]
+        order = [0, spec_k, spec_k, 0]
         for k in order:
-            dt = wave(engines[k], n, max_new, f"{r}-{k}-{len(sums[k])}")
+            dt = wave(engines[k], n, max_new, f"{r}-{k}-{len(sums[k])}", words=(160, 161))
             sums[k].append(dt)
         line = "  ".join(f"k={k}: {np.mean(v):.2f}s" for k, v in sums.items())
         print(f"round {r}: {line}", flush=True)

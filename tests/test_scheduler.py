@@ -385,6 +385,35 @@ def test_roofline_microbench_smoke(cont_engine):
     assert cache.allocator.free_count == cache.num_pages - 1
 
 
+def test_stalled_slot_keeps_first_token():
+    """Regression: a slot that finishes prefill but must STALL (pool pages
+    held by a mid-prefill neighbor, no preemptable decode victim) must not
+    drop its deferred first token — output must equal a roomy-pool run."""
+    mc = _short_ctx_model()
+    # short prompt (31 ids: 2 pages, but 31+decode_block=35 needs a 3rd)
+    # finishes prefill in one chunk and must grow immediately, while the
+    # long prompt (2 chunks of 64, 5 pages) is still mid-prefill and not
+    # preemptable: 2+5 = all 7 usable pages -> the short slot STALLS
+    reqs = [GenerationRequest(prompt="s" * 30, request_id=0,
+                              temperature=0.0, max_new_tokens=8),
+            GenerationRequest(prompt="x" * 78, request_id=1,
+                              temperature=0.0, max_new_tokens=8)]
+    ec = lambda npages: EngineConfig(
+        backend="jax", scheduler="continuous", max_tokens=8,
+        max_batch_slots=2, seed=0, page_size=16, num_pages=npages,
+        decode_block=4, prefill_chunk=64)
+    roomy = JaxEngine(ec(1), mc)  # worst-case pool: no pressure
+    want = [r.text for r in roomy.generate_batch(reqs)]
+    roomy.shutdown()
+
+    tight = JaxEngine(ec(8), mc)
+    got = [r.text for r in tight.generate_batch(reqs)]
+    m = tight._scheduler.metrics
+    tight.shutdown()
+    assert m["stalls"] > 0, f"stall branch never exercised: {m}"
+    assert got == want
+
+
 def test_pow2_bucket():
     from lmrs_tpu.engine.scheduler import _pow2_bucket
 
