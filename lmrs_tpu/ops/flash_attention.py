@@ -18,6 +18,7 @@ see tests/test_kernels.py.  Falls back to interpret mode off-TPU.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +26,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# Default q/kv tile: at S=2048 a 256 tile means 1024 grid programs per
+# layer call and per-program overhead shows up in the MFU; 512 quarters
+# the program count — measured +3.6 MFU points at bench-1b (37.9% -> 41.5%
+# bf16; docs/PERF.md round 2).  Env knob for A/B sweeps.
+_DEFAULT_BLOCK = int(os.environ.get("LMRS_FLASH_BLOCK", "512"))
 
 
 def _flash_kernel(
@@ -122,8 +129,8 @@ def flash_attention(
     k: jnp.ndarray,          # [B, Skv, K, hd]
     v: jnp.ndarray,          # [B, Skv, K, hd]
     lengths: jnp.ndarray | None = None,  # [B] valid kv length
-    q_block: int = 256,
-    kv_block: int = 256,
+    q_block: int = _DEFAULT_BLOCK,
+    kv_block: int = _DEFAULT_BLOCK,
     interpret: bool = False,
     skip_padded_q: bool = True,
     segment_ids: jnp.ndarray | None = None,  # [B, S] packed-prompt segments
