@@ -93,6 +93,10 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, quantize=args.quantize)
     if args.speculate_k is not None:
         engine = dataclasses.replace(engine, speculate_k=args.speculate_k)
+    if args.tokenizer and args.tokenizer != "approx":
+        # ONE token authority (SURVEY §7.4 item 4): an explicit --tokenizer
+        # names the serving tokenizer too, not just the chunker's counter
+        engine = dataclasses.replace(engine, tokenizer=args.tokenizer)
     return PipelineConfig(
         data=DataConfig(
             merge_same_speaker=not args.no_merge,
@@ -115,6 +119,12 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(quiet=args.quiet)
+    # an explicit JAX_PLATFORMS=cpu must beat any sitecustomize that
+    # force-registers an accelerator (utils/platform.py) — without this a
+    # wedged tunnel hangs even pure-CPU runs
+    from lmrs_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
 
     try:
         transcript = json.loads(Path(args.input).read_text(encoding="utf-8"))

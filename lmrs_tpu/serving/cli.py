@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(quiet=args.quiet)
+    from lmrs_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
     engine_cfg = EngineConfig(
         backend=args.backend,
         model=args.model,

@@ -11,10 +11,13 @@ import jax
 def honor_platform_env() -> None:
     """Some hosts' sitecustomize force-registers an accelerator backend
     (jax.config.update("jax_platforms", ...)), silently overriding the
-    standard JAX_PLATFORMS env var; re-apply an explicit cpu request.
-    Call before the first backend use."""
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    standard JAX_PLATFORMS env var; re-apply any explicit request (a wedged
+    accelerator tunnel otherwise hangs even pure-CPU runs).  Call before
+    the first backend use.  The ONE shared copy of this workaround —
+    CLIs and bench.py all route here."""
+    value = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if value:
+        jax.config.update("jax_platforms", value)
 
 
 def on_tpu() -> bool:
