@@ -5,18 +5,26 @@ Two implementations with one contract:
 * ``paged_decode_xla`` — gather-based fallback (any platform): gathers the
   slot's pages into a contiguous [B, W, K, hd] window and runs masked
   attention.  Cost ∝ the (bucketed) window, independent of real lengths.
-* ``paged_decode_pallas`` — ragged Pallas kernel (TPU): grid over
-  (batch, kv_head); each program walks ONLY its row's live pages — a dynamic
-  ``fori_loop`` bound from SMEM — DMA-ing K/V pages HBM→VMEM and folding them
-  into an online softmax.  Decode cost is proportional to the tokens actually
-  in the cache (the Ragged Paged Attention idea, PAPERS.md), which is the
+* ``paged_decode_pallas`` — ragged Pallas kernel (TPU): grid over (batch,);
+  each program walks ONLY its row's live pages — a dynamic ``fori_loop``
+  bound from SMEM — DMA-ing K/V pages HBM→VMEM and folding them into an
+  online softmax.  Decode cost is proportional to the tokens actually in
+  the cache (the Ragged Paged Attention idea, PAPERS.md), which is the
   whole point of paging: decode is HBM-bandwidth-bound and the bandwidth
   spent is exactly the live KV bytes.
+
+The kv-head axis is folded INTO each program as a statically-unrolled loop
+(round 3; previously grid=(B, K)): one program per batch row walks all
+kv heads' pages through one double-buffered DMA pipeline that crosses head
+boundaries.  At bench shape this cuts programs/step 8× (3,456 → 432 per
+model step) — the round-2 decode fixed cost was diagnosed as program +
+small-DMA launch latency, not bandwidth (docs/PERF.md round 2: 9.39 ms
+fitted fixed cost vs a 2.49 ms weight-stream floor).
 
 Cache layout: [K, P_total, page_size, hd] (kv-head-major so one page of one
 kv head is a contiguous [page_size, hd] DMA; P_total flattens the layer axis
 into the page axis — engine/kv_cache.PagedKVCache — and callers pass GLOBAL
-page ids).
+page ids, shared across kv heads).
 """
 
 from __future__ import annotations
@@ -62,27 +70,34 @@ def paged_decode_xla(
 # ------------------------------------------------------------ Pallas kernel
 
 
-def _ragged_decode_kernel(
+def _ragged_decode_all_heads(
     # scalar prefetch
     page_tables_ref,  # SMEM [B, W]
     kv_lens_ref,      # SMEM [B]
     # inputs
-    q_ref,            # VMEM [1, n_rep, hd] (this batch row, this kv head's group)
-    k_hbm,            # ANY  [P, ps, hd] (this kv head's page pool)
-    v_hbm,            # ANY  [P, ps, hd]
+    q_ref,            # VMEM [kh, n_rep_p, hd] (this batch row, all kv heads)
+    k_hbm,            # ANY  [K, P, ps, hd] (full page pool)
+    v_hbm,            # ANY  [K, P, ps, hd]
     # output
-    o_ref,            # VMEM [1, n_rep, hd]
+    o_ref,            # VMEM [kh, n_rep_p, hd]
     # scratch
     k_scr,            # VMEM [2, ps, hd] double-buffered
     v_scr,            # VMEM [2, ps, hd]
-    acc_scr,          # VMEM [n_rep, hd] f32
-    m_scr,            # VMEM [n_rep, 128] f32
-    l_scr,            # VMEM [n_rep, 128] f32
+    acc_scr,          # VMEM [n_rep_p, hd] f32 (current head)
+    m_scr,            # VMEM [n_rep_p, 128] f32
+    l_scr,            # VMEM [n_rep_p, 128] f32
     sem,              # DMA semaphores (2, 2): [buffer parity, k/v]
     *,
     page_size: int,
     sm_scale: float,
+    kh: int,
 ):
+    """Walk every kv head's live pages for ONE batch row through a single
+    double-buffered DMA pipeline.  The head loop is a static Python unroll
+    (kh is a shape), so all VMEM indexing is static — only the page DMAs
+    carry dynamic indices — and the page prefetched at the end of head
+    ``ki`` is head ``ki+1``'s first page: the pipeline never drains at a
+    head boundary, which is the entire point of the fold."""
     b = pl.program_id(0)
     length = kv_lens_ref[b]
     # clamp to the table width: a row whose length exceeds its table (e.g.
@@ -93,120 +108,137 @@ def _ragged_decode_kernel(
         page_tables_ref.shape[1],
     )
 
-    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-    l_scr[:] = jnp.zeros_like(l_scr)
-    acc_scr[:] = jnp.zeros_like(acc_scr)
-    q = q_ref[0].astype(jnp.float32)  # [n_rep, hd]
-
-    def fetch(p, slot):
+    def fetch(ki, p, slot):
         page = page_tables_ref[b, p]
-        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sem.at[slot, 0]).start()
-        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sem.at[slot, 1]).start()
+        pltpu.make_async_copy(k_hbm.at[ki, page], k_scr.at[slot], sem.at[slot, 0]).start()
+        pltpu.make_async_copy(v_hbm.at[ki, page], v_scr.at[slot], sem.at[slot, 1]).start()
+
+    @pl.when(n_pages == 0)
+    def _zero():  # inactive row: defined output, no page walk
+        o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
 
     @pl.when(n_pages > 0)
     def _prime():
-        fetch(0, 0)
+        fetch(0, 0, 0)
 
-    def body(p, _):
-        slot = jax.lax.rem(p, 2)
+    for ki in range(kh):
+        base = ki * n_pages  # global step index of this head's first page
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+        q = q_ref[ki].astype(jnp.float32)  # [n_rep_p, hd]
 
-        # overlap: next page's DMA streams while this page computes
-        @pl.when(p + 1 < n_pages)
-        def _prefetch():
-            fetch(p + 1, jax.lax.rem(p + 1, 2))
+        def body(p, _, ki=ki, base=base, q=q):
+            g = base + p
+            slot = jax.lax.rem(g, 2)
 
-        page = page_tables_ref[b, p]
-        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sem.at[slot, 0]).wait()
-        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sem.at[slot, 1]).wait()
-        k = k_scr[slot].astype(jnp.float32)  # [ps, hd]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [n_rep, ps]
-        pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], page_size), 1
-        )
-        s = jnp.where(pos < length, s, NEG_INF)
+            # overlap: the NEXT page's DMA streams while this one computes —
+            # next page of this head, or the next head's first page
+            @pl.when(p + 1 < n_pages)
+            def _prefetch():
+                fetch(ki, p + 1, jax.lax.rem(g + 1, 2))
 
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        pw = jnp.exp(s - m_new)
-        pw = jnp.where(m_new > NEG_INF * 0.5, pw, 0.0)
-        l_scr[:] = jnp.broadcast_to(
-            alpha * l_scr[:, :1] + jnp.sum(pw, axis=1, keepdims=True), l_scr.shape
-        )
-        vv = v_scr[slot].astype(jnp.float32)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            pw, vv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        return _
+            if ki + 1 < kh:
+                @pl.when(p + 1 == n_pages)
+                def _prefetch_next_head():
+                    fetch(ki + 1, 0, jax.lax.rem(g + 1, 2))
 
-    jax.lax.fori_loop(0, n_pages, body, None)
-    l = l_scr[:, :1]
-    o_ref[0] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+            page = page_tables_ref[b, p]
+            pltpu.make_async_copy(
+                k_hbm.at[ki, page], k_scr.at[slot], sem.at[slot, 0]).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[ki, page], v_scr.at[slot], sem.at[slot, 1]).wait()
+            k = k_scr[slot].astype(jnp.float32)  # [ps, hd]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * sm_scale  # [n_rep_p, ps]
+            pos = p * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (q.shape[0], page_size), 1
+            )
+            s = jnp.where(pos < length, s, NEG_INF)
+
+            m_prev = m_scr[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            pw = jnp.exp(s - m_new)
+            pw = jnp.where(m_new > NEG_INF * 0.5, pw, 0.0)
+            l_scr[:] = jnp.broadcast_to(
+                alpha * l_scr[:, :1] + jnp.sum(pw, axis=1, keepdims=True), l_scr.shape
+            )
+            vv = v_scr[slot].astype(jnp.float32)
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                pw, vv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+            return _
+
+        jax.lax.fori_loop(0, n_pages, body, None)
+
+        @pl.when(n_pages > 0)
+        def _write(ki=ki):
+            l = l_scr[:, :1]
+            o_ref[ki] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
 
 
-def _fused_decode_kernel(
-    # scalar prefetch
-    page_tables_ref,  # SMEM [B, W] GLOBAL page ids
-    kv_lens_ref,      # SMEM [B] length INCLUDING the current token
-    # inputs
-    q_ref,            # VMEM [1, 1, n_rep_p, hd]
-    knew_ref,         # VMEM [1, 1, 8, hd] current token's K (row 0 real)
-    vnew_ref,         # VMEM [1, 1, 8, hd]
-    k_hbm,            # ANY  [P_total, ps, hd] (this kv head's pool)
-    v_hbm,            # ANY  [P_total, ps, hd]
-    # outputs
-    o_ref,            # VMEM [1, 1, n_rep_p, hd]
-    k_out,            # ANY  aliased to k_hbm
-    v_out,            # ANY  aliased to v_hbm
-    # scratch
-    k_scr, v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem,
+def _write_new_token_all_heads(
+    page_tables_ref, kv_lens_ref,
+    knew_ref,         # VMEM [kh, 8, hd] current token's K (row 0 real)
+    vnew_ref,         # VMEM [kh, 8, hd]
+    k_out,            # ANY  [K, P, ps, hd] aliased pool
+    v_out,
+    k8_scr,           # VMEM [kh, 8, hd]
+    v8_scr,
+    wsem,             # DMA semaphores (kh, 2)
     *,
     page_size: int,
-    sm_scale: float,
+    kh: int,
 ):
+    """Scatter the current token's K/V for EVERY kv head into its page slot
+    in place, pipelined: all heads' read-DMAs issue together, then each head
+    blends + issues its write-back, then all writes drain.  Mosaic can't DMA
+    a single sublane row, so each head read-modify-writes the aligned 8-row
+    window around the slot (knew rows are broadcast-identical, so a where on
+    the row index blends the real row)."""
     b = pl.program_id(0)
     length = kv_lens_ref[b]
     pos = length - 1
-    # clamped like the walk bound below: never index the table OOB, even
-    # for rows carrying a degenerate length (inactive slots write page 0)
+    # clamped like the walk bound: never index the table OOB, even for rows
+    # carrying a degenerate length (inactive slots write page 0)
     page_idx = jnp.clip(jax.lax.div(pos, page_size), 0,
                         page_tables_ref.shape[1] - 1)
     page = page_tables_ref[b, page_idx]
     off = jax.lax.rem(pos, page_size)
-
-    # Write the current token's K/V into its page slot IN PLACE (k_out is
-    # aliased to k_hbm) before the ragged walk reads that page.  Mosaic
-    # can't DMA a single sublane row, so read-modify-write an aligned 8-row
-    # window around the slot: DMA it in, blend the new row (knew_ref rows
-    # are broadcast-identical, so a where on the row index suffices), DMA
-    # it back.
     # window start must be PROVABLY 8-aligned for Mosaic's tile reasoning
     w0 = jax.lax.div(off, 8) * 8
     r = off - w0
-    rk = pltpu.make_async_copy(k_out.at[page, pl.ds(w0, 8)], k8_scr, wsem.at[0])
-    rv = pltpu.make_async_copy(v_out.at[page, pl.ds(w0, 8)], v8_scr, wsem.at[1])
-    rk.start()
-    rv.start()
-    rk.wait()
-    rv.wait()
-    row = jax.lax.broadcasted_iota(jnp.int32, k8_scr.shape, 0) == r
-    k8_scr[:] = jnp.where(row, knew_ref[0, 0], k8_scr[:])
-    v8_scr[:] = jnp.where(row, vnew_ref[0, 0], v8_scr[:])
-    wk = pltpu.make_async_copy(k8_scr, k_out.at[page, pl.ds(w0, 8)], wsem.at[0])
-    wv = pltpu.make_async_copy(v8_scr, v_out.at[page, pl.ds(w0, 8)], wsem.at[1])
-    wk.start()
-    wv.start()
-    wk.wait()
-    wv.wait()
 
-    _ragged_decode_kernel(
-        page_tables_ref, kv_lens_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
-        k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
-        page_size=page_size, sm_scale=sm_scale,
-    )
+    reads = []
+    for ki in range(kh):
+        rk = pltpu.make_async_copy(
+            k_out.at[ki, page, pl.ds(w0, 8)], k8_scr.at[ki], wsem.at[ki, 0])
+        rv = pltpu.make_async_copy(
+            v_out.at[ki, page, pl.ds(w0, 8)], v8_scr.at[ki], wsem.at[ki, 1])
+        rk.start()
+        rv.start()
+        reads.append((rk, rv))
+    writes = []
+    for ki in range(kh):
+        rk, rv = reads[ki]
+        rk.wait()
+        rv.wait()
+        row = jax.lax.broadcasted_iota(jnp.int32, (8, k8_scr.shape[-1]), 0) == r
+        k8_scr[ki] = jnp.where(row, knew_ref[ki], k8_scr[ki])
+        v8_scr[ki] = jnp.where(row, vnew_ref[ki], v8_scr[ki])
+        wk = pltpu.make_async_copy(
+            k8_scr.at[ki], k_out.at[ki, page, pl.ds(w0, 8)], wsem.at[ki, 0])
+        wv = pltpu.make_async_copy(
+            v8_scr.at[ki], v_out.at[ki, page, pl.ds(w0, 8)], wsem.at[ki, 1])
+        wk.start()
+        wv.start()
+        writes.append((wk, wv))
+    for wk, wv in writes:
+        wk.wait()
+        wv.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -222,9 +254,10 @@ def paged_decode_pallas_fused(
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write-fused ragged decode: scatter the current token's K/V into the
     page pool (in place — the pools are input/output aliased) and attend the
-    live pages, in one kernel.  Replaces XLA scatter + kernel: the XLA
-    scatter on the multi-GiB pool was measured copying the whole pool per
-    decode step (no in-place aliasing through the scan carry)."""
+    live pages, in one kernel, one program per BATCH ROW (all kv heads).
+    Replaces XLA scatter + kernel: the XLA scatter on the multi-GiB pool was
+    measured copying the whole pool per decode step (no in-place aliasing
+    through the scan carry)."""
     b, h, hd = q.shape
     kh = k_pages.shape[0]
     ps = k_pages.shape[2]
@@ -239,16 +272,16 @@ def paged_decode_pallas_fused(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, kh),
+        grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
-            pl.BlockSpec((1, 1, 8, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
-            pl.BlockSpec((1, 1, 8, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, kh, 8, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, kh, 8, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
@@ -258,22 +291,25 @@ def paged_decode_pallas_fused(
             pltpu.VMEM((n_rep_p, hd), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
-            pltpu.VMEM((8, hd), k_pages.dtype),
-            pltpu.VMEM((8, hd), v_pages.dtype),
+            pltpu.VMEM((kh, 8, hd), k_pages.dtype),
+            pltpu.VMEM((kh, 8, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((kh, 2)),
         ],
     )
 
     def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
                o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
                k8_scr, v8_scr, sem, wsem):
-        ki = pl.program_id(1)
-        _fused_decode_kernel(
-            pt_ref, len_ref, q_ref, knew_ref, vnew_ref,
-            k_hbm.at[ki], v_hbm.at[ki], o_ref, k_out.at[ki], v_out.at[ki],
-            k_scr, v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem,
-            page_size=ps, sm_scale=hd**-0.5,
+        # the new token's K/V must land before the walk reads its page
+        _write_new_token_all_heads(
+            pt_ref, len_ref, knew_ref.at[0], vnew_ref.at[0], k_out, v_out,
+            k8_scr, v8_scr, wsem, page_size=ps, kh=kh,
+        )
+        _ragged_decode_all_heads(
+            pt_ref, len_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
+            k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+            page_size=ps, sm_scale=hd**-0.5, kh=kh,
         )
 
     out, k_pages, v_pages = pl.pallas_call(
@@ -311,8 +347,9 @@ def paged_decode_fused_sharded(
     kv-head-sharded (engine/kv_cache.py), each shard's page walk and in-place
     K/V write touch only local HBM, and query heads shard consistently with
     their kv head (H/tp = (K/tp) * n_rep) — no cross-chip KV traffic, same
-    contract as the single-device kernel per shard.  Page tables and lengths
-    replicate (host-built, O(B*W) ints)."""
+    contract as the single-device kernel per shard (each shard's program
+    loops its LOCAL kv heads).  Page tables and lengths replicate
+    (host-built, O(B*W) ints)."""
     from jax.sharding import PartitionSpec as P
 
     head = P(None, "tp", None)
@@ -350,13 +387,13 @@ def paged_decode_pallas(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, kh),
+        grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, n_rep_p, hd), lambda bi, ki, *_: (bi, ki, 0, 0)),
+        out_specs=pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, ps, hd), k_pages.dtype),  # double-buffered pages
             pltpu.VMEM((2, ps, hd), v_pages.dtype),
@@ -369,12 +406,11 @@ def paged_decode_pallas(
 
     def kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
                k_scr, v_scr, acc_scr, m_scr, l_scr, sem):
-        ki = pl.program_id(1)
-        _ragged_decode_kernel(
+        _ragged_decode_all_heads(
             pt_ref, len_ref,
-            q_ref.at[0], k_hbm.at[ki], v_hbm.at[ki], o_ref.at[0],
+            q_ref.at[0], k_hbm, v_hbm, o_ref.at[0],
             k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
-            page_size=ps, sm_scale=hd**-0.5,
+            page_size=ps, sm_scale=hd**-0.5, kh=kh,
         )
 
     out = pl.pallas_call(
