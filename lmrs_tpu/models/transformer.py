@@ -323,6 +323,7 @@ def forward_paged(
     segment_ids: jnp.ndarray | None = None,   # [B, S] packed-prompt segments
     packed_last_idx: jnp.ndarray | None = None,  # [N] last-token row indices
     use_ring: bool = False,  # sp-mesh fresh prefill: ring attention over sp
+    last_pos: jnp.ndarray | None = None,  # [B] per-row last-token index
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -348,6 +349,13 @@ def forward_paged(
     packed length.  With ``packed_last_idx``, the LM head runs only on the
     gathered last-token rows (logits [B, N, V]) — the padding rows' vocab
     matmul is the FLOP waste packing exists to eliminate.
+
+    ``last_pos`` is the per-ROW version of the same gather for the fresh
+    and chunked-continuation paths (one prompt per row): the LM head runs
+    only on row b's token ``last_pos[b]`` and logits come back [B, 1, V].
+    At a real-model vocab (Llama-3: 128,256) the full [B, S, V] head is
+    ~2 TFLOPs + a ~1 GB f32 buffer per [1, 4096] prefill, all discarded
+    but the last row (VERDICT r2 weak #2).
 
     RING prefill (``use_ring`` + ``mesh``): serving-side context
     parallelism (SURVEY.md §5.7 tier b) — fresh-prefill attention runs as
@@ -498,6 +506,10 @@ def forward_paged(
     if packed_last_idx is not None:
         # LM head only where tokens are sampled: [B, S, D] -> [B, N, D]
         x = x[:, packed_last_idx]
+    elif last_pos is not None:
+        # per-row gather: [B, S, D] -> [B, 1, D]
+        x = jnp.take_along_axis(
+            x, jnp.clip(last_pos, 0, s - 1)[:, None, None], axis=1)
     x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
