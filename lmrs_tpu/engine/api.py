@@ -84,7 +84,7 @@ class Engine(Protocol):
     """
 
     def generate_batch(self, requests: list[GenerationRequest],
-                       on_result=None) -> list[GenerationResult]:
+                       on_result=None, on_tokens=None) -> list[GenerationResult]:
         """Generate all requests (plus any the callback submits).
 
         ``on_result(result, submit)``, when given, fires once per completed
@@ -94,6 +94,11 @@ class Engine(Protocol):
         submissions (``drain_with_callback``) — same results, no overlap.
         The returned list covers initial + submitted requests, in
         submission order.  request_ids must be unique per call.
+
+        ``on_tokens(request_id, text_delta)``, when given, fires as text
+        becomes available mid-generation (SSE streaming): per decode block
+        on the continuous scheduler, one whole-text delta elsewhere.  The
+        deltas' concatenation equals the final result's ``text``.
         """
         ...
 

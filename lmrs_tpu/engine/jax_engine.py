@@ -120,8 +120,11 @@ class JaxEngine:
     # -------------------------------------------------------------- plumbing
 
     def _default_tokenizer(self):
-        # Model-vocab authority (SURVEY.md §7.4 item 4). Byte tokenizer covers
-        # random-init models; real checkpoints name their tokenizer.
+        # Model-vocab authority (SURVEY.md §7.4 item 4).  An explicit
+        # engine_cfg.tokenizer spec wins (CLI --tokenizer / real-checkpoint
+        # vocabularies); byte tokenizer covers random-init models.
+        if self.cfg.tokenizer:
+            return get_tokenizer(self.cfg.tokenizer)
         return ByteTokenizer() if self.model_cfg.vocab_size < 100000 else get_tokenizer("approx")
 
     def _place(self, params):
@@ -143,11 +146,23 @@ class JaxEngine:
     # -------------------------------------------------------------- generate
 
     def generate_batch(self, requests: list[GenerationRequest],
-                       on_result=None) -> list[GenerationResult]:
+                       on_result=None, on_tokens=None) -> list[GenerationResult]:
         if not requests:
             return []
         if self._scheduler is not None:
-            return self._scheduler.run(requests, on_result=on_result)
+            return self._scheduler.run(requests, on_result=on_result,
+                                       on_tokens=on_tokens)
+        if on_tokens is not None:
+            # static scheduler decodes whole completions per wave: emulate
+            # streaming with one delta per finished request (single-chunk
+            # SSE semantics; the continuous scheduler streams real blocks)
+            inner = on_result
+
+            def on_result(res, submit, _inner=inner):  # noqa: F811
+                if res.text:
+                    on_tokens(res.request_id, res.text)
+                if _inner is not None:
+                    _inner(res, submit)
         if on_result is not None:
             # static scheduler has no mid-run hook: run wave-by-wave,
             # deliver post-hoc, and loop on whatever the callbacks submit

@@ -36,13 +36,20 @@ class MockEngine:
         self._tok = ApproxTokenizer()
 
     def generate_batch(self, requests: list[GenerationRequest],
-                       on_result=None) -> list[GenerationResult]:
+                       on_result=None, on_tokens=None) -> list[GenerationResult]:
+        def one(req: GenerationRequest) -> GenerationResult:
+            res = self._one(req)
+            if on_tokens is not None and res.text:
+                # no incremental decode in the mock: one delta per result
+                on_tokens(res.request_id, res.text)
+            return res
+
         if on_result is not None:
             from lmrs_tpu.engine.api import drain_with_callback
 
             return drain_with_callback(
-                lambda reqs: [self._one(r) for r in reqs], requests, on_result)
-        return [self._one(r) for r in requests]
+                lambda reqs: [one(r) for r in reqs], requests, on_result)
+        return [one(r) for r in requests]
 
     def shutdown(self) -> None:
         pass

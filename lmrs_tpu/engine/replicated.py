@@ -134,16 +134,22 @@ class ReplicatedEngine:
                     self.replicas[ri].generate_batch, [probe])
 
     def generate_batch(self, requests: list[GenerationRequest],
-                       on_result=None) -> list[GenerationResult]:
+                       on_result=None, on_tokens=None) -> list[GenerationResult]:
+        # on_tokens fans in from every replica's worker thread CONCURRENTLY —
+        # callers must pass a thread-safe callback (the HTTP server's
+        # per-job queues are; a bare list append is not)
         if on_result is not None:
             # replicas have no cross-replica mid-run hook: deliver per wave
             # and loop on callback submissions (engine/api.py)
             from lmrs_tpu.engine.api import drain_with_callback
 
-            return drain_with_callback(self._generate_wave, requests, on_result)
-        return self._generate_wave(requests)
+            return drain_with_callback(
+                lambda reqs: self._generate_wave(reqs, on_tokens=on_tokens),
+                requests, on_result)
+        return self._generate_wave(requests, on_tokens=on_tokens)
 
-    def _generate_wave(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
+    def _generate_wave(self, requests: list[GenerationRequest],
+                       on_tokens=None) -> list[GenerationResult]:
         # route over healthy replicas only; if every replica is marked dead,
         # optimistically try them all again (a transient fault should not
         # permanently brick the fleet)
@@ -159,7 +165,8 @@ class ReplicatedEngine:
             shards[i % len(targets)].append((i, req))
 
         def run(replica, shard):
-            return replica.generate_batch([req for _, req in shard])
+            return replica.generate_batch([req for _, req in shard],
+                                          on_tokens=on_tokens)
 
         futures = [
             (ri, shard, self._pools[ri].submit(run, self.replicas[ri], shard))

@@ -15,6 +15,12 @@ hands the whole wave to ``Engine.generate_batch`` — a reference-style client
 fanning out N requests under its semaphore gets them pooled into one engine
 wave instead of N serialized ones (continuous batching across HTTP clients).
 
+Both endpoints support ``stream: true`` (SSE) in their own wire dialect —
+chat.completion.chunk deltas / Anthropic message_start→message_stop events —
+driven by the engine's ``on_tokens`` callback: the continuous scheduler
+emits one delta per decode block, so streamed and pooled requests share the
+same batch slots (a streaming request never gets a private engine).
+
 stdlib only (``http.server``): the serving runtime must not pull in an async
 web framework this image doesn't have.
 """
@@ -35,12 +41,17 @@ logger = logging.getLogger("lmrs.serving")
 
 
 class _Job:
-    __slots__ = ("request", "result", "event")
+    __slots__ = ("request", "result", "event", "deltas")
 
-    def __init__(self, request: GenerationRequest):
+    def __init__(self, request: GenerationRequest, stream: bool = False):
         self.request = request
         self.result: GenerationResult | None = None
         self.event = threading.Event()
+        # streaming jobs carry a per-job delta queue: the dispatcher routes
+        # engine on_tokens callbacks here; a None sentinel (pushed AFTER
+        # ``result`` is set) ends the stream
+        self.deltas: queue.Queue[str | None] | None = (
+            queue.Queue() if stream else None)
 
 
 class _Batcher:
@@ -73,6 +84,21 @@ class _Batcher:
         job.event.wait()
         assert job.result is not None
         return job.result
+
+    def submit_stream(self, request: GenerationRequest) -> _Job:
+        """Enqueue WITHOUT blocking; the caller reads ``job.deltas`` until
+        the None sentinel, then ``job.result`` is set (SSE handlers)."""
+        job = _Job(request, stream=True)
+        with self._close_lock:
+            if self.closed:
+                job.result = GenerationResult(
+                    request_id=0, finish_reason="error",
+                    error="server shutting down")
+                job.event.set()
+                job.deltas.put(None)
+                return job
+            self.queue.put(job)
+        return job
 
     def shutdown(self) -> None:
         with self._close_lock:
@@ -118,12 +144,28 @@ class _Batcher:
             job.result = GenerationResult(
                 request_id=0, finish_reason="error", error="server shutting down")
             job.event.set()
+            if job.deltas is not None:
+                job.deltas.put(None)
 
     def _run(self, jobs: list[_Job]) -> None:
         for i, job in enumerate(jobs):  # engine results map back by id
             job.request.request_id = i
+        # route engine token deltas to their job's stream queue (rids are
+        # the wave indices assigned above); queue.put is thread-safe, which
+        # the replicated engine's concurrent fan-in requires
+        stream_jobs = {i: j for i, j in enumerate(jobs) if j.deltas is not None}
+        on_tokens = None
+        if stream_jobs:
+            def on_tokens(rid: int, delta: str) -> None:
+                j = stream_jobs.get(rid)
+                if j is not None:
+                    j.deltas.put(delta)
         try:
-            results = self.engine.generate_batch([j.request for j in jobs])
+            # kwarg only when streaming: engines predating on_tokens keep
+            # working for non-streamed waves
+            kw = {"on_tokens": on_tokens} if on_tokens is not None else {}
+            results = self.engine.generate_batch(
+                [j.request for j in jobs], **kw)
         except Exception as e:  # degrade, never kill the dispatcher
             logger.exception("engine batch failure")
             results = [
@@ -138,6 +180,8 @@ class _Batcher:
                 i, GenerationResult(request_id=i, finish_reason="error",
                                     error="engine returned no result"))
             job.event.set()
+            if job.deltas is not None:  # sentinel strictly after result
+                job.deltas.put(None)
 
 
 def _clamp_max_tokens(value, cap: int) -> int:
@@ -266,28 +310,21 @@ class EngineHTTPServer:
                 if body is None:
                     self._send(400, {"error": {"message": "invalid JSON body"}})
                     return
-                # SSE is not implemented; a streaming client would fail to
-                # parse a plain JSON body, so reject loudly (in each wire
-                # format's own error envelope) instead of silently ignoring
-                stream_msg = ("streaming is not supported by this server; "
-                              "retry with stream=false")
                 try:
                     if self.path == "/v1/chat/completions":
-                        if body.get("stream"):
-                            self._send(400, {"error": {
-                                "message": stream_msg,
-                                "type": "invalid_request_error"}})
-                            return
                         req = _chat_to_request(body, outer.max_tokens_cap)
+                        if body.get("stream"):
+                            self._stream_openai(
+                                body, outer.batcher.submit_stream(req))
+                            return
                         res = outer.batcher.submit(req)
                         self._respond_openai(body, res)
                     elif self.path == "/v1/messages":
-                        if body.get("stream"):
-                            self._send(400, {"type": "error", "error": {
-                                "type": "invalid_request_error",
-                                "message": stream_msg}})
-                            return
                         req = _messages_to_request(body, outer.max_tokens_cap)
+                        if body.get("stream"):
+                            self._stream_anthropic(
+                                body, outer.batcher.submit_stream(req))
+                            return
                         res = outer.batcher.submit(req)
                         self._respond_anthropic(body, res)
                     else:
@@ -295,6 +332,123 @@ class EngineHTTPServer:
                 except Exception as e:
                     logger.exception("request handling failed")
                     self._send(500, {"error": {"message": str(e)}})
+
+            # ------------------------------------------------ SSE streaming
+
+            def _sse_headers(self) -> None:
+                # no Content-Length: the connection closes to end the body
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+
+            def _sse(self, data: str, event: str | None = None) -> None:
+                frame = (f"event: {event}\n" if event else "") + f"data: {data}\n\n"
+                self.wfile.write(frame.encode())
+                self.wfile.flush()
+
+            def _drain(self, job: _Job):
+                """Yield deltas until the dispatcher's sentinel; afterwards
+                ``job.result`` is guaranteed set."""
+                while True:
+                    d = job.deltas.get()
+                    if d is None:
+                        return
+                    yield d
+
+            def _stream_openai(self, body: dict, job: _Job) -> None:
+                """OpenAI chat.completion.chunk SSE (llm_executor.py:292's
+                API, streaming form): role chunk, content deltas, finish
+                chunk (+usage with stream_options.include_usage), [DONE]."""
+                cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+                created = int(time.time())
+                model = body.get("model") or outer.model_name
+
+                def chunk(delta: dict, finish=None, usage=None) -> None:
+                    payload = {
+                        "id": cid, "object": "chat.completion.chunk",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0, "delta": delta,
+                                     "finish_reason": finish}],
+                    }
+                    if usage is not None:
+                        payload["usage"] = usage
+                    self._sse(json.dumps(payload))
+
+                self._sse_headers()
+                try:
+                    chunk({"role": "assistant", "content": ""})
+                    for delta in self._drain(job):
+                        chunk({"content": delta})
+                    res = job.result
+                    if res.error is not None:
+                        self._sse(json.dumps({"error": {
+                            "message": res.error, "type": "engine_error"}}))
+                    else:
+                        want_usage = (body.get("stream_options") or {}).get(
+                            "include_usage")
+                        chunk({}, finish=res.finish_reason,
+                              usage={"prompt_tokens": res.prompt_tokens,
+                                     "completion_tokens": res.completion_tokens,
+                                     "total_tokens": res.total_tokens}
+                              if want_usage else None)
+                    self._sse("[DONE]")
+                except OSError:  # client went away: stop writing, don't 500
+                    logger.debug("stream client disconnected")
+
+            def _stream_anthropic(self, body: dict, job: _Job) -> None:
+                """Anthropic messages SSE (llm_executor.py:378's API,
+                streaming form): message_start, one text content block of
+                deltas, message_delta with stop_reason/usage, message_stop."""
+                mid = f"msg_{uuid.uuid4().hex[:24]}"
+                model = body.get("model") or outer.model_name
+                self._sse_headers()
+                try:
+                    self._sse(json.dumps({
+                        "type": "message_start",
+                        "message": {
+                            "id": mid, "type": "message", "role": "assistant",
+                            "model": model, "content": [],
+                            "stop_reason": None, "stop_sequence": None,
+                            # input_tokens unknown until the engine encodes:
+                            # corrected in the closing message_delta usage
+                            "usage": {"input_tokens": 0, "output_tokens": 0},
+                        }}), event="message_start")
+                    self._sse(json.dumps({
+                        "type": "content_block_start", "index": 0,
+                        "content_block": {"type": "text", "text": ""}}),
+                        event="content_block_start")
+                    for delta in self._drain(job):
+                        self._sse(json.dumps({
+                            "type": "content_block_delta", "index": 0,
+                            "delta": {"type": "text_delta", "text": delta}}),
+                            event="content_block_delta")
+                    res = job.result
+                    if res.error is not None:
+                        self._sse(json.dumps({
+                            "type": "error",
+                            "error": {"type": "api_error",
+                                      "message": res.error}}), event="error")
+                        return
+                    self._sse(json.dumps({
+                        "type": "content_block_stop", "index": 0}),
+                        event="content_block_stop")
+                    self._sse(json.dumps({
+                        "type": "message_delta",
+                        "delta": {"stop_reason": (
+                            "stop_sequence" if res.stop_sequence is not None
+                            else "end_turn" if res.finish_reason == "stop"
+                            else "max_tokens"),
+                            "stop_sequence": res.stop_sequence},
+                        "usage": {"input_tokens": res.prompt_tokens,
+                                  "output_tokens": res.completion_tokens}}),
+                        event="message_delta")
+                    self._sse(json.dumps({"type": "message_stop"}),
+                              event="message_stop")
+                except OSError:
+                    logger.debug("stream client disconnected")
 
             def _respond_openai(self, body: dict, res: GenerationResult) -> None:
                 if res.error is not None:

@@ -27,9 +27,9 @@ class CountingEngine:
         self.inner = MockEngine()
         self.batch_sizes: list[int] = []
 
-    def generate_batch(self, requests):
+    def generate_batch(self, requests, on_tokens=None):
         self.batch_sizes.append(len(requests))
-        return self.inner.generate_batch(requests)
+        return self.inner.generate_batch(requests, on_tokens=on_tokens)
 
     def shutdown(self):
         pass
@@ -180,19 +180,77 @@ def test_anthropic_system_content_blocks(server):
     assert status == 200 and out["content"][0]["text"]
 
 
-def test_stream_true_rejected_with_400(server):
-    for path in ("/v1/chat/completions", "/v1/messages"):
-        req = urllib.request.Request(
-            f"http://{server.host}:{server.port}{path}",
-            data=json.dumps({
-                "messages": [{"role": "user", "content": "hi"}],
-                "stream": True,
-            }).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with pytest.raises(urllib.error.HTTPError) as e:
-            urllib.request.urlopen(req, timeout=10)
-        assert e.value.code == 400
+def _post_sse(server, path: str, body: dict, timeout: float = 30.0):
+    """POST with stream:true and parse the SSE body into
+    [(event_or_None, parsed_data)] frames."""
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        raw = resp.read().decode()
+    frames = []
+    event = None
+    for line in raw.splitlines():
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            data = line[len("data: "):]
+            frames.append((event, data if data == "[DONE]"
+                           else json.loads(data)))
+            event = None
+    return frames
+
+
+def test_openai_streaming(server):
+    """stream:true must produce parseable chat.completion.chunk SSE whose
+    concatenated deltas equal the non-streamed completion (the streaming
+    form of the API at llm_executor.py:292)."""
+    body = {
+        "messages": [{"role": "user", "content": "Summarize: hiring sync."}],
+        "max_tokens": 64,
+        "stream_options": {"include_usage": True},
+    }
+    _, plain = _post(server, "/v1/chat/completions", body)
+    frames = _post_sse(server, "/v1/chat/completions",
+                       {**body, "stream": True})
+    assert frames[-1][1] == "[DONE]"
+    chunks = [d for _, d in frames[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks)
+    assert text == plain["choices"][0]["message"]["content"]
+    final = chunks[-1]
+    assert final["choices"][0]["finish_reason"] in ("stop", "length")
+    assert final["usage"]["total_tokens"] > 0  # stream_options.include_usage
+
+
+def test_anthropic_streaming(server):
+    """stream:true on /v1/messages must emit the Anthropic event sequence
+    (message_start .. message_stop) with text_delta frames that concatenate
+    to the non-streamed text."""
+    body = {
+        "messages": [{"role": "user", "content": "Summarize: budget review."}],
+        "max_tokens": 64,
+    }
+    _, plain = _post(server, "/v1/messages", body)
+    frames = _post_sse(server, "/v1/messages", {**body, "stream": True})
+    events = [e for e, _ in frames]
+    assert events[0] == "message_start"
+    assert events[1] == "content_block_start"
+    assert events[-2] == "message_delta"
+    assert events[-1] == "message_stop"
+    deltas = [d for e, d in frames if e == "content_block_delta"]
+    assert deltas, "no text deltas streamed"
+    text = "".join(d["delta"]["text"] for d in deltas)
+    assert text == plain["content"][0]["text"]
+    mdelta = [d for e, d in frames if e == "message_delta"][0]
+    assert mdelta["delta"]["stop_reason"] in ("end_turn", "max_tokens")
+    assert mdelta["usage"]["output_tokens"] > 0
 
 
 def test_anthropic_stop_sequence_reason(server):
