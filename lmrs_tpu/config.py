@@ -155,6 +155,11 @@ class EngineConfig:
     speculate_k: int = 0
     checkpoint_path: str | None = None
     quantize: str | None = None  # None | "int8" (weight-only; ops/quant.py)
+    # engine-side tokenizer spec ("" = model default: byte for random-init
+    # vocabs, the checkpoint's tokenizer for real ones).  Accepts the same
+    # forms as data.tokenizer.get_tokenizer: "byte", a *.model SentencePiece
+    # path, or an HF tokenizer directory/repo id (local_files_only).
+    tokenizer: str = ""
 
     def __post_init__(self) -> None:
         # Reference DEFAULT_PROVIDER values name HTTP vendors; both map to

@@ -1,0 +1,170 @@
+"""Real-tokenizer path (VERDICT r2 item 6): the SentencePiece/HF adapters
+must not be dead code gated on assets this zero-egress image lacks.
+
+``transformers`` + ``tokenizers`` ARE in the image, so a real BPE tokenizer
+is TRAINED in-tree at test time on the synthetic corpus, saved in HF format,
+and driven through the full stack: ``get_tokenizer`` resolution → chunk
+budgeting → CLI config → the continuous-batching engine (encode and decode
+through a non-byte vocabulary).  ``SentencePieceTokenizer`` keeps its gated
+import (no ``sentencepiece`` wheel here) — its adapter shape is identical
+and the resolution branch is covered below.
+
+Reference counterpart: the vendor tokenizer behind llm_executor.py:250-326
+(tiktoken cl100k_base as count authority, big_chunkeroosky.py:27).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+pytest.importorskip("tokenizers")
+pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_tok_dir(tmp_path_factory):
+    """Train a tiny BPE tokenizer on the synthetic transcript corpus and
+    save it in HF (PreTrainedTokenizerFast) format."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    from tests.conftest import make_segments
+
+    corpus = [s["text"] for s in make_segments(400, seed=7)]
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400,
+        special_tokens=["<pad>", "<s>", "</s>", "<unk>"])
+    tok.train_from_iterator(corpus, trainer)
+
+    d = tmp_path_factory.mktemp("hf_tok")
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "<s>", "eos_token": "</s>",
+        "pad_token": "<pad>", "unk_token": "<unk>",
+    }))
+    return str(d)
+
+
+def test_get_tokenizer_resolves_hf_dir(hf_tok_dir):
+    from lmrs_tpu.data.tokenizer import HFTokenizer, get_tokenizer
+
+    tok = get_tokenizer(hf_tok_dir)
+    assert isinstance(tok, HFTokenizer)
+    assert 0 < tok.vocab_size <= 512
+    ids = tok.encode("the project timeline depends on shipping")
+    assert ids and all(0 <= i < tok.vocab_size for i in ids)
+    assert tok.count("the project timeline") == len(tok.encode("the project timeline"))
+    # decode inverts encode up to whitespace normalization
+    assert "project" in tok.decode(ids)
+
+
+def test_get_tokenizer_sentencepiece_branch_is_gated():
+    """*.model resolves to the SentencePiece adapter; without the wheel the
+    gated import raises ImportError (not a silent fallback)."""
+    from lmrs_tpu.data.tokenizer import get_tokenizer
+
+    try:
+        import sentencepiece  # noqa: F401
+        pytest.skip("sentencepiece present: gate untestable")
+    except ImportError:
+        pass
+    with pytest.raises((ImportError, OSError)):
+        get_tokenizer("/nonexistent/vocab.model")
+
+
+def test_chunk_budgets_in_hf_tokens(hf_tok_dir):
+    """Chunk budgets measured by the REAL tokenizer (SURVEY §7.4 item 4),
+    not the 4-chars/token approximation."""
+    from lmrs_tpu.data.chunker import TranscriptChunker
+    from lmrs_tpu.data.tokenizer import get_tokenizer
+
+    from tests.conftest import make_segments
+
+    tok = get_tokenizer(hf_tok_dir)
+    chunker = TranscriptChunker(max_tokens_per_chunk=120, overlap_tokens=0,
+                                context_tokens=20, tokenizer=tok)
+    chunks = chunker.chunk_transcript(make_segments(120, seed=3))
+    assert len(chunks) > 1
+    for c in chunks:
+        # same contract as test_chunker.test_budget_respected: packed
+        # segment text measured in the REAL tokenizer fits the budget
+        packed = sum(tok.count(s["text"]) for s in c.segments)
+        assert packed <= chunker.effective_max_tokens
+
+
+def test_engine_generates_through_hf_tokenizer(hf_tok_dir):
+    """CLI config → engine: --tokenizer names the serving tokenizer, the
+    engine encodes prompts and decodes completions through the trained BPE
+    vocabulary (vocab_size must cover it)."""
+    from lmrs_tpu.config import EngineConfig, ModelConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                     dtype="float32")
+    eng = JaxEngine(
+        EngineConfig(backend="jax", scheduler="continuous", max_tokens=16,
+                     max_batch_slots=2, seed=0, decode_block=8,
+                     tokenizer=hf_tok_dir),
+        mc)
+    assert type(eng.tokenizer).__name__ == "HFTokenizer"
+    out = eng.generate_batch([
+        GenerationRequest(prompt="the project timeline depends on shipping",
+                          request_id=0, temperature=0.8, max_new_tokens=16)])
+    assert out[0].error is None
+    assert out[0].prompt_tokens > 0
+    # the completion decodes through the BPE vocab: pieces are corpus words/
+    # subwords, not raw bytes
+    assert isinstance(out[0].text, str)
+    eng.shutdown()
+
+
+def test_cli_tokenizer_flag_flows_to_engine_and_chunker(hf_tok_dir):
+    """--tokenizer <hf dir> must reach BOTH the chunker (count authority)
+    and the jax engine (serving vocabulary) through config_from_args."""
+    import argparse
+
+    from lmrs_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args([
+        "--input", "unused.json", "--backend", "jax",
+        "--tokenizer", hf_tok_dir])
+    assert isinstance(args, argparse.Namespace)
+    cfg = config_from_args(args)
+    assert cfg.chunk.tokenizer == hf_tok_dir
+    assert cfg.engine.tokenizer == hf_tok_dir
+
+
+def test_pipeline_end_to_end_with_hf_tokenizer(hf_tok_dir):
+    """Full map-reduce through the jax engine with the HF tokenizer as the
+    single token authority: CLI-shaped config → chunker budgets → engine
+    encode/decode → reduce."""
+    from lmrs_tpu.config import (
+        ChunkConfig, EngineConfig, ModelConfig, PipelineConfig, ReduceConfig,
+    )
+    from lmrs_tpu.pipeline import TranscriptSummarizer
+
+    from tests.conftest import make_segments
+
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                     dtype="float32")
+    cfg = PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=200, overlap_tokens=0,
+                          context_tokens=30, tokenizer=hf_tok_dir),
+        engine=EngineConfig(backend="jax", scheduler="continuous",
+                            max_tokens=24, max_batch_slots=2, seed=0,
+                            decode_block=8, tokenizer=hf_tok_dir),
+        model=mc,
+        reduce=ReduceConfig(max_tokens_per_batch=400),
+    )
+    s = TranscriptSummarizer(cfg)
+    stats = s.summarize({"segments": make_segments(60, seed=11)})
+    assert stats["num_chunks"] >= 1
+    assert stats["failed_requests"] == 0
+    assert isinstance(stats["summary"], str)
