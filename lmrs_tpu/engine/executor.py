@@ -70,12 +70,23 @@ class MapExecutor:
         """Summarize every chunk of every group through ONE pooled request
         queue (multi-transcript batching: the engine's batch slots fill from
         all transcripts at once instead of draining per transcript).
-        Summaries are written onto the chunks in place."""
+        Summaries are written onto the chunks in place.
+
+        Groups interleave ROUND-ROBIN into the queue (VERDICT r2 item 9):
+        admission is FIFO, so appending whole groups in order would make
+        transcript N's first chunk wait behind every chunk of transcripts
+        0..N-1 — the pooled-queue design exists to overlap transcripts, and
+        per-transcript completion skew should reflect size, not submission
+        order."""
         t0 = time.time()
         requests = []
         flat: list[Chunk] = []
-        for chunks in groups:
-            for chunk in chunks:
+        queues = [list(chunks) for chunks in groups]
+        while any(queues):
+            for g in queues:
+                if not g:
+                    continue
+                chunk = g.pop(0)
                 requests.append(self.build_map_request(
                     chunk, prompt_template, summary_type, system_prompt,
                     request_id=len(flat)))  # pool-unique, not chunk_index
