@@ -1239,13 +1239,27 @@ class ContinuousScheduler:
         w, table = self._decode_window(slots,
                                        self.decode_block + self.spec_k)
         self._key, sub = jax.random.split(self._key)
-        fn = self._get_spec_decode_fn(w)
-        toks, counts, self._spec_buf, self.cache.k, self.cache.v = fn(
+        args = (
             self.params, self.cache.k, self.cache.v, self._spec_buf,
             jnp.asarray(last_tok), jnp.asarray(kv_lens),
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
+        try:
+            out = self._get_spec_decode_fn(w)(*args)
+        except Exception:
+            # same contract as the plain decode fallback: degrade only on a
+            # first-run lowering failure of the multi-verify kernel (args
+            # not yet donated); a failure on a proven shape re-raises
+            if not self._use_ragged or ("specfn", w) in self._ran_ok:
+                raise
+            logger.warning("multi-verify kernel failed to lower; "
+                           "falling back to XLA multi decode", exc_info=True)
+            self._use_ragged = False
+            self._decode_fns.clear()  # spec fns cache here too
+            out = self._get_spec_decode_fn(w)(*args)
+        self._ran_ok.add(("specfn", w))
+        toks, counts, self._spec_buf, self.cache.k, self.cache.v = out
         toks, counts = jax.device_get((toks, counts))  # one transfer
         emitted: list[list[int]] = []
         for b in range(self.B):
@@ -1267,6 +1281,13 @@ class ContinuousScheduler:
         eos_id = self.tokenizer.eos_id
         max_len = self.max_len
         rope_max = self.max_len
+        # ragged multi-token verify: same gate as the decode kernel (the
+        # multi kernel is its generalization); under a real multi-device
+        # mesh the XLA multi path serves (one window gather — still not
+        # window_prefill).  _kernel_mesh(), not self.mesh: a 1-device mesh
+        # is single-device everywhere else too.
+        use_ragged = self._use_ragged and self._kernel_mesh() is None
+        interp = self._interpret
 
         from lmrs_tpu.ops.sampling import filtered_probs
         from lmrs_tpu.ops.speculative import draft_lookup, verify_tokens
@@ -1285,10 +1306,16 @@ class ContinuousScheduler:
 
                 toks_in = jnp.concatenate([tok[:, None], draft], axis=1)
                 positions = jnp.minimum(lens[:, None] + offs, max_len - 1)
+                # kv_lens UNCLAMPED: the multi path derives the write base
+                # as kv_lens - (k+1), which must be the true position even
+                # when drafts overhang max_len (the max_pos cap masks the
+                # overhang; a clamped length would slide the write span
+                # backwards over real cache entries)
                 logits, k_pages, v_pages = forward_paged(
                     params, cfg, toks_in, positions, k_pages, v_pages, table,
-                    jnp.minimum(lens + 1 + k, max_len), rope_max,
-                    use_ragged_kernel=False, window_prefill=True,
+                    lens + 1 + k, rope_max,
+                    use_ragged_kernel=use_ragged, multi_decode=True,
+                    interpret=interp,
                 )
                 probs = jax.vmap(filtered_probs, in_axes=(1, None, None, None),
                                  out_axes=1)(logits, temps, tk, tp)

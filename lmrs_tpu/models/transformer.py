@@ -324,6 +324,7 @@ def forward_paged(
     packed_last_idx: jnp.ndarray | None = None,  # [N] last-token row indices
     use_ring: bool = False,  # sp-mesh fresh prefill: ring attention over sp
     last_pos: jnp.ndarray | None = None,  # [B] per-row last-token index
+    multi_decode: bool = False,  # speculative verify: S tokens, ragged walk
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -368,7 +369,9 @@ def forward_paged(
     """
     from lmrs_tpu.ops.paged_attention import (
         paged_decode_fused_sharded,
+        paged_decode_multi_xla,
         paged_decode_pallas_fused,
+        paged_decode_pallas_multi,
         paged_decode_xla,
     )
 
@@ -409,6 +412,25 @@ def forward_paged(
         q, k, v = qkv_proj(lp, cfg, h)
         q = apply_rope(q, positions, sin, cos)
         k = apply_rope(k, positions, sin, cos)
+
+        if multi_decode:
+            # speculative verify: the S tokens sit at consecutive positions
+            # kv_lens - S + j; K/V write and the per-token-causal attention
+            # run in ONE ragged page walk (kernel) or one window gather
+            # (XLA fallback) — never the full window_prefill gather per
+            # layer that made round-2 speculation a 12x loss.  Write slots
+            # derive from kv_lens, which callers pass UNCLAMPED (base must
+            # be the true position); tokens overhanging rope_max are
+            # neither written nor attended (max_pos cap).
+            if use_ragged_kernel:
+                attn, kp_all, vp_all = paged_decode_pallas_multi(
+                    q, k, v, kp_all, vp_all, g_tables, kv_lens,
+                    interpret=interpret, max_pos=rope_max)
+            else:
+                attn, kp_all, vp_all = paged_decode_multi_xla(
+                    q, k, v, kp_all, vp_all, g_tables, kv_lens,
+                    max_pos=rope_max)
+            return _finish_layer(lp, x, attn, kp_all, vp_all)
 
         if is_decode and use_ragged_kernel:
             # write-fused ragged kernel: the current token's K/V lands in

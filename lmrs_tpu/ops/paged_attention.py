@@ -75,29 +75,38 @@ def _ragged_decode_all_heads(
     page_tables_ref,  # SMEM [B, W]
     kv_lens_ref,      # SMEM [B]
     # inputs
-    q_ref,            # VMEM [kh, n_rep_p, hd] (this batch row, all kv heads)
+    q_ref,            # VMEM [kh, n_tokens*n_rep_p, hd] (this row, all heads)
     k_hbm,            # ANY  [K, P, ps, hd] (full page pool)
     v_hbm,            # ANY  [K, P, ps, hd]
     # output
-    o_ref,            # VMEM [kh, n_rep_p, hd]
+    o_ref,            # VMEM [kh, n_tokens*n_rep_p, hd]
     # scratch
     k_scr,            # VMEM [2, ps, hd] double-buffered
     v_scr,            # VMEM [2, ps, hd]
-    acc_scr,          # VMEM [n_rep_p, hd] f32 (current head)
-    m_scr,            # VMEM [n_rep_p, 128] f32
-    l_scr,            # VMEM [n_rep_p, 128] f32
+    acc_scr,          # VMEM [n_tokens*n_rep_p, hd] f32 (current head)
+    m_scr,            # VMEM [n_tokens*n_rep_p, 128] f32
+    l_scr,            # VMEM [n_tokens*n_rep_p, 128] f32
     sem,              # DMA semaphores (2, 2): [buffer parity, k/v]
     *,
     page_size: int,
     sm_scale: float,
     kh: int,
+    n_rep_p: int = 0,   # rows per token (0 = single-token: all rows one group)
+    n_tokens: int = 1,  # queries per row (speculative verify: k+1)
+    max_pos: int | None = None,  # static cap: no position >= this is valid
 ):
     """Walk every kv head's live pages for ONE batch row through a single
     double-buffered DMA pipeline.  The head loop is a static Python unroll
     (kh is a shape), so all VMEM indexing is static — only the page DMAs
     carry dynamic indices — and the page prefetched at the end of head
     ``ki`` is head ``ki+1``'s first page: the pipeline never drains at a
-    head boundary, which is the entire point of the fold."""
+    head boundary, which is the entire point of the fold.
+
+    With ``n_tokens > 1`` (ragged speculative verify) the q rows group as
+    [token j][query head group]: token j sits at absolute position
+    ``length - n_tokens + j`` and its rows attend positions < that + 1 —
+    per-row causal limits over the SAME single page walk, so verifying
+    k drafts costs one walk, not a full page-window gather."""
     b = pl.program_id(0)
     length = kv_lens_ref[b]
     # clamp to the table width: a row whose length exceeds its table (e.g.
@@ -151,11 +160,23 @@ def _ragged_decode_all_heads(
             k = k_scr[slot].astype(jnp.float32)  # [ps, hd]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            ) * sm_scale  # [n_rep_p, ps]
+            ) * sm_scale  # [rows, ps]
             pos = p * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, (q.shape[0], page_size), 1
             )
-            s = jnp.where(pos < length, s, NEG_INF)
+            if n_tokens == 1:
+                limit = length  # every row is the newest token
+            else:
+                # row r belongs to token j = r // n_rep_p at absolute
+                # position length - n_tokens + j: strict per-row causality
+                j = jax.lax.broadcasted_iota(
+                    jnp.int32, (q.shape[0], page_size), 0) // n_rep_p
+                limit = length - n_tokens + j + 1
+                if max_pos is not None:
+                    # positions >= max_pos were never written (write cap
+                    # below): a query past the cap sees the real prefix only
+                    limit = jnp.minimum(limit, max_pos)
+            s = jnp.where(pos < limit, s, NEG_INF)
 
             m_prev = m_scr[:, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -239,6 +260,264 @@ def _write_new_token_all_heads(
     for wk, wv in writes:
         wk.wait()
         wv.wait()
+
+
+def _write_new_tokens_all_heads(
+    page_tables_ref, kv_lens_ref,
+    knew_ref,         # VMEM [kh, t_pad, hd] the T new tokens' K (rows 0..T-1)
+    vnew_ref,
+    k_out,            # ANY  [K, P, ps, hd] aliased pool
+    v_out,
+    k8_scr,           # VMEM [kh, n_win, 8, hd]
+    v8_scr,
+    wsem,             # DMA semaphores (kh * n_win, 2)
+    *,
+    page_size: int,
+    kh: int,
+    n_tokens: int,
+    max_pos: int | None = None,
+):
+    """Scatter T consecutive new tokens' K/V (speculative verify: the
+    carried token + k drafts at positions length-T .. length-1) into the
+    page pool in place.  The positions are consecutive, so they cover at
+    most ``n_win = (T-2)//8 + 2`` aligned 8-row windows, and page_size %
+    8 == 0 (scheduler kernel gate) means no window straddles a page —
+    each (head, window) is one read-blend-write RMW, reads all issued
+    before any blend so the tiny DMAs overlap.
+
+    ``max_pos`` (static): tokens at positions >= it are NOT written — the
+    max-seq-len cap for draft tokens that overhang the end of the cache
+    (the caller passes the UNCLAMPED length, so the base position is
+    always exact; a clamped length would slide the whole span backwards
+    over real cache entries)."""
+    b = pl.program_id(0)
+    length = kv_lens_ref[b]
+    base = jnp.maximum(length - n_tokens, 0)  # first new token's position
+    n_win = 1 if n_tokens == 1 else (n_tokens - 2) // 8 + 2
+    t_pad = knew_ref.shape[1]
+    hd = knew_ref.shape[-1]
+    win0 = jax.lax.div(base, 8) * 8  # provably 8-aligned
+
+    def win_page(wi):
+        start = win0 + 8 * wi
+        page_idx = jnp.clip(jax.lax.div(start, page_size), 0,
+                            page_tables_ref.shape[1] - 1)
+        return start, page_tables_ref[b, page_idx]
+
+    reads = []
+    for ki in range(kh):
+        for wi in range(n_win):
+            start, page = win_page(wi)
+            si = ki * n_win + wi
+            rk = pltpu.make_async_copy(
+                k_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
+                k8_scr.at[ki, wi], wsem.at[si, 0])
+            rv = pltpu.make_async_copy(
+                v_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
+                v8_scr.at[ki, wi], wsem.at[si, 1])
+            rk.start()
+            rv.start()
+            reads.append((rk, rv))
+    writes = []
+    for ki in range(kh):
+        for wi in range(n_win):
+            start, page = win_page(wi)
+            si = ki * n_win + wi
+            rk, rv = reads[si]
+            rk.wait()
+            rv.wait()
+            # row r of this window holds token j = start + r - base when
+            # 0 <= j < T; select token rows with a tiny 0/1 matmul (no
+            # dynamic VMEM indexing) and blend where a token lands
+            row = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 0)
+            tok = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 1)
+            j = start + row - base
+            valid = (j == tok) & (tok < n_tokens)
+            if max_pos is not None:
+                valid &= (start + row) < max_pos
+            sel = valid.astype(jnp.float32)
+            k_rows = jax.lax.dot_general(
+                sel, knew_ref[ki].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v_rows = jax.lax.dot_general(
+                sel, vnew_ref[ki].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            hit = (jnp.sum(sel, axis=1, keepdims=True) > 0)
+            hit = jnp.broadcast_to(hit, (8, hd))
+            k8_scr[ki, wi] = jnp.where(hit, k_rows.astype(k8_scr.dtype),
+                                       k8_scr[ki, wi])
+            v8_scr[ki, wi] = jnp.where(hit, v_rows.astype(v8_scr.dtype),
+                                       v8_scr[ki, wi])
+            wk = pltpu.make_async_copy(
+                k8_scr.at[ki, wi],
+                k_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
+                wsem.at[si, 0])
+            wv = pltpu.make_async_copy(
+                v8_scr.at[ki, wi],
+                v_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
+                wsem.at[si, 1])
+            wk.start()
+            wv.start()
+            writes.append((wk, wv))
+    for wk, wv in writes:
+        wk.wait()
+        wv.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "max_pos"))
+def paged_decode_pallas_multi(
+    q: jnp.ndarray,            # [B, T, H, hd] queries (token-major)
+    k_new: jnp.ndarray,        # [B, T, K, hd] the T tokens' K (post-rope)
+    v_new: jnp.ndarray,        # [B, T, K, hd]
+    k_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    v_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] GLOBAL page ids
+    kv_lens: jnp.ndarray,      # [B] length INCLUDING all T tokens (UNclamped:
+                               # may exceed max_pos near the cap; the base
+                               # position kv_lens - T must be the true one)
+    interpret: bool = False,
+    max_pos: int | None = None,  # static position cap (max_seq_len)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Ragged multi-token verify: the speculative-decoding analog of
+    ``paged_decode_pallas_fused``.  One program per batch row writes all T
+    new tokens' K/V into their pages in place and attends each token's
+    query rows to the live pages with strict per-token causality — ONE
+    ragged page walk for the whole [B, T] verify step, replacing the
+    full page-window gather that made round-2 speculation 12x slower
+    (docs/PERF.md; VERDICT r2 item 3).
+
+    Near the max-seq-len boundary the caller passes the UNclamped length
+    (base = kv_lens - T is then always the true first-token position) and
+    ``max_pos``: tokens overhanging the cap are neither written nor
+    attended — a clamped length would instead slide the whole write span
+    backwards over real cache entries."""
+    b, t, h, hd = q.shape
+    kh = k_pages.shape[0]
+    ps = k_pages.shape[2]
+    n_rep = h // kh
+    n_rep_p = -(-n_rep // 8) * 8
+    rows = t * n_rep_p
+    # [B, T, H, hd] -> [B, kh, T*n_rep_p, hd], token-major row groups
+    qg = q.reshape(b, t, kh, n_rep, hd)
+    if n_rep_p != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, n_rep_p - n_rep), (0, 0)))
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(b, kh, rows, hd)
+    t_pad = -(-t // 8) * 8
+    knew = k_new.transpose(0, 2, 1, 3)  # [B, K, T, hd]
+    vnew = v_new.transpose(0, 2, 1, 3)
+    if t_pad != t:
+        knew = jnp.pad(knew, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+        vnew = jnp.pad(vnew, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+    n_win = 1 if t == 1 else (t - 2) // 8 + 2
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, kh, rows, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, kh, t_pad, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, kh, t_pad, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, kh, rows, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, hd), k_pages.dtype),
+            pltpu.VMEM((2, ps, hd), v_pages.dtype),
+            pltpu.VMEM((rows, hd), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((kh, n_win, 8, hd), k_pages.dtype),
+            pltpu.VMEM((kh, n_win, 8, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((kh * n_win, 2)),
+        ],
+    )
+
+    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
+               o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
+               k8_scr, v8_scr, sem, wsem):
+        _write_new_tokens_all_heads(
+            pt_ref, len_ref, knew_ref.at[0], vnew_ref.at[0], k_out, v_out,
+            k8_scr, v8_scr, wsem, page_size=ps, kh=kh, n_tokens=t,
+            max_pos=max_pos,
+        )
+        _ragged_decode_all_heads(
+            pt_ref, len_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
+            k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+            page_size=ps, sm_scale=hd**-0.5, kh=kh,
+            n_rep_p=n_rep_p, n_tokens=t, max_pos=max_pos,
+        )
+
+    out, k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        input_output_aliases={5: 1, 6: 2},
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      qg, knew, vnew, k_pages, v_pages)
+    out = out.reshape(b, kh, t, n_rep_p, hd)[:, :, :, :n_rep]
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, t, h, hd), k_pages, v_pages
+
+
+def paged_decode_multi_xla(
+    q: jnp.ndarray,            # [B, T, H, hd]
+    k_new: jnp.ndarray,        # [B, T, K, hd]
+    v_new: jnp.ndarray,        # [B, T, K, hd]
+    k_pages: jnp.ndarray,      # [K, P, ps, hd]
+    v_pages: jnp.ndarray,      # [K, P, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W]
+    kv_lens: jnp.ndarray,      # [B] incl. the T tokens (unclamped; see kernel)
+    max_pos: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scatter + gather reference for the multi-token verify: same contract
+    as ``paged_decode_pallas_multi`` on any platform (correctness baseline
+    + CPU fallback for the speculative verify forward).  Tokens at
+    positions >= ``max_pos`` redirect to the reserved null page (id 0) and
+    are masked out of every query's context."""
+    b, t, h, hd = q.shape
+    kh, _, ps, _ = k_pages.shape
+    w = page_tables.shape[1]
+    base = jnp.maximum(kv_lens - t, 0)
+    pos = base[:, None] + jnp.arange(t)[None, :]  # [B, T]
+    page = jnp.take_along_axis(
+        page_tables, jnp.clip(pos // ps, 0, w - 1), axis=1)  # [B, T]
+    off = pos % ps
+    if max_pos is not None:
+        in_cap = pos < max_pos
+        page = jnp.where(in_cap, page, 0)  # overhang lands on the null page
+        off = jnp.where(in_cap, off, 0)
+    k_pages = k_pages.at[:, page, off].set(k_new.transpose(2, 0, 1, 3))
+    v_pages = v_pages.at[:, page, off].set(v_new.transpose(2, 0, 1, 3))
+
+    n_rep = h // kh
+    k_win = k_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+        b, w * ps, kh, hd)
+    v_win = v_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+        b, w * ps, kh, hd)
+    if n_rep > 1:
+        k_win = jnp.repeat(k_win, n_rep, axis=2)
+        v_win = jnp.repeat(v_win, n_rep, axis=2)
+    logits = jnp.einsum("bthd,bkhd->bthk", q, k_win).astype(jnp.float32) * hd**-0.5
+    col = jnp.arange(w * ps)[None, None, None, :]
+    mask = col <= pos[:, :, None, None]  # query t attends positions <= its own
+    if max_pos is not None:
+        mask &= col < max_pos
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bthk,bkhd->bthd", probs.astype(v_win.dtype), v_win)
+    return out, k_pages, v_pages
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

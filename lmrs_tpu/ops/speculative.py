@@ -23,12 +23,14 @@ serving-stack surface with no reference counterpart.
 Everything here is trace-friendly (static k, where-masks, no data-dependent
 shapes) so it runs inside the scheduler's on-device decode block scan.
 
-Cost note: the verify forward currently runs through forward_paged's
-windowed-attention path, which gathers the page window per layer per step —
-fine at moderate windows, but the dominant cost for very long contexts.  A
-multi-query extension of the ragged decode kernel (per-query-row position
-limits) would remove that gather; until then prefer speculation for
-short/medium-context, repetitive workloads where acceptance is high.
+Cost note: the verify forward runs through forward_paged(multi_decode=True)
+— the ragged multi-token kernel (ops/paged_attention.paged_decode_pallas_multi)
+writes all k+1 tokens' K/V and attends them with per-token causality in ONE
+page walk per layer, the multi-query extension of the decode kernel.  The
+round-2 measurement that made speculation a 12x loss (verify materialized
+the full page window per layer per step, docs/PERF.md) is specifically what
+this path removes; whether speculation WINS still depends on acceptance
+rate, so ``speculate_k`` stays opt-in until the hardware ABBA lands.
 """
 
 from __future__ import annotations

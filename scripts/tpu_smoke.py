@@ -127,6 +127,32 @@ def check_fused_ragged_decode(interpret: bool) -> float:
     return d
 
 
+def check_multi_token_verify(interpret: bool) -> float:
+    """Ragged multi-token verify (speculative decode) vs the XLA
+    scatter+gather reference, spans straddling page and RMW-window
+    boundaries."""
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla, paged_decode_pallas_multi)
+
+    b, t, h, kh, hd, ps, n_pages = 2, 5, 8, 4, 128, 128, 12
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((b, t, h, hd)), jnp.bfloat16)
+    k_new = jnp.asarray(rng.standard_normal((b, t, kh, hd)), jnp.bfloat16)
+    v_new = jnp.asarray(rng.standard_normal((b, t, kh, hd)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((kh, n_pages, ps, hd)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((kh, n_pages, ps, hd)), jnp.bfloat16)
+    tables = jnp.asarray(1 + np.arange(b * 3).reshape(b, 3), jnp.int32)
+    kv_lens = jnp.asarray([ps + 2, 131], jnp.int32)  # page + window straddles
+
+    want, k_ref, v_ref = paged_decode_multi_xla(
+        q, k_new, v_new, kp, vp, tables, kv_lens)
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, k_new, v_new, kp, vp, tables, kv_lens, interpret=interpret)
+    d = _maxdiff(got, want)
+    d = max(d, _maxdiff(k_out[:, 1:1 + b * 3], k_ref[:, 1:1 + b * 3]))
+    return max(d, _maxdiff(v_out[:, 1:1 + b * 3], v_ref[:, 1:1 + b * 3]))
+
+
 def check_int8_forward() -> float:
     """Weights-only int8 through the full forward: finite logits, and
     close to the bf16 forward within quantization error."""
@@ -177,6 +203,8 @@ def main() -> int:
         ("packed_prefill_vs_xla", lambda: check_packed_prefill(args.interpret), 0.03),
         ("fused_ragged_decode_vs_xla",
          lambda: check_fused_ragged_decode(args.interpret), 0.03),
+        ("multi_token_verify_vs_xla",
+         lambda: check_multi_token_verify(args.interpret), 0.03),
         ("int8_forward", check_int8_forward, 0.02),
     ]
     results = {}

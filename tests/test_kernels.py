@@ -208,3 +208,109 @@ def test_flash_sharded_matches_reference():
         np.testing.assert_allclose(np.asarray(got[i, :n]),
                                    np.asarray(want[i, :n]),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_multi_token_verify_matches_xla_reference():
+    """The ragged multi-token verify kernel (speculative decode: T
+    consecutive tokens written + attended with per-token causality in one
+    page walk) must match the scatter+gather XLA reference — outputs AND
+    pool contents.  Lengths chosen so the T-token span straddles a page
+    boundary and an 8-row RMW window boundary."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla,
+        paged_decode_pallas_multi,
+    )
+
+    b, t, h, kh, hd, ps, n_pages = 3, 5, 8, 4, 128, 16, 16
+    rng = jax.random.split(jax.random.PRNGKey(3), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3], [4, 5, 6], [7, 8, 9]], jnp.int32)
+    # row 0: span 13..17 straddles page 0->1; row 1: span 1..5 in-page but
+    # crosses the 8-row window at base offset 1; row 2: base offset 30
+    # straddles page AND window
+    kv_lens = jnp.asarray([18, 6, 35], jnp.int32)
+
+    want, k_ref, v_ref = paged_decode_multi_xla(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens)
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
+    np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
+
+
+def test_multi_token_verify_gqa_and_t1_degenerate():
+    """GQA head grouping through the multi kernel, plus T=1 degenerating to
+    the single-token contract (same mask, same write)."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla,
+        paged_decode_pallas_multi,
+    )
+
+    b, h, kh, hd, ps, n_pages = 2, 8, 2, 128, 16, 8
+    for t in (1, 4):
+        rng = jax.random.split(jax.random.PRNGKey(10 + t), 5)
+        k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+        v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+        q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
+        k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
+        v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
+        tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        kv_lens = jnp.asarray([t + 7, t], jnp.int32)  # row 1: fresh row
+        want, k_ref, v_ref = paged_decode_multi_xla(
+            q, k_new, v_new, k_pages, v_pages, tables, kv_lens)
+        got, k_out, v_out = paged_decode_pallas_multi(
+            q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
+        np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
+
+
+def test_multi_token_verify_max_pos_boundary():
+    """Drafts overhanging max_pos (the max-seq-len cap) must be NEITHER
+    written (earlier real cache entries stay intact — a clamped length
+    would slide the write span backwards over them) NOR attended."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla,
+        paged_decode_pallas_multi,
+    )
+
+    b, t, h, kh, hd, ps, n_pages = 2, 4, 4, 2, 128, 16, 8
+    max_pos = 32  # 2 pages of capacity
+    rng = jax.random.split(jax.random.PRNGKey(5), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    # row 0: base 30 -> tokens at 30,31 valid, 32,33 overhang the cap;
+    # row 1: fully inside
+    kv_lens = jnp.asarray([34, 20], jnp.int32)  # UNclamped lengths
+
+    want, k_ref, v_ref = paged_decode_multi_xla(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, max_pos=max_pos)
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True,
+        max_pos=max_pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # pool parity on the real pages (null page 0 excluded: the reference
+    # parks overhang writes there by contract)
+    np.testing.assert_array_equal(np.asarray(k_out[:, 1:5]),
+                                  np.asarray(k_ref[:, 1:5]))
+    np.testing.assert_array_equal(np.asarray(v_out[:, 1:5]),
+                                  np.asarray(v_ref[:, 1:5]))
+    # and the overhang really was suppressed: row 0's pre-cap cache entries
+    # at positions 28..29 (page 2, offsets 12..13) are untouched
+    np.testing.assert_array_equal(np.asarray(k_out[:, 2, 12:14]),
+                                  np.asarray(k_pages[:, 2, 12:14]))
