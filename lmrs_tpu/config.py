@@ -267,6 +267,16 @@ def model_preset(name: str) -> ModelConfig:
         "tiny-moe": dict(
             hidden_dim=512, n_experts=4, n_experts_per_token=2,
         ),
+        "bench-smoke": dict(
+            # CPU smoke of the bench HARNESS itself (LMRS_BENCH_MODEL=
+            # bench-smoke): tiny compute but bench-1b's max_seq_len, so the
+            # bench's chunk budget (1400 + context + template < 1920
+            # truncation line) holds and the exact same scheduler shapes
+            # compile — in seconds on a CPU, not minutes ("tiny" inherits
+            # max_seq_len 8192, whose packed/decode shapes thrash CPU XLA).
+            vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=256, max_seq_len=2048,
+        ),
         "mixtral-8x7b": dict(
             vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
             hidden_dim=14336, max_seq_len=8192, rope_theta=1e6,
