@@ -1,0 +1,91 @@
+"""Worker process for tests/test_distributed.py.
+
+Runs ONE data-parallel train step over a GLOBAL mesh that spans two
+OS processes (2 local CPU devices each — the multi-host DCN topology in
+miniature: gradient psums cross the process boundary over the gloo
+backend exactly where a pod crosses DCN).  Usage:
+
+    python tests/_dist_worker.py <process_id> <coordinator> <out_file>
+
+Module top is side-effect free: the test process imports ``make_cfg`` /
+``make_global_tokens`` (one shared workload definition — no copy-paste
+drift between the worker and the single-process parity check), so env
+setup happens only under ``__main__``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def make_cfg():
+    from lmrs_tpu.config import ModelConfig
+
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=64,
+                       dtype="float32")
+
+
+def make_global_tokens():
+    """Deterministic global batch [4, 64] (one row per dp device)."""
+    import numpy as np
+
+    return np.random.default_rng(42).integers(3, 258, (4, 64)).astype(np.int32)
+
+
+def main() -> None:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    pid, coordinator, out_file = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+
+    from lmrs_tpu.parallel.mesh import build_mesh, initialize_distributed
+
+    initialize_distributed(coordinator=coordinator, num_processes=2,
+                           process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4, "2 procs x 2 local devices"
+
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lmrs_tpu.config import MeshConfig
+    from lmrs_tpu.models.transformer import init_params
+    from lmrs_tpu.training.train import make_train_step
+
+    cfg = make_cfg()
+    mesh = build_mesh(MeshConfig(dp=4))
+    params = init_params(cfg, jax.random.PRNGKey(0))  # same seed: replicated
+    optimizer = optax.sgd(1e-2)
+    opt_state = optimizer.init(params)
+    step = make_train_step(cfg, optimizer, mesh)
+
+    # this process owns rows [2*pid, 2*pid+2) — the dp shard that lives on
+    # its local devices
+    global_tokens = make_global_tokens()
+    local_rows = global_tokens[2 * pid: 2 * pid + 2]
+    sharding = NamedSharding(mesh, P("dp", None))
+    tokens = jax.make_array_from_process_local_data(sharding, local_rows)
+
+    params, opt_state, loss = step(params, opt_state, tokens)
+    # loss is a replicated scalar: every process must see the same value
+    loss_val = float(loss)
+
+    # one more step to prove updated (cross-process-psummed) params stay
+    # consistent and usable
+    params, opt_state, loss2 = step(params, opt_state, tokens)
+
+    with open(out_file, "w") as f:
+        f.write(f"{loss_val:.8f} {float(loss2):.8f} "
+                f"{jax.process_index()} {jax.process_count()}\n")
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
