@@ -419,13 +419,13 @@ def paged_decode_pallas_multi(
             pl.BlockSpec((1, kh, rows, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec((1, kh, t_pad, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec((1, kh, t_pad, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((1, kh, rows, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((2, ps, hd), k_pages.dtype),
@@ -556,13 +556,13 @@ def paged_decode_pallas_fused(
             pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec((1, kh, 8, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec((1, kh, 8, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((2, ps, hd), k_pages.dtype),  # double-buffered pages
@@ -669,8 +669,8 @@ def paged_decode_pallas(
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
