@@ -330,3 +330,37 @@ def test_stop_sequence_and_cap(server):
     })
     assert status == 200
     assert "====" not in out["choices"][0]["message"]["content"]
+
+
+def test_streaming_through_real_scheduler():
+    """SSE through the REAL continuous-batching engine (not the mock): a
+    streamed HTTP request must produce multiple deltas (one per decode
+    block) that concatenate to a non-streamed greedy run's text."""
+    from lmrs_tpu.config import EngineConfig, ModelConfig
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                     dtype="float32")
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=24, max_batch_slots=2, seed=0,
+                                 decode_block=4), mc)
+    srv = EngineHTTPServer(eng, port=0, batch_window_s=0.02)
+    srv.start_background()
+    try:
+        body = {"messages": [{"role": "user", "content": "stream me"}],
+                "max_tokens": 24, "temperature": 0.0}
+        _, plain = _post(srv, "/v1/chat/completions", body, timeout=120)
+        frames = _post_sse(srv, "/v1/chat/completions",
+                           {**body, "stream": True}, timeout=120)
+        chunks = [d for _, d in frames[:-1]]
+        deltas = [c["choices"][0]["delta"].get("content", "")
+                  for c in chunks]
+        text = "".join(deltas)
+        assert text == plain["choices"][0]["message"]["content"]
+        # decode_block=4 over 24 greedy tokens: streaming must be
+        # incremental through the scheduler, not one final-text delta
+        assert sum(1 for d in deltas if d) > 1, deltas
+    finally:
+        srv.shutdown()
+        eng.shutdown()
