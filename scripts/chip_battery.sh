@@ -1,0 +1,26 @@
+#!/bin/bash
+# Hardware measurement battery — run top-to-bottom the moment a TPU answers.
+# Each stage gates the next (no point benching on a chip that fails parity).
+# Usage: bash scripts/chip_battery.sh [outdir]
+set -u
+OUT=${1:-/tmp/chip_battery}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "=== 1. kernel parity smoke (<60s) ==="
+timeout 600 python scripts/tpu_smoke.py 2>&1 | tee "$OUT/smoke.log"
+grep -q '"ok": true' "$OUT/smoke.log" || { echo "SMOKE FAILED — stop"; exit 1; }
+
+echo "=== 2. decode fixed-cost/slope fit (kv-head fold ABBA target: 9.39ms -> <5ms fixed) ==="
+timeout 1200 python scripts/decode_split.py 2>&1 | tee "$OUT/decode_split.log"
+
+echo "=== 3. bench (median of 3 reps, full roofline detail) ==="
+timeout 1800 python bench.py 2>&1 | tee "$OUT/bench.log"
+
+echo "=== 4. speculation ABBA (multi-token verify kernel; was 12x loss) ==="
+timeout 1200 python scripts/ab_spec.py 2>&1 | tee "$OUT/spec.log"
+
+echo "=== 5. int8 x flash-tile sanity (should reproduce r2: ~41.5% MFU tile 512) ==="
+timeout 1200 python scripts/ab_int8.py 2>&1 | tee "$OUT/int8.log"
+
+echo "battery complete -> $OUT"
