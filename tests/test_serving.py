@@ -364,3 +364,30 @@ def test_streaming_through_real_scheduler():
     finally:
         srv.shutdown()
         eng.shutdown()
+
+
+def test_streaming_engine_error_emits_sse_error_frame():
+    """A failing request with stream:true must deliver an in-band SSE error
+    frame and close — never hang the client or emit a bare 500 after the
+    event-stream headers are out."""
+    engine = MockEngine(fail_pattern="EXPLODE")
+    srv = EngineHTTPServer(engine, port=0, batch_window_s=0.02)
+    srv.start_background()
+    try:
+        frames = _post_sse(srv, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "please EXPLODE now"}],
+            "stream": True,
+        })
+        err = [d for _, d in frames
+               if isinstance(d, dict) and "error" in d]
+        assert err and "injected failure" in err[0]["error"]["message"]
+        assert frames[-1][1] == "[DONE]"
+
+        frames = _post_sse(srv, "/v1/messages", {
+            "messages": [{"role": "user", "content": "please EXPLODE now"}],
+            "stream": True,
+        })
+        err = [d for e, d in frames if e == "error"]
+        assert err and err[0]["error"]["type"] == "api_error"
+    finally:
+        srv.shutdown()
