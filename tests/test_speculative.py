@@ -137,3 +137,40 @@ def test_spec_sampling_runs_and_respects_budget():
     for i, r in enumerate(out):
         assert r.error is None
         assert 0 < r.completion_tokens <= 10 + i
+
+
+def test_spec_greedy_through_multi_kernel_matches_plain(monkeypatch):
+    """The RAGGED multi-token verify KERNEL path (interpret mode; the gate
+    needs hd%128==0) must also emit token-for-token what plain decode
+    emits — the kernel replaces the window gather, never the math."""
+    import jax
+
+    from lmrs_tpu.config import EngineConfig, ModelConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    monkeypatch.setenv("LMRS_FORCE_KERNELS", "interpret")
+    mc = ModelConfig(vocab_size=512, dim=512, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=256, max_seq_len=256,
+                     dtype="float32")
+    reqs = [GenerationRequest(prompt="the cat sat on the mat the cat sat " * 2,
+                              request_id=i, max_new_tokens=12, temperature=0.0)
+            for i in range(2)]
+
+    def make(k):
+        return JaxEngine(EngineConfig(
+            backend="jax", scheduler="continuous", max_tokens=12,
+            max_batch_slots=2, seed=0, decode_block=6, page_size=16,
+            speculate_k=k), mc)
+
+    plain = make(0)
+    assert plain._scheduler._use_ragged  # the kernel gate really is on
+    want = [r.text for r in plain.generate_batch(reqs)]
+    plain.shutdown()
+
+    spec = make(4)
+    got_res = spec.generate_batch(reqs)
+    got = [r.text for r in got_res]
+    spec.shutdown()
+    assert all(r.error is None for r in got_res)
+    assert got == want
