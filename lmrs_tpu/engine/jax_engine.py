@@ -69,6 +69,17 @@ class JaxEngine:
         self.model_cfg = model_cfg
         self.mesh_cfg = mesh_cfg
         self.tokenizer = tokenizer or self._default_tokenizer()
+        # A tokenizer whose ids exceed the model vocabulary would fail
+        # SILENTLY: JAX clamps out-of-range embedding gathers (every big id
+        # embeds as the last row) and an out-of-range eos_id can never be
+        # sampled, so requests run to budget producing garbage.  Refuse.
+        if (self.tokenizer.vocab_size > model_cfg.vocab_size
+                or self.tokenizer.eos_id >= model_cfg.vocab_size):
+            raise ValueError(
+                f"tokenizer vocab ({self.tokenizer.vocab_size}, eos "
+                f"{self.tokenizer.eos_id}) does not fit model vocab "
+                f"({model_cfg.vocab_size}); pick a tokenizer the model was "
+                "trained with (--tokenizer) or a matching model preset")
         self._mesh = None
         # An explicit device list always builds a mesh — even a 1-device one —
         # so params/cache/dispatches PIN to those devices (a DP replica must

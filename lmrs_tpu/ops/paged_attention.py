@@ -201,67 +201,6 @@ def _ragged_decode_all_heads(
             o_ref[ki] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
 
 
-def _write_new_token_all_heads(
-    page_tables_ref, kv_lens_ref,
-    knew_ref,         # VMEM [kh, 8, hd] current token's K (row 0 real)
-    vnew_ref,         # VMEM [kh, 8, hd]
-    k_out,            # ANY  [K, P, ps, hd] aliased pool
-    v_out,
-    k8_scr,           # VMEM [kh, 8, hd]
-    v8_scr,
-    wsem,             # DMA semaphores (kh, 2)
-    *,
-    page_size: int,
-    kh: int,
-):
-    """Scatter the current token's K/V for EVERY kv head into its page slot
-    in place, pipelined: all heads' read-DMAs issue together, then each head
-    blends + issues its write-back, then all writes drain.  Mosaic can't DMA
-    a single sublane row, so each head read-modify-writes the aligned 8-row
-    window around the slot (knew rows are broadcast-identical, so a where on
-    the row index blends the real row)."""
-    b = pl.program_id(0)
-    length = kv_lens_ref[b]
-    pos = length - 1
-    # clamped like the walk bound: never index the table OOB, even for rows
-    # carrying a degenerate length (inactive slots write page 0)
-    page_idx = jnp.clip(jax.lax.div(pos, page_size), 0,
-                        page_tables_ref.shape[1] - 1)
-    page = page_tables_ref[b, page_idx]
-    off = jax.lax.rem(pos, page_size)
-    # window start must be PROVABLY 8-aligned for Mosaic's tile reasoning
-    w0 = jax.lax.div(off, 8) * 8
-    r = off - w0
-
-    reads = []
-    for ki in range(kh):
-        rk = pltpu.make_async_copy(
-            k_out.at[ki, page, pl.ds(w0, 8)], k8_scr.at[ki], wsem.at[ki, 0])
-        rv = pltpu.make_async_copy(
-            v_out.at[ki, page, pl.ds(w0, 8)], v8_scr.at[ki], wsem.at[ki, 1])
-        rk.start()
-        rv.start()
-        reads.append((rk, rv))
-    writes = []
-    for ki in range(kh):
-        rk, rv = reads[ki]
-        rk.wait()
-        rv.wait()
-        row = jax.lax.broadcasted_iota(jnp.int32, (8, k8_scr.shape[-1]), 0) == r
-        k8_scr[ki] = jnp.where(row, knew_ref[ki], k8_scr[ki])
-        v8_scr[ki] = jnp.where(row, vnew_ref[ki], v8_scr[ki])
-        wk = pltpu.make_async_copy(
-            k8_scr.at[ki], k_out.at[ki, page, pl.ds(w0, 8)], wsem.at[ki, 0])
-        wv = pltpu.make_async_copy(
-            v8_scr.at[ki], v_out.at[ki, page, pl.ds(w0, 8)], wsem.at[ki, 1])
-        wk.start()
-        wv.start()
-        writes.append((wk, wv))
-    for wk, wv in writes:
-        wk.wait()
-        wv.wait()
-
-
 def _write_new_tokens_all_heads(
     page_tables_ref, kv_lens_ref,
     knew_ref,         # VMEM [kh, t_pad, hd] the T new tokens' K (rows 0..T-1)
@@ -297,6 +236,16 @@ def _write_new_tokens_all_heads(
     t_pad = knew_ref.shape[1]
     hd = knew_ref.shape[-1]
     win0 = jax.lax.div(base, 8) * 8  # provably 8-aligned
+    # A window is touched ONLY if it holds a valid token position.  An
+    # overhanging window (past the table span or max_pos) must be skipped
+    # entirely, not clipped: a clipped page index keeps the raw offset and
+    # can ALIAS an earlier window's rows when page_size <= 8*(n_win-1)
+    # (e.g. ps=8 with any draft span ending at the table edge) — its stale
+    # write-back would then revert the valid window's freshly written K/V.
+    limit = jnp.minimum(base + n_tokens,
+                        page_tables_ref.shape[1] * page_size)
+    if max_pos is not None:
+        limit = jnp.minimum(limit, max_pos)
 
     def win_page(wi):
         start = win0 + 8 * wi
@@ -304,66 +253,71 @@ def _write_new_tokens_all_heads(
                             page_tables_ref.shape[1] - 1)
         return start, page_tables_ref[b, page_idx]
 
-    reads = []
+    def copies(ki, wi, start, page):
+        si = ki * n_win + wi
+        off = pl.ds(jax.lax.rem(start, page_size), 8)
+        return (pltpu.make_async_copy(k_out.at[ki, page, off],
+                                      k8_scr.at[ki, wi], wsem.at[si, 0]),
+                pltpu.make_async_copy(v_out.at[ki, page, off],
+                                      v8_scr.at[ki, wi], wsem.at[si, 1]),
+                pltpu.make_async_copy(k8_scr.at[ki, wi],
+                                      k_out.at[ki, page, off], wsem.at[si, 0]),
+                pltpu.make_async_copy(v8_scr.at[ki, wi],
+                                      v_out.at[ki, page, off], wsem.at[si, 1]))
+
     for ki in range(kh):
         for wi in range(n_win):
             start, page = win_page(wi)
-            si = ki * n_win + wi
-            rk = pltpu.make_async_copy(
-                k_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
-                k8_scr.at[ki, wi], wsem.at[si, 0])
-            rv = pltpu.make_async_copy(
-                v_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
-                v8_scr.at[ki, wi], wsem.at[si, 1])
-            rk.start()
-            rv.start()
-            reads.append((rk, rv))
-    writes = []
+
+            @pl.when(start < limit)
+            def _read(ki=ki, wi=wi, start=start, page=page):
+                rk, rv, _, _ = copies(ki, wi, start, page)
+                rk.start()
+                rv.start()
     for ki in range(kh):
         for wi in range(n_win):
             start, page = win_page(wi)
-            si = ki * n_win + wi
-            rk, rv = reads[si]
-            rk.wait()
-            rv.wait()
-            # row r of this window holds token j = start + r - base when
-            # 0 <= j < T; select token rows with a tiny 0/1 matmul (no
-            # dynamic VMEM indexing) and blend where a token lands
-            row = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 0)
-            tok = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 1)
-            j = start + row - base
-            valid = (j == tok) & (tok < n_tokens)
-            if max_pos is not None:
-                valid &= (start + row) < max_pos
-            sel = valid.astype(jnp.float32)
-            k_rows = jax.lax.dot_general(
-                sel, knew_ref[ki].astype(jnp.float32),
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            v_rows = jax.lax.dot_general(
-                sel, vnew_ref[ki].astype(jnp.float32),
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            hit = (jnp.sum(sel, axis=1, keepdims=True) > 0)
-            hit = jnp.broadcast_to(hit, (8, hd))
-            k8_scr[ki, wi] = jnp.where(hit, k_rows.astype(k8_scr.dtype),
-                                       k8_scr[ki, wi])
-            v8_scr[ki, wi] = jnp.where(hit, v_rows.astype(v8_scr.dtype),
-                                       v8_scr[ki, wi])
-            wk = pltpu.make_async_copy(
-                k8_scr.at[ki, wi],
-                k_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
-                wsem.at[si, 0])
-            wv = pltpu.make_async_copy(
-                v8_scr.at[ki, wi],
-                v_out.at[ki, page, pl.ds(jax.lax.rem(start, page_size), 8)],
-                wsem.at[si, 1])
-            wk.start()
-            wv.start()
-            writes.append((wk, wv))
-    for wk, wv in writes:
-        wk.wait()
-        wv.wait()
+
+            @pl.when(start < limit)
+            def _blend(ki=ki, wi=wi, start=start, page=page):
+                rk, rv, wk, wv = copies(ki, wi, start, page)
+                rk.wait()
+                rv.wait()
+                # row r of this window holds token j = start + r - base when
+                # 0 <= j < T; select token rows with a tiny 0/1 matmul (no
+                # dynamic VMEM indexing) and blend where a token lands
+                row = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 0)
+                tok = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 1)
+                j = start + row - base
+                valid = (j == tok) & (tok < n_tokens)
+                if max_pos is not None:
+                    valid &= (start + row) < max_pos
+                sel = valid.astype(jnp.float32)
+                k_rows = jax.lax.dot_general(
+                    sel, knew_ref[ki].astype(jnp.float32),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                v_rows = jax.lax.dot_general(
+                    sel, vnew_ref[ki].astype(jnp.float32),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                hit = (jnp.sum(sel, axis=1, keepdims=True) > 0)
+                hit = jnp.broadcast_to(hit, (8, hd))
+                k8_scr[ki, wi] = jnp.where(hit, k_rows.astype(k8_scr.dtype),
+                                           k8_scr[ki, wi])
+                v8_scr[ki, wi] = jnp.where(hit, v_rows.astype(v8_scr.dtype),
+                                           v8_scr[ki, wi])
+                wk.start()
+                wv.start()
+    for ki in range(kh):
+        for wi in range(n_win):
+            start, page = win_page(wi)
+
+            @pl.when(start < limit)
+            def _drain(ki=ki, wi=wi, start=start, page=page):
+                _, _, wk, wv = copies(ki, wi, start, page)
+                wk.wait()
+                wv.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "max_pos"))
@@ -570,8 +524,8 @@ def paged_decode_pallas_fused(
             pltpu.VMEM((n_rep_p, hd), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
-            pltpu.VMEM((kh, 8, hd), k_pages.dtype),
-            pltpu.VMEM((kh, 8, hd), v_pages.dtype),
+            pltpu.VMEM((kh, 1, 8, hd), k_pages.dtype),  # one RMW window
+            pltpu.VMEM((kh, 1, 8, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((kh, 2)),
         ],
@@ -581,9 +535,12 @@ def paged_decode_pallas_fused(
                o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
                k8_scr, v8_scr, sem, wsem):
         # the new token's K/V must land before the walk reads its page
-        _write_new_token_all_heads(
+        # (n_tokens=1 degenerate of the multi-token writer — one shared
+        # RMW implementation; a stale length past the table span now
+        # SKIPS the write instead of scribbling a clipped page)
+        _write_new_tokens_all_heads(
             pt_ref, len_ref, knew_ref.at[0], vnew_ref.at[0], k_out, v_out,
-            k8_scr, v8_scr, wsem, page_size=ps, kh=kh,
+            k8_scr, v8_scr, wsem, page_size=ps, kh=kh, n_tokens=1,
         )
         _ragged_decode_all_heads(
             pt_ref, len_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],

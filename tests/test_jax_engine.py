@@ -104,3 +104,33 @@ def test_engine_restores_checkpoint_sharded(tmp_path):
     got = eng.generate_batch([req])[0].text
     eng.shutdown()
     assert got == want
+
+
+def test_tokenizer_vocab_mismatch_refused():
+    """An engine tokenizer whose ids exceed the model vocabulary must be
+    refused loudly at construction — JAX clamps out-of-range embedding
+    gathers silently and an unreachable eos_id never terminates decode
+    (round-3 review finding)."""
+    from lmrs_tpu.config import EngineConfig, ModelConfig
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    class BigVocabTok:
+        vocab_size = 128256
+        bos_id, eos_id, pad_id = 1, 128001, 0
+
+        def encode(self, text):
+            return [5]
+
+        def decode(self, ids):
+            return ""
+
+        def count(self, text):
+            return 1
+
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=1, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=64,
+                     dtype="float32")
+    with pytest.raises(ValueError, match="does not fit model vocab"):
+        JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                               max_batch_slots=1, seed=0), mc,
+                  tokenizer=BigVocabTok())

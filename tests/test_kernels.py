@@ -121,31 +121,31 @@ def test_ragged_decode_clamps_stale_lengths():
     tables = jnp.asarray([[3, 5], [9, 0]], jnp.int32)  # width 2 = 32 tokens
     # row 0 normal; row 1 claims 180 tokens (needs 12 pages > width 2)
     kv_lens = jnp.asarray([20, 180], jnp.int32)
-    W, clamped = tables.shape[1], jnp.minimum(kv_lens, tables.shape[1] * ps)
+    clamped = jnp.minimum(kv_lens, tables.shape[1] * ps)
 
     got, k_out, v_out = paged_decode_pallas_fused(
         q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
 
-    # reference mirrors the kernel's CLAMPED write (page index clipped to
-    # the table width) and attends each tabled page exactly once with the
-    # length capped at the table capacity.  The unclamped kernel would
-    # re-attend its last column's page for every overflow walk step
-    # (interpret-mode ref clamping), shifting row 1's softmax — so output
-    # parity here genuinely discriminates fixed vs broken kernels.
-    pos = kv_lens - 1
-    page = jnp.take_along_axis(tables, jnp.minimum(pos // ps, W - 1)[:, None], 1)[:, 0]
-    off = pos % ps
-    k_ref = k_pages.at[:, page, off].set(k_new.transpose(1, 0, 2))
-    v_ref = v_pages.at[:, page, off].set(v_new.transpose(1, 0, 2))
+    # reference mirrors the kernel: the degenerate row's write is SKIPPED
+    # entirely (its position lies past the table span — a clipped-page
+    # write would alias/scribble another window's rows), and the walk
+    # attends each tabled page exactly once with the length capped at the
+    # table capacity.  An unclamped kernel would re-attend its last
+    # column's page for every overflow walk step, shifting row 1's softmax
+    # — so output parity here genuinely discriminates fixed vs broken.
+    pos0 = int(kv_lens[0]) - 1  # row 0 only; row 1's write is skipped
+    page0, off0 = int(tables[0, pos0 // ps]), pos0 % ps
+    k_ref = k_pages.at[:, page0, off0].set(k_new[0])
+    v_ref = v_pages.at[:, page0, off0].set(v_new[0])
     want = paged_decode_xla(q, k_ref, v_ref, tables, clamped)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
-    # writes land ONLY on the two rows' write pages: row 0 -> page 5
-    # (pos 19, column 1), row 1 -> page 0 (clamped column 1); K and V both
+    # writes land ONLY on row 0's write page (pos 19 -> column 1 -> page
+    # 5); row 1's out-of-span write is skipped, not clipped; K and V both
     for name, out_pool, in_pool in (("k", k_out, k_pages), ("v", v_out, v_pages)):
         touched = set(np.flatnonzero(
             (np.asarray(out_pool) != np.asarray(in_pool)).any(axis=(0, 2, 3))))
-        assert touched == {5, 0}, f"{name} wrote pages {touched}, want {{5, 0}}"
+        assert touched == {5}, f"{name} wrote pages {touched}, want {{5}}"
 
 
 def _tp_mesh(tp=2):
@@ -314,3 +314,41 @@ def test_multi_token_verify_max_pos_boundary():
     # at positions 28..29 (page 2, offsets 12..13) are untouched
     np.testing.assert_array_equal(np.asarray(k_out[:, 2, 12:14]),
                                   np.asarray(k_pages[:, 2, 12:14]))
+
+
+def test_multi_token_verify_no_window_alias_at_table_edge():
+    """Regression (round-3 review): with small pages an OVERHANGING padded
+    RMW window clipped onto the last table column aliases an earlier
+    window's physical rows — its stale write-back would revert freshly
+    written K/V.  page_size=8, T=5, span ending exactly at the table edge:
+    windows at offsets 0 (valid) and 8 (overhang, must be SKIPPED)."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla,
+        paged_decode_pallas_multi,
+    )
+
+    b, t, h, kh, hd, ps, n_pages = 1, 5, 4, 2, 128, 8, 8
+    rng = jax.random.split(jax.random.PRNGKey(9), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2]], jnp.int32)  # capacity 16 tokens
+    # base = 11: tokens at 11..15 — all valid, spanning windows 8..15 of
+    # page 2 AND the padded window at global offset 16 (start >= capacity)
+    kv_lens = jnp.asarray([16], jnp.int32)
+
+    want, k_ref, v_ref = paged_decode_multi_xla(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens)
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # the freshly written rows must SURVIVE (an aliased stale write-back
+    # reverted them before this fix); pages 1-2 are the row's real pages
+    np.testing.assert_array_equal(np.asarray(k_out[:, 1:3]),
+                                  np.asarray(k_ref[:, 1:3]))
+    np.testing.assert_array_equal(np.asarray(v_out[:, 1:3]),
+                                  np.asarray(v_ref[:, 1:3]))
