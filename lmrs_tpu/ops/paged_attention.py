@@ -253,14 +253,18 @@ def _write_new_tokens_all_heads(
                             page_tables_ref.shape[1] - 1)
         return start, page_tables_ref[b, page_idx]
 
-    def copies(ki, wi, start, page):
+    def read_copies(ki, wi, start, page):
         si = ki * n_win + wi
         off = pl.ds(jax.lax.rem(start, page_size), 8)
         return (pltpu.make_async_copy(k_out.at[ki, page, off],
                                       k8_scr.at[ki, wi], wsem.at[si, 0]),
                 pltpu.make_async_copy(v_out.at[ki, page, off],
-                                      v8_scr.at[ki, wi], wsem.at[si, 1]),
-                pltpu.make_async_copy(k8_scr.at[ki, wi],
+                                      v8_scr.at[ki, wi], wsem.at[si, 1]))
+
+    def write_copies(ki, wi, start, page):
+        si = ki * n_win + wi
+        off = pl.ds(jax.lax.rem(start, page_size), 8)
+        return (pltpu.make_async_copy(k8_scr.at[ki, wi],
                                       k_out.at[ki, page, off], wsem.at[si, 0]),
                 pltpu.make_async_copy(v8_scr.at[ki, wi],
                                       v_out.at[ki, page, off], wsem.at[si, 1]))
@@ -271,7 +275,7 @@ def _write_new_tokens_all_heads(
 
             @pl.when(start < limit)
             def _read(ki=ki, wi=wi, start=start, page=page):
-                rk, rv, _, _ = copies(ki, wi, start, page)
+                rk, rv = read_copies(ki, wi, start, page)
                 rk.start()
                 rv.start()
     for ki in range(kh):
@@ -280,7 +284,8 @@ def _write_new_tokens_all_heads(
 
             @pl.when(start < limit)
             def _blend(ki=ki, wi=wi, start=start, page=page):
-                rk, rv, wk, wv = copies(ki, wi, start, page)
+                rk, rv = read_copies(ki, wi, start, page)
+                wk, wv = write_copies(ki, wi, start, page)
                 rk.wait()
                 rv.wait()
                 # row r of this window holds token j = start + r - base when
@@ -315,7 +320,7 @@ def _write_new_tokens_all_heads(
 
             @pl.when(start < limit)
             def _drain(ki=ki, wi=wi, start=start, page=page):
-                _, _, wk, wv = copies(ki, wi, start, page)
+                wk, wv = write_copies(ki, wi, start, page)
                 wk.wait()
                 wv.wait()
 
