@@ -11,7 +11,7 @@ from lmrs_tpu.ops.moe import expert_capacity, moe_mlp
 
 
 def _moe_cfg(**kw) -> ModelConfig:
-    base = dict(vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    base = dict(vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
                 hidden_dim=96, n_experts=4, n_experts_per_token=2,
                 max_seq_len=256)
     base.update(kw)
