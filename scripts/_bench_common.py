@@ -8,16 +8,30 @@ import numpy as np
 from lmrs_tpu.engine.api import GenerationRequest
 
 
-def wave(engine, n, max_new, tag, words=(160, 161), temperature=0.3):
+def wave(engine, n, max_new, tag, words=(160, 161), temperature=0.3,
+         repetitive=False):
     """One timed generate_batch of n requests; prompt lengths drawn from
-    ``words`` = (lo, hi) range (uniform ~1.3k-byte prompts by default)."""
+    ``words`` = (lo, hi) range (uniform ~1.3k-byte prompts by default).
+
+    ``repetitive``: prompts are a short phrase repeated — a low-entropy
+    workload where prompt-lookup drafting should reach high acceptance
+    (the speculation WIN case; the default high-entropy prompts measure
+    speculation's pure overhead instead)."""
     rng = np.random.default_rng(hash(tag) % 2**31)
-    reqs = [GenerationRequest(
-        prompt=f"[{i:02d}:00] " + " ".join(
-            f"word{rng.integers(0, 997)}"
-            for _ in range(int(rng.integers(*words)))),
-        request_id=i, temperature=temperature, max_new_tokens=max_new)
-        for i in range(n)]
+    if repetitive:
+        reqs = [GenerationRequest(
+            prompt=f"[{i:02d}:00] " + " ".join(
+                f"step{j % 7} leads to step{(j + 1) % 7}"
+                for j in range(int(rng.integers(*words)) // 2)),
+            request_id=i, temperature=temperature, max_new_tokens=max_new)
+            for i in range(n)]
+    else:
+        reqs = [GenerationRequest(
+            prompt=f"[{i:02d}:00] " + " ".join(
+                f"word{rng.integers(0, 997)}"
+                for _ in range(int(rng.integers(*words)))),
+            request_id=i, temperature=temperature, max_new_tokens=max_new)
+            for i in range(n)]
     t0 = time.time()
     out = engine.generate_batch(reqs)
     dt = time.time() - t0

@@ -39,17 +39,27 @@ def main():
     for k, e in engines.items():
         wave(e, n, max_new, f"warm{k}", words=(160, 161))
 
-    sums = {k: [] for k in engines}
-    for r in range(3):
-        order = [0, spec_k, spec_k, 0]
-        for k in order:
-            dt = wave(engines[k], n, max_new, f"{r}-{k}-{len(sums[k])}", words=(160, 161))
-            sums[k].append(dt)
-        line = "  ".join(f"k={k}: {np.mean(v):.2f}s" for k, v in sums.items())
-        print(f"round {r}: {line}", flush=True)
-    for k, v in sums.items():
-        acc = engines[k]._scheduler.metrics.get("spec_accepted_tokens", 0)
-        print(f"k={k}: mean {np.mean(v):.2f}s  accepted={acc}")
+    # two workloads per round (VERDICT r3 decision protocol): high-entropy
+    # prompts measure speculation's pure overhead; repetitive prompts are
+    # the acceptance-rich case where it must show >= 1.2x to ship ON
+    for rep, label in ((False, "high-entropy"), (True, "repetitive")):
+        sums = {k: [] for k in engines}
+        for r in range(3):
+            order = [0, spec_k, spec_k, 0]
+            for k in order:
+                dt = wave(engines[k], n, max_new,
+                          f"{label}-{r}-{k}-{len(sums[k])}",
+                          words=(160, 161), repetitive=rep)
+                sums[k].append(dt)
+            line = "  ".join(f"k={k}: {np.mean(v):.2f}s"
+                             for k, v in sums.items())
+            print(f"[{label}] round {r}: {line}", flush=True)
+        speedup = np.mean(sums[0]) / np.mean(sums[spec_k])
+        for k, v in sums.items():
+            acc = engines[k]._scheduler.metrics.get("spec_accepted_tokens", 0)
+            print(f"[{label}] k={k}: mean {np.mean(v):.2f}s  accepted={acc}")
+        print(f"[{label}] speculation speedup: {speedup:.2f}x "
+              f"({'WIN' if speedup >= 1.2 else 'keep OFF'})", flush=True)
 
 
 if __name__ == "__main__":
