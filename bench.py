@@ -51,6 +51,10 @@ TRANSCRIPT_CANDIDATES = [
 
 _emit_lock = threading.Lock()
 _emitted = False
+# completed timed reps, appended as they finish: if the watchdog fires
+# mid-run (slow link, wedged dispatch after some reps landed), it emits
+# the median of what completed instead of throwing the data away
+_partial_reps: list[dict] = []
 
 
 def emit(value: float, detail: dict) -> None:
@@ -72,10 +76,23 @@ def emit(value: float, detail: dict) -> None:
 
 def start_watchdog(deadline_s: float) -> threading.Timer:
     """If the bench wedges on a device call after init, still emit the
-    artifact and exit cleanly."""
+    artifact — the median of any COMPLETED reps, else an error — and exit
+    cleanly."""
     def fire() -> None:
-        emit(0.0, {"error": f"watchdog: bench exceeded {deadline_s:.0f}s "
-                            "deadline (device call wedged?)"})
+        note = (f"watchdog: bench exceeded {deadline_s:.0f}s deadline "
+                "(device call wedged?)")
+        if _partial_reps:
+            vals = sorted(r["chunks_per_sec"] for r in _partial_reps)
+            emit(statistics.median(vals), {
+                **_partial_reps[len(_partial_reps) // 2],
+                "reps": len(_partial_reps), "partial": True,
+                "rep_chunks_per_sec": [r["chunks_per_sec"]
+                                       for r in _partial_reps],
+                "spread": round(vals[-1] - vals[0], 3),
+                "error": note,
+            })
+        else:
+            emit(0.0, {"error": note})
         sys.stdout.flush()
         os._exit(0)
 
@@ -228,7 +245,7 @@ def run_bench() -> tuple[float, dict]:
     # run-to-run spread on identical code; the median + per-rep values let
     # the judge tell a real regression from a bad link day.
     reps = max(1, int(os.environ.get("LMRS_BENCH_REPS", "3")))
-    rep_rows = []
+    rep_rows = _partial_reps  # shared with the watchdog (see start_watchdog)
     for _ in range(reps):
         tokens_before = s.executor.total_tokens_used
         failed_before = s.executor.failed_requests
