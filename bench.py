@@ -81,13 +81,17 @@ def start_watchdog(deadline_s: float) -> threading.Timer:
     def fire() -> None:
         note = (f"watchdog: bench exceeded {deadline_s:.0f}s deadline "
                 "(device call wedged?)")
-        if _partial_reps:
-            vals = sorted(r["chunks_per_sec"] for r in _partial_reps)
-            emit(statistics.median(vals), {
-                **_partial_reps[len(_partial_reps) // 2],
-                "reps": len(_partial_reps), "partial": True,
-                "rep_chunks_per_sec": [r["chunks_per_sec"]
-                                       for r in _partial_reps],
+        reps = list(_partial_reps)  # snapshot: the main thread may append
+        if reps:
+            vals = sorted(r["chunks_per_sec"] for r in reps)
+            value = statistics.median(vals)
+            # same selection as the main path: the rep NEAREST the median,
+            # so the detail block never contradicts the headline value
+            row = min(reps, key=lambda r: abs(r["chunks_per_sec"] - value))
+            emit(value, {
+                **row,
+                "reps": len(reps), "partial": True,
+                "rep_chunks_per_sec": [r["chunks_per_sec"] for r in reps],
                 "spread": round(vals[-1] - vals[0], 3),
                 "error": note,
             })

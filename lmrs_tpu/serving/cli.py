@@ -33,6 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None,
                    help="device mesh axes as dp,tp[,sp[,pp]], e.g. 2,4")
     p.add_argument("--checkpoint", default=None, help="Orbax checkpoint dir")
+    p.add_argument("--tokenizer", default=None,
+                   help="serving tokenizer: 'byte', a *.model SentencePiece "
+                        "path, or an HF tokenizer dir (the checkpoint's own "
+                        "vocabulary; default: model-derived)")
     p.add_argument("--quantize", default=None, choices=["int8"])
     p.add_argument("--batch-slots", type=int, default=8,
                    help="continuous-batching decode slots")
@@ -55,6 +59,7 @@ def main(argv: list[str] | None = None) -> int:
         model=args.model,
         max_batch_slots=args.batch_slots,
         checkpoint_path=args.checkpoint,
+        tokenizer=args.tokenizer or "",
         quantize=args.quantize,
         max_tokens=args.max_tokens_cap,
     )
