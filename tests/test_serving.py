@@ -391,3 +391,18 @@ def test_streaming_engine_error_emits_sse_error_frame():
         assert err and err[0]["error"]["type"] == "api_error"
     finally:
         srv.shutdown()
+
+
+def test_serve_cli_tokenizer_flag_reaches_engine_config():
+    """--tokenizer on lmrs-serve must land in EngineConfig.tokenizer (the
+    converted-checkpoint journey README documents)."""
+    from lmrs_tpu.serving.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--backend", "jax", "--model", "tiny", "--tokenizer", "byte"])
+    assert args.tokenizer == "byte"
+    from lmrs_tpu.config import EngineConfig
+
+    cfg = EngineConfig(backend=args.backend, model=args.model,
+                       tokenizer=args.tokenizer or "")
+    assert cfg.tokenizer == "byte"
