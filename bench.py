@@ -74,29 +74,40 @@ def emit(value: float, detail: dict) -> None:
         }), flush=True)
 
 
+def summarize_reps(reps: list[dict]) -> tuple[float, dict]:
+    """Headline = MEDIAN rep; detail = the rep NEAREST the median (never
+    contradicting the headline) + per-rep values and spread.  The ONE
+    summary used by the happy path, the watchdog, and the error path."""
+    vals = sorted(r["chunks_per_sec"] for r in reps)
+    value = statistics.median(vals)
+    row = min(reps, key=lambda r: abs(r["chunks_per_sec"] - value))
+    return value, {
+        **row,
+        "reps": len(reps),
+        "rep_chunks_per_sec": [r["chunks_per_sec"] for r in reps],
+        "spread": round(vals[-1] - vals[0], 3),
+    }
+
+
+def emit_salvage(note: str) -> None:
+    """Emit the best artifact available after a failure: the median of any
+    COMPLETED reps (flagged partial) — measured data must never be thrown
+    away for a late error — else value 0 with the error alone."""
+    reps = list(_partial_reps)  # snapshot: the main thread may append
+    if reps:
+        value, detail = summarize_reps(reps)
+        emit(value, {**detail, "partial": True, "error": note})
+    else:
+        emit(0.0, {"error": note})
+
+
 def start_watchdog(deadline_s: float) -> threading.Timer:
     """If the bench wedges on a device call after init, still emit the
     artifact — the median of any COMPLETED reps, else an error — and exit
     cleanly."""
     def fire() -> None:
-        note = (f"watchdog: bench exceeded {deadline_s:.0f}s deadline "
-                "(device call wedged?)")
-        reps = list(_partial_reps)  # snapshot: the main thread may append
-        if reps:
-            vals = sorted(r["chunks_per_sec"] for r in reps)
-            value = statistics.median(vals)
-            # same selection as the main path: the rep NEAREST the median,
-            # so the detail block never contradicts the headline value
-            row = min(reps, key=lambda r: abs(r["chunks_per_sec"] - value))
-            emit(value, {
-                **row,
-                "reps": len(reps), "partial": True,
-                "rep_chunks_per_sec": [r["chunks_per_sec"] for r in reps],
-                "spread": round(vals[-1] - vals[0], 3),
-                "error": note,
-            })
-        else:
-            emit(0.0, {"error": note})
+        emit_salvage(f"watchdog: bench exceeded {deadline_s:.0f}s deadline "
+                     "(device call wedged?)")
         sys.stdout.flush()
         os._exit(0)
 
@@ -266,20 +277,13 @@ def run_bench() -> tuple[float, dict]:
             "num_chunks": stats["num_chunks"],
         })
 
-    vals = sorted(r["chunks_per_sec"] for r in rep_rows)
-    value = statistics.median(vals)
-    median_row = min(rep_rows,
-                     key=lambda r: abs(r["chunks_per_sec"] - value))
-    detail = {
-        **median_row,
-        "reps": reps,
-        "rep_chunks_per_sec": [r["chunks_per_sec"] for r in rep_rows],
-        "spread": round(vals[-1] - vals[0], 3),
+    value, detail = summarize_reps(rep_rows)
+    detail.update({
         "model": model.name,
         "params_m": round(_param_count_m(sched.params), 1),
         "backend": "jax",
         **roofline,
-    }
+    })
     return float(value), detail
 
 
@@ -298,8 +302,9 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - artifact > traceback
         import traceback
         traceback.print_exc()
-        emit(0.0, {"error": f"{type(e).__name__}: {e}"[:400],
-                   "backend_probe": probe_log})
+        # same salvage as the watchdog: a transient device error after
+        # completed reps must not zero out measured data
+        emit_salvage(f"{type(e).__name__}: {e}"[:400])
     return 0
 
 

@@ -210,8 +210,10 @@ class JaxEngine:
 
     def _max_new(self, req: GenerationRequest) -> int:
         # one decode-length bucket per engine (single compile); respect the
-        # smaller of request/config
-        return min(req.max_new_tokens, self.cfg.max_tokens)
+        # smallest of request/config/context — a budget >= max_seq_len would
+        # drive the truncation limit non-positive (see scheduler._encode)
+        return min(req.max_new_tokens, self.cfg.max_tokens,
+                   self.model_cfg.max_seq_len - 1)
 
     def _run_group(self, group):
         B = max(1, self.cfg.max_batch_slots)

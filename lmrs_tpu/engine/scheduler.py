@@ -183,7 +183,7 @@ class ContinuousScheduler:
         self._ran_ok: set = set()  # fn-cache keys that have executed once
         self._spec_buf = None  # device token-history buffer (speculation)
         self._on_tokens = None  # per-block streaming callback (run()-scoped)
-        self._streamed: dict[int, int] = {}
+        self._streamed: dict[int, str] = {}
         # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
         self.metrics = {
             "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
@@ -283,7 +283,7 @@ class ContinuousScheduler:
         """
         t_run = time.time()
         self._on_tokens = on_tokens
-        self._streamed: dict[int, int] = {}  # rid -> chars already emitted
+        self._streamed: dict[int, str] = {}  # rid -> text already emitted
         # queue entries: (req, prefill_ids, max_new, n_prompt,
         # prior_generated, t_start) — the last three are preemption-
         # continuation state (len(ids), [], None for fresh requests)
@@ -483,7 +483,13 @@ class ContinuousScheduler:
     def _encode(self, req: GenerationRequest) -> tuple[list[int], int]:
         text = (req.system_prompt + "\n\n" if req.system_prompt else "") + req.prompt
         ids = [self.tokenizer.bos_id] + self.tokenizer.encode(text)
-        max_new = min(req.max_new_tokens, self.cfg.max_tokens)
+        # max_new additionally caps at max_len-1: a budget >= the context
+        # window would make the truncation limit below non-positive, turning
+        # the middle-truncation slice into prompt DUPLICATION (negative-index
+        # wraparound) or an empty prompt — and the admission invariant
+        # ("every submitted request fits") rests on limit >= 1
+        max_new = min(req.max_new_tokens, self.cfg.max_tokens,
+                      self.max_len - 1)
         limit = self.max_len - max_new
         if len(ids) > limit:
             head, tail = limit // 2, limit - limit // 2
@@ -583,7 +589,13 @@ class ContinuousScheduler:
         # on the null page (length 0) rather than raising.
         B = self.B
         free = self.cache.allocator.free_count
-        rows = max(1, min(B, free))
+        if free == 0:
+            # probing an exhausted pool would raise OutOfPages on the very
+            # first open_sequence; an all-masked decode measures nothing,
+            # so report the skip instead of crashing the detail block
+            out["decode_probe_skipped"] = "no free KV pages"
+            return out
+        rows = min(B, free)
         per_slot = max(1, min(self.cache.max_pages_per_slot, free // rows))
         live = min(int(S * 0.75), per_slot * self.cache.page_size)
         seqs = [self.cache.open_sequence(live) for _ in range(rows)]
@@ -721,10 +733,16 @@ class ContinuousScheduler:
             # multi-byte UTF-8 sequence straddling a block boundary decodes
             # as trailing U+FFFD until its bytes complete — hold those back
             # (they'd change retroactively); a real U+FFFD flushes at finish.
-            sent = self._streamed.get(st.req.request_id, 0)
+            # Guarded against non-prefix-stable decoders (HF tokenizers'
+            # cleanup can rewrite earlier characters as tokens arrive): a
+            # delta is emitted ONLY while the new text extends what was
+            # already sent — on violation the stream FREEZES (undershoots)
+            # rather than ever emitting characters that later change; the
+            # non-streamed result text stays authoritative.
+            sent = self._streamed.get(st.req.request_id, "")
             frontier = len(text)
             if not finished:
-                while frontier > sent and text[frontier - 1] == "�":
+                while frontier > len(sent) and text[frontier - 1] == "�":
                     frontier -= 1
                 if st.req.stop:
                     # a stop string can straddle block boundaries: a future
@@ -734,9 +752,9 @@ class ContinuousScheduler:
                     hold = max((len(s) for s in st.req.stop if s),
                                default=1) - 1
                     frontier = min(frontier, len(text) - hold)
-            if frontier > sent:
-                self._on_tokens(st.req.request_id, text[sent:frontier])
-                self._streamed[st.req.request_id] = frontier
+            if frontier > len(sent) and text.startswith(sent):
+                self._on_tokens(st.req.request_id, text[len(sent):frontier])
+                self._streamed[st.req.request_id] = text[:frontier]
         if finished:
             finish = "stop" if (hit_eos or stop_hit) else "length"
             results[st.req.request_id] = GenerationResult(

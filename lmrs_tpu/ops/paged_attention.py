@@ -442,9 +442,12 @@ def paged_decode_multi_xla(
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Scatter + gather reference for the multi-token verify: same contract
     as ``paged_decode_pallas_multi`` on any platform (correctness baseline
-    + CPU fallback for the speculative verify forward).  Tokens at
-    positions >= ``max_pos`` redirect to the reserved null page (id 0) and
-    are masked out of every query's context."""
+    + CPU fallback for the speculative verify forward).  A token is
+    written ONLY when its position lies inside BOTH the table span (W*ps)
+    and ``max_pos`` — matching the kernel, which SKIPS out-of-span
+    windows; a clipped write would scribble real rows of the last tabled
+    page (the stale-length degenerate class).  Skipped writes park on the
+    reserved null page (id 0)."""
     b, t, h, hd = q.shape
     kh, _, ps, _ = k_pages.shape
     w = page_tables.shape[1]
@@ -453,10 +456,11 @@ def paged_decode_multi_xla(
     page = jnp.take_along_axis(
         page_tables, jnp.clip(pos // ps, 0, w - 1), axis=1)  # [B, T]
     off = pos % ps
+    in_span = pos < w * ps
     if max_pos is not None:
-        in_cap = pos < max_pos
-        page = jnp.where(in_cap, page, 0)  # overhang lands on the null page
-        off = jnp.where(in_cap, off, 0)
+        in_span &= pos < max_pos
+    page = jnp.where(in_span, page, 0)  # overhang lands on the null page
+    off = jnp.where(in_span, off, 0)
     k_pages = k_pages.at[:, page, off].set(k_new.transpose(2, 0, 1, 3))
     v_pages = v_pages.at[:, page, off].set(v_new.transpose(2, 0, 1, 3))
 

@@ -2,14 +2,16 @@
 # Hardware measurement battery — run top-to-bottom the moment a TPU answers.
 # Each stage gates the next (no point benching on a chip that fails parity).
 # Usage: bash scripts/chip_battery.sh [outdir]
-set -u
+set -u -o pipefail
 OUT=${1:-/tmp/chip_battery}
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
 echo "=== 1. kernel parity smoke (<60s) ==="
+# pipefail: the gate is the smoke's EXIT CODE — grepping '"ok": true' would
+# match the per-check fields even when the overall summary says false
 timeout 600 python scripts/tpu_smoke.py 2>&1 | tee "$OUT/smoke.log"
-grep -q '"ok": true' "$OUT/smoke.log" || { echo "SMOKE FAILED — stop"; exit 1; }
+[ "${PIPESTATUS[0]}" -eq 0 ] || { echo "SMOKE FAILED — stop"; exit 1; }
 
 echo "=== 2. decode fixed-cost/slope fit (kv-head fold ABBA target: 9.39ms -> <5ms fixed) ==="
 timeout 1200 python scripts/decode_split.py 2>&1 | tee "$OUT/decode_split.log"

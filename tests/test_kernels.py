@@ -352,3 +352,40 @@ def test_multi_token_verify_no_window_alias_at_table_edge():
                                   np.asarray(k_ref[:, 1:3]))
     np.testing.assert_array_equal(np.asarray(v_out[:, 1:3]),
                                   np.asarray(v_ref[:, 1:3]))
+
+
+def test_multi_token_verify_out_of_span_skips_on_both_paths():
+    """A degenerate row whose length exceeds the table span (stale-length
+    class) must write NOTHING on BOTH implementations — the XLA reference
+    previously clipped onto the last tabled page and scribbled real rows
+    (round-3 review finding); real pages must be untouched and the two
+    paths must agree."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla,
+        paged_decode_pallas_multi,
+    )
+
+    b, t, h, kh, hd, ps, n_pages = 2, 3, 4, 2, 128, 16, 8
+    rng = jax.random.split(jax.random.PRNGKey(21), 5)
+    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)  # span 32 tokens
+    # row 0 normal; row 1 claims 100 tokens — its whole T-token span lies
+    # past the table capacity, so no write may land anywhere real
+    kv_lens = jnp.asarray([10, 100], jnp.int32)
+
+    want, k_ref, v_ref = paged_decode_multi_xla(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens)
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # row 1's real pages (3, 4) untouched on BOTH paths
+    for pool_out, pool_in in ((k_ref, k_pages), (v_ref, v_pages),
+                              (k_out, k_pages), (v_out, v_pages)):
+        np.testing.assert_array_equal(np.asarray(pool_out[:, 3:5]),
+                                      np.asarray(pool_in[:, 3:5]))

@@ -491,3 +491,61 @@ def test_on_tokens_streaming_respects_stop_sequences():
     assert stop not in res.text
     assert "".join(got) == res.text
     eng.shutdown()
+
+
+def test_on_tokens_freezes_on_non_prefix_stable_decode():
+    """HF-style tokenizers can rewrite earlier characters as tokens arrive
+    (cleanup_tokenization_spaces): the stream must FREEZE — never emit
+    characters that later change — and the final result text stays
+    authoritative (round-3 review finding)."""
+    from lmrs_tpu.data.tokenizer import ByteTokenizer
+
+    class UnstableTokenizer(ByteTokenizer):
+        """Decodes normally until >8 ids, then rewrites the first char —
+        a caricature of HF cleanup's retroactive edits."""
+
+        def decode(self, ids):
+            text = super().decode(ids)
+            if len(list(ids)) > 8 and text:
+                return "#" + text[1:]
+            return text
+
+    mc = tiny_model()
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=20, max_batch_slots=1, seed=0,
+                                 decode_block=4), mc,
+                    tokenizer=UnstableTokenizer())
+    got: list[str] = []
+    res = eng.generate_batch(
+        [GenerationRequest(prompt="prefix stability probe", request_id=0,
+                           temperature=0.0, max_new_tokens=20)],
+        on_tokens=lambda rid, t: got.append(t))[0]
+    eng.shutdown()
+    assert res.error is None
+    streamed = "".join(got)
+    # the retroactive rewrite ('#' at position 0) appears in the FINAL text
+    # but must never have been streamed: the stream froze at the last
+    # stable prefix instead of emitting characters that later changed
+    assert res.text.startswith("#")
+    assert "#" not in streamed
+    assert streamed  # deltas did flow before the instability hit
+
+
+def test_max_new_clamped_to_context_window():
+    """A decode budget >= max_seq_len must clamp (a negative truncation
+    limit previously DUPLICATED the prompt middle or emptied it)."""
+    mc = tiny_model()  # max_seq_len 256
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=100000, max_batch_slots=1,
+                                 seed=0, decode_block=4), mc)
+    ids, max_new = eng._scheduler._encode(
+        GenerationRequest(prompt="x" * 500, request_id=0,
+                          max_new_tokens=100000))
+    assert max_new == mc.max_seq_len - 1
+    assert 1 <= len(ids) <= mc.max_seq_len - max_new
+    res = eng.generate_batch([
+        GenerationRequest(prompt="short", request_id=0, temperature=0.0,
+                          max_new_tokens=100000)])[0]
+    eng.shutdown()
+    assert res.error is None
+    assert res.completion_tokens <= mc.max_seq_len - 1
