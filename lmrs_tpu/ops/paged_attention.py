@@ -229,6 +229,9 @@ def _write_new_tokens_all_heads(
     (the caller passes the UNCLAMPED length, so the base position is
     always exact; a clamped length would slide the whole span backwards
     over real cache entries)."""
+    assert page_size % 8 == 0, (
+        "RMW window offsets are computed in 8-row units; a non-multiple "
+        f"page_size={page_size} would silently alias (scheduler gates this)")
     b = pl.program_id(0)
     length = kv_lens_ref[b]
     base = jnp.maximum(length - n_tokens, 0)  # first new token's position
@@ -255,7 +258,9 @@ def _write_new_tokens_all_heads(
 
     def read_copies(ki, wi, start, page):
         si = ki * n_win + wi
-        off = pl.ds(jax.lax.rem(start, page_size), 8)
+        # rem(start, ps) is 8-aligned (start = 8k, ps % 8 == 0) but Mosaic's
+        # divisibility prover can't see through rem; the w*8 form it can.
+        off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
         return (pltpu.make_async_copy(k_out.at[ki, page, off],
                                       k8_scr.at[ki, wi], wsem.at[si, 0]),
                 pltpu.make_async_copy(v_out.at[ki, page, off],
@@ -263,7 +268,9 @@ def _write_new_tokens_all_heads(
 
     def write_copies(ki, wi, start, page):
         si = ki * n_win + wi
-        off = pl.ds(jax.lax.rem(start, page_size), 8)
+        # rem(start, ps) is 8-aligned (start = 8k, ps % 8 == 0) but Mosaic's
+        # divisibility prover can't see through rem; the w*8 form it can.
+        off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
         return (pltpu.make_async_copy(k8_scr.at[ki, wi],
                                       k_out.at[ki, page, off], wsem.at[si, 0]),
                 pltpu.make_async_copy(v8_scr.at[ki, wi],

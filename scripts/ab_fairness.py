@@ -18,6 +18,8 @@ independent; run without the override to measure on a chip)
 
 from __future__ import annotations
 
+import _pathfix  # noqa: F401  (repo-root import shim)
+
 
 def main() -> None:
     from lmrs_tpu.utils.platform import honor_platform_env

@@ -3,6 +3,7 @@
 Two engines (params differ), alternating decode-heavy waves A B B A.
 Run: python scripts/ab_int8.py
 """
+import _pathfix  # noqa: F401  (repo-root import shim)
 import time
 
 import numpy as np
@@ -11,12 +12,7 @@ from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 
-import sys as _sys
-from pathlib import Path as _Path
-_sys.path.insert(0, str(_Path(__file__).parent))
 from _bench_common import wave
-
-
 
 
 def main():

@@ -4,6 +4,7 @@ One engine; sched._pack_prefill toggled between runs (both program families
 compile once).  Order A B B A per round; map-stage wall per arm.
 Run on the real chip: python scripts/ab_pack.py [max_new]
 """
+import _pathfix  # noqa: F401  (repo-root import shim)
 import sys
 import time
 
@@ -13,12 +14,7 @@ from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 
-import sys as _sys
-from pathlib import Path as _Path
-_sys.path.insert(0, str(_Path(__file__).parent))
 from _bench_common import wave
-
-
 
 
 def main():

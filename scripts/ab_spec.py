@@ -5,6 +5,7 @@ real checkpoint and is demonstrated separately on the trained tiny model).
 Three engines: speculate_k in {0, 4, 8}; interleaved A B C C B A waves.
 Run: python scripts/ab_spec.py
 """
+import _pathfix  # noqa: F401  (repo-root import shim)
 import time
 
 import numpy as np
@@ -13,12 +14,7 @@ from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 
-import sys as _sys
-from pathlib import Path as _Path
-_sys.path.insert(0, str(_Path(__file__).parent))
 from _bench_common import wave
-
-
 
 
 def main():

@@ -6,6 +6,8 @@ while a long prompt admits mid-stream, chunked (512) vs one-dispatch
 import os
 import time
 
+
+import _pathfix  # noqa: F401  (repo-root import shim)
 import numpy as np
 
 os.environ["LMRS_TRACE_DISPATCH"] = "1"

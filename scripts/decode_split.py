@@ -5,6 +5,8 @@ Run: python scripts/decode_split.py
 """
 import time
 
+
+import _pathfix  # noqa: F401  (repo-root import shim)
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -28,6 +28,8 @@ import json
 import sys
 import time
 
+import _pathfix  # noqa: F401  (repo-root import shim)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
