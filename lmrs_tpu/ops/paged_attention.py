@@ -39,6 +39,29 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _n_live_pages(page_tables_ref, kv_lens_ref, row, page_size):
+    """Live pages of ``row``, clamped to the table width: a row whose
+    length exceeds its table (e.g. an inactive row carrying a stale/garbage
+    length) must never index page_tables_ref out of bounds — SMEM reads are
+    not range-checked."""
+    return jnp.minimum(
+        jax.lax.div(kv_lens_ref[row] + page_size - 1, page_size),
+        page_tables_ref.shape[1],
+    )
+
+
+def _fetch_page(page_tables_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
+                row, ki, p, slot):
+    """Start the K+V page DMAs for (row, head ki, page index p) into
+    double-buffer ``slot``.  ONE shared implementation: the walk's
+    steady-state prefetches and the fused kernel's cross-row prime must
+    agree on the slot/semaphore layout or the next wait pairs with the
+    wrong DMA."""
+    page = page_tables_ref[row, p]
+    pltpu.make_async_copy(k_hbm.at[ki, page], k_scr.at[slot], sem.at[slot, 0]).start()
+    pltpu.make_async_copy(v_hbm.at[ki, page], v_scr.at[slot], sem.at[slot, 1]).start()
+
+
 # ------------------------------------------------------------ XLA fallback
 
 
@@ -94,6 +117,11 @@ def _ragged_decode_all_heads(
     n_rep_p: int = 0,   # rows per token (0 = single-token: all rows one group)
     n_tokens: int = 1,  # queries per row (speculative verify: k+1)
     max_pos: int | None = None,  # static cap: no position >= this is valid
+    row=None,           # batch row to walk (default: this program's row)
+    external_prime: bool = False,  # caller already DMA'd page 0 into slot 0
+    after_head=None,    # callback(ki) after head ki's page loop (cross-row
+                        # software pipelining: the fused kernel runs the NEXT
+                        # row's RMW cycle in these slots)
 ):
     """Walk every kv head's live pages for ONE batch row through a single
     double-buffered DMA pipeline.  The head loop is a static Python unroll
@@ -107,28 +135,22 @@ def _ragged_decode_all_heads(
     ``length - n_tokens + j`` and its rows attend positions < that + 1 —
     per-row causal limits over the SAME single page walk, so verifying
     k drafts costs one walk, not a full page-window gather."""
-    b = pl.program_id(0)
+    b = pl.program_id(0) if row is None else row
     length = kv_lens_ref[b]
-    # clamp to the table width: a row whose length exceeds its table (e.g.
-    # an inactive row carrying a stale/garbage length) must never index
-    # page_tables_ref out of bounds — SMEM reads are not range-checked
-    n_pages = jnp.minimum(
-        jax.lax.div(length + page_size - 1, page_size),
-        page_tables_ref.shape[1],
-    )
+    n_pages = _n_live_pages(page_tables_ref, kv_lens_ref, b, page_size)
 
     def fetch(ki, p, slot):
-        page = page_tables_ref[b, p]
-        pltpu.make_async_copy(k_hbm.at[ki, page], k_scr.at[slot], sem.at[slot, 0]).start()
-        pltpu.make_async_copy(v_hbm.at[ki, page], v_scr.at[slot], sem.at[slot, 1]).start()
+        _fetch_page(page_tables_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
+                    b, ki, p, slot)
 
     @pl.when(n_pages == 0)
     def _zero():  # inactive row: defined output, no page walk
         o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
 
-    @pl.when(n_pages > 0)
-    def _prime():
-        fetch(0, 0, 0)
+    if not external_prime:
+        @pl.when(n_pages > 0)
+        def _prime():
+            fetch(0, 0, 0)
 
     for ki in range(kh):
         base = ki * n_pages  # global step index of this head's first page
@@ -200,6 +222,157 @@ def _ragged_decode_all_heads(
             l = l_scr[:, :1]
             o_ref[ki] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
 
+        if after_head is not None:
+            after_head(ki)
+
+
+def _make_rmw(
+    page_tables_ref, kv_lens_ref,
+    get_knew,         # (row, ki) -> VMEM [t_pad, hd] the T new tokens' K
+    get_vnew,
+    k_out,            # ANY  [K, P, ps, hd] aliased pool
+    v_out,
+    k8_scr,           # VMEM [kh, n_win, 8, hd]
+    v8_scr,
+    wsem,             # DMA semaphores (kh * n_win, 2)
+    *,
+    page_size: int,
+    kh: int,
+    n_tokens: int,
+    t_pad: int,
+    hd: int,
+    max_pos: int | None = None,
+):
+    """Row-parametrized RMW scatter of T consecutive new tokens' K/V into
+    the page pool in place.  ``for_row(row)`` returns the three phases —
+    ``(start_reads, blend_write, drain)`` — so a caller can interleave one
+    row's RMW cycle with another row's page walk (the fused kernel runs row
+    b+1's cycle inside row b's walk: their pages are disjoint because slots
+    own their pages exclusively).  Exactly ONE cycle may be in flight at a
+    time (the phases share k8/v8 scratch and ``wsem``).
+
+    The positions are consecutive, so they cover at most
+    ``n_win = (T-2)//8 + 2`` aligned 8-row windows, and page_size % 8 == 0
+    means no window straddles a page — each (head, window) is one
+    read-blend-write RMW, reads all issued before any blend so the tiny
+    DMAs overlap.
+
+    ``max_pos`` (static): tokens at positions >= it are NOT written — the
+    max-seq-len cap for draft tokens that overhang the end of the cache
+    (the caller passes the UNCLAMPED length, so the base position is
+    always exact; a clamped length would slide the whole span backwards
+    over real cache entries)."""
+    assert page_size % 8 == 0, (
+        "RMW window offsets are computed in 8-row units; a non-multiple "
+        f"page_size={page_size} would silently alias (scheduler gates this)")
+    n_win = 1 if n_tokens == 1 else (n_tokens - 2) // 8 + 2
+
+    def for_row(b):
+        length = kv_lens_ref[b]
+        base = jnp.maximum(length - n_tokens, 0)  # first new token's position
+        win0 = jax.lax.div(base, 8) * 8  # provably 8-aligned
+        # A window is touched ONLY if it holds a valid token position.  An
+        # overhanging window (past the table span or max_pos) must be
+        # skipped entirely, not clipped: a clipped page index keeps the raw
+        # offset and can ALIAS an earlier window's rows when
+        # page_size <= 8*(n_win-1) (e.g. ps=8 with any draft span ending at
+        # the table edge) — its stale write-back would then revert the valid
+        # window's freshly written K/V.
+        limit = jnp.minimum(base + n_tokens,
+                            page_tables_ref.shape[1] * page_size)
+        if max_pos is not None:
+            limit = jnp.minimum(limit, max_pos)
+
+        def win_page(wi):
+            start = win0 + 8 * wi
+            page_idx = jnp.clip(jax.lax.div(start, page_size), 0,
+                                page_tables_ref.shape[1] - 1)
+            return start, page_tables_ref[b, page_idx]
+
+        def read_copies(ki, wi, start, page):
+            si = ki * n_win + wi
+            # rem(start, ps) is 8-aligned (start = 8k, ps % 8 == 0) but
+            # Mosaic's divisibility prover can't see through rem; the w*8
+            # form it can.
+            off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
+            return (pltpu.make_async_copy(k_out.at[ki, page, off],
+                                          k8_scr.at[ki, wi], wsem.at[si, 0]),
+                    pltpu.make_async_copy(v_out.at[ki, page, off],
+                                          v8_scr.at[ki, wi], wsem.at[si, 1]))
+
+        def write_copies(ki, wi, start, page):
+            si = ki * n_win + wi
+            off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
+            return (pltpu.make_async_copy(k8_scr.at[ki, wi],
+                                          k_out.at[ki, page, off], wsem.at[si, 0]),
+                    pltpu.make_async_copy(v8_scr.at[ki, wi],
+                                          v_out.at[ki, page, off], wsem.at[si, 1]))
+
+        def start_reads():
+            for ki in range(kh):
+                for wi in range(n_win):
+                    start, page = win_page(wi)
+
+                    @pl.when(start < limit)
+                    def _read(ki=ki, wi=wi, start=start, page=page):
+                        rk, rv = read_copies(ki, wi, start, page)
+                        rk.start()
+                        rv.start()
+
+        def blend_write():
+            for ki in range(kh):
+                for wi in range(n_win):
+                    start, page = win_page(wi)
+
+                    @pl.when(start < limit)
+                    def _blend(ki=ki, wi=wi, start=start, page=page):
+                        rk, rv = read_copies(ki, wi, start, page)
+                        wk, wv = write_copies(ki, wi, start, page)
+                        rk.wait()
+                        rv.wait()
+                        # row r of this window holds token j = start+r-base
+                        # when 0 <= j < T; select token rows with a tiny 0/1
+                        # matmul (no dynamic VMEM indexing) and blend where
+                        # a token lands
+                        row = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 0)
+                        tok = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 1)
+                        j = start + row - base
+                        valid = (j == tok) & (tok < n_tokens)
+                        if max_pos is not None:
+                            valid &= (start + row) < max_pos
+                        sel = valid.astype(jnp.float32)
+                        k_rows = jax.lax.dot_general(
+                            sel, get_knew(b, ki).astype(jnp.float32),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                        v_rows = jax.lax.dot_general(
+                            sel, get_vnew(b, ki).astype(jnp.float32),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                        hit = (jnp.sum(sel, axis=1, keepdims=True) > 0)
+                        hit = jnp.broadcast_to(hit, (8, hd))
+                        k8_scr[ki, wi] = jnp.where(
+                            hit, k_rows.astype(k8_scr.dtype), k8_scr[ki, wi])
+                        v8_scr[ki, wi] = jnp.where(
+                            hit, v_rows.astype(v8_scr.dtype), v8_scr[ki, wi])
+                        wk.start()
+                        wv.start()
+
+        def drain():
+            for ki in range(kh):
+                for wi in range(n_win):
+                    start, page = win_page(wi)
+
+                    @pl.when(start < limit)
+                    def _drain(ki=ki, wi=wi, start=start, page=page):
+                        wk, wv = write_copies(ki, wi, start, page)
+                        wk.wait()
+                        wv.wait()
+
+        return start_reads, blend_write, drain
+
+    return for_row
+
 
 def _write_new_tokens_all_heads(
     page_tables_ref, kv_lens_ref,
@@ -216,120 +389,20 @@ def _write_new_tokens_all_heads(
     n_tokens: int,
     max_pos: int | None = None,
 ):
-    """Scatter T consecutive new tokens' K/V (speculative verify: the
-    carried token + k drafts at positions length-T .. length-1) into the
-    page pool in place.  The positions are consecutive, so they cover at
-    most ``n_win = (T-2)//8 + 2`` aligned 8-row windows, and page_size %
-    8 == 0 (scheduler kernel gate) means no window straddles a page —
-    each (head, window) is one read-blend-write RMW, reads all issued
-    before any blend so the tiny DMAs overlap.
-
-    ``max_pos`` (static): tokens at positions >= it are NOT written — the
-    max-seq-len cap for draft tokens that overhang the end of the cache
-    (the caller passes the UNCLAMPED length, so the base position is
-    always exact; a clamped length would slide the whole span backwards
-    over real cache entries)."""
-    assert page_size % 8 == 0, (
-        "RMW window offsets are computed in 8-row units; a non-multiple "
-        f"page_size={page_size} would silently alias (scheduler gates this)")
-    b = pl.program_id(0)
-    length = kv_lens_ref[b]
-    base = jnp.maximum(length - n_tokens, 0)  # first new token's position
-    n_win = 1 if n_tokens == 1 else (n_tokens - 2) // 8 + 2
-    t_pad = knew_ref.shape[1]
-    hd = knew_ref.shape[-1]
-    win0 = jax.lax.div(base, 8) * 8  # provably 8-aligned
-    # A window is touched ONLY if it holds a valid token position.  An
-    # overhanging window (past the table span or max_pos) must be skipped
-    # entirely, not clipped: a clipped page index keeps the raw offset and
-    # can ALIAS an earlier window's rows when page_size <= 8*(n_win-1)
-    # (e.g. ps=8 with any draft span ending at the table edge) — its stale
-    # write-back would then revert the valid window's freshly written K/V.
-    limit = jnp.minimum(base + n_tokens,
-                        page_tables_ref.shape[1] * page_size)
-    if max_pos is not None:
-        limit = jnp.minimum(limit, max_pos)
-
-    def win_page(wi):
-        start = win0 + 8 * wi
-        page_idx = jnp.clip(jax.lax.div(start, page_size), 0,
-                            page_tables_ref.shape[1] - 1)
-        return start, page_tables_ref[b, page_idx]
-
-    def read_copies(ki, wi, start, page):
-        si = ki * n_win + wi
-        # rem(start, ps) is 8-aligned (start = 8k, ps % 8 == 0) but Mosaic's
-        # divisibility prover can't see through rem; the w*8 form it can.
-        off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
-        return (pltpu.make_async_copy(k_out.at[ki, page, off],
-                                      k8_scr.at[ki, wi], wsem.at[si, 0]),
-                pltpu.make_async_copy(v_out.at[ki, page, off],
-                                      v8_scr.at[ki, wi], wsem.at[si, 1]))
-
-    def write_copies(ki, wi, start, page):
-        si = ki * n_win + wi
-        # rem(start, ps) is 8-aligned (start = 8k, ps % 8 == 0) but Mosaic's
-        # divisibility prover can't see through rem; the w*8 form it can.
-        off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
-        return (pltpu.make_async_copy(k8_scr.at[ki, wi],
-                                      k_out.at[ki, page, off], wsem.at[si, 0]),
-                pltpu.make_async_copy(v8_scr.at[ki, wi],
-                                      v_out.at[ki, page, off], wsem.at[si, 1]))
-
-    for ki in range(kh):
-        for wi in range(n_win):
-            start, page = win_page(wi)
-
-            @pl.when(start < limit)
-            def _read(ki=ki, wi=wi, start=start, page=page):
-                rk, rv = read_copies(ki, wi, start, page)
-                rk.start()
-                rv.start()
-    for ki in range(kh):
-        for wi in range(n_win):
-            start, page = win_page(wi)
-
-            @pl.when(start < limit)
-            def _blend(ki=ki, wi=wi, start=start, page=page):
-                rk, rv = read_copies(ki, wi, start, page)
-                wk, wv = write_copies(ki, wi, start, page)
-                rk.wait()
-                rv.wait()
-                # row r of this window holds token j = start + r - base when
-                # 0 <= j < T; select token rows with a tiny 0/1 matmul (no
-                # dynamic VMEM indexing) and blend where a token lands
-                row = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 0)
-                tok = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 1)
-                j = start + row - base
-                valid = (j == tok) & (tok < n_tokens)
-                if max_pos is not None:
-                    valid &= (start + row) < max_pos
-                sel = valid.astype(jnp.float32)
-                k_rows = jax.lax.dot_general(
-                    sel, knew_ref[ki].astype(jnp.float32),
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                v_rows = jax.lax.dot_general(
-                    sel, vnew_ref[ki].astype(jnp.float32),
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                hit = (jnp.sum(sel, axis=1, keepdims=True) > 0)
-                hit = jnp.broadcast_to(hit, (8, hd))
-                k8_scr[ki, wi] = jnp.where(hit, k_rows.astype(k8_scr.dtype),
-                                           k8_scr[ki, wi])
-                v8_scr[ki, wi] = jnp.where(hit, v_rows.astype(v8_scr.dtype),
-                                           v8_scr[ki, wi])
-                wk.start()
-                wv.start()
-    for ki in range(kh):
-        for wi in range(n_win):
-            start, page = win_page(wi)
-
-            @pl.when(start < limit)
-            def _drain(ki=ki, wi=wi, start=start, page=page):
-                wk, wv = write_copies(ki, wi, start, page)
-                wk.wait()
-                wv.wait()
+    """One whole RMW cycle for this program's own row (the multi-token
+    verify kernel's path; the fused decode kernel uses ``_make_rmw``
+    directly to pipeline the cycle across grid iterations)."""
+    rmw = _make_rmw(
+        page_tables_ref, kv_lens_ref,
+        lambda _row, ki: knew_ref[ki], lambda _row, ki: vnew_ref[ki],
+        k_out, v_out, k8_scr, v8_scr, wsem,
+        page_size=page_size, kh=kh, n_tokens=n_tokens,
+        t_pad=knew_ref.shape[1], hd=knew_ref.shape[-1], max_pos=max_pos,
+    )
+    start_reads, blend_write, drain = rmw(pl.program_id(0))
+    start_reads()
+    blend_write()
+    drain()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "max_pos"))
@@ -518,14 +591,25 @@ def paged_decode_pallas_fused(
     # pad the singleton row dim to 8 for sublane alignment (see n_rep_p)
     knew = jnp.broadcast_to(k_new[:, :, None], (b, kh, 8, hd))
     vnew = jnp.broadcast_to(v_new[:, :, None], (b, kh, 8, hd))
+    # knew/vnew live whole in VMEM (the cross-row RMW needs the next row's
+    # slice — see in_specs) so their footprint scales with batch; keep it
+    # well under the ~16 MiB core budget alongside the page scratch
+    new_tok_bytes = 2 * b * kh * 8 * hd * k_pages.dtype.itemsize
+    assert new_tok_bytes <= 4 * 1024 * 1024, (
+        f"fused decode keeps all rows' new-token K/V in VMEM "
+        f"({new_tok_bytes/2**20:.1f} MiB at B={b}, kh={kh}, hd={hd}); "
+        "shard the batch or lower max_batch_slots")
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, kh, 8, hd), lambda bi, *_: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, kh, 8, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            # knew/vnew map as ONE whole-array block (constant index map):
+            # iteration b runs row b+1's RMW cycle mid-walk, so it must read
+            # the NEXT row's slice — a per-row block can't cross iterations
+            pl.BlockSpec((b, kh, 8, hd), lambda bi, *_: (0, 0, 0, 0)),
+            pl.BlockSpec((b, kh, 8, hd), lambda bi, *_: (0, 0, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -550,18 +634,72 @@ def paged_decode_pallas_fused(
     def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
                o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
                k8_scr, v8_scr, sem, wsem):
-        # the new token's K/V must land before the walk reads its page
-        # (n_tokens=1 degenerate of the multi-token writer — one shared
-        # RMW implementation; a stale length past the table span now
-        # SKIPS the write instead of scribbling a clipped page)
-        _write_new_tokens_all_heads(
-            pt_ref, len_ref, knew_ref.at[0], vnew_ref.at[0], k_out, v_out,
-            k8_scr, v8_scr, wsem, page_size=ps, kh=kh, n_tokens=1,
+        # Cross-row software pipeline (round 3, after the kv-head fold):
+        # the fixed decode cost was measured at ~7.7 us per batch row —
+        # dominated by each grid iteration serializing RMW-write -> drain ->
+        # walk and by the walk's first-page DMA stall.  Rows' pages are
+        # DISJOINT (slots own their pages exclusively), so iteration b now:
+        #   1. walks row b (its first page was DMA'd by iteration b-1),
+        #   2. runs row b+1's RMW cycle between head loops (reads after
+        #      head 0, blend+write after head 1, drain after the last head
+        #      — each phase's DMA latency hides behind page streaming),
+        #   3. primes row b+1's first page fetch (safe: the RMW for b+1
+        #      drained in step 2, so even a 1-page row reads fresh K/V).
+        # Iteration 0 bootstraps its own RMW + prime inline.  Exactly one
+        # RMW cycle is in flight at a time, so the shared scratch/sems are
+        # race-free; the n_tokens=1 degenerate of the multi-token writer
+        # keeps one shared RMW implementation.
+        nb = pl.num_programs(0)
+        bi = pl.program_id(0)
+        rmw = _make_rmw(
+            pt_ref, len_ref,
+            lambda row, ki: knew_ref[row, ki], lambda row, ki: vnew_ref[row, ki],
+            k_out, v_out, k8_scr, v8_scr, wsem,
+            page_size=ps, kh=kh, n_tokens=1, t_pad=8, hd=hd,
         )
+        nxt = bi + 1
+        # clamp for closure creation only: for_row's scalar SMEM reads trace
+        # unguarded at kernel top level, and nxt == nb at the last iteration
+        # would read past len_ref; the pl.when guards below keep the phases
+        # from EXECUTING there, the clamp keeps the reads in bounds
+        nxt_reads, nxt_blend, nxt_drain = rmw(jnp.minimum(nxt, nb - 1))
+
+        def prime_row(row):
+            # same fetch layout as the walk's body: the wait at the next
+            # iteration's step 0 is fetch(head 0, page 0, slot 0)
+            @pl.when(_n_live_pages(pt_ref, len_ref, row, ps) > 0)
+            def _():
+                _fetch_page(pt_ref, k_out, v_out, k_scr, v_scr, sem,
+                            row, 0, 0, 0)
+
+        @pl.when(bi == 0)
+        def _bootstrap():
+            sr, bw, dr = rmw(0)
+            sr()
+            bw()
+            dr()
+            prime_row(0)
+
+        def after_head(ki):
+            if ki == 0:
+                @pl.when(nxt < nb)
+                def _():
+                    nxt_reads()
+            if ki == min(1, kh - 1):
+                @pl.when(nxt < nb)
+                def _():
+                    nxt_blend()
+            if ki == kh - 1:
+                @pl.when(nxt < nb)
+                def _():
+                    nxt_drain()
+                    prime_row(nxt)
+
         _ragged_decode_all_heads(
             pt_ref, len_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
             k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
             page_size=ps, sm_scale=hd**-0.5, kh=kh,
+            external_prime=True, after_head=after_head,
         )
 
     out, k_pages, v_pages = pl.pallas_call(
