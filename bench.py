@@ -228,15 +228,16 @@ def run_bench() -> tuple[float, dict]:
         # dispatch), prefill_chunk > max prompt (one fresh dispatch,
         # packed), page_size 512 (decode was DMA-latency-bound on page
         # fetches), num_pages=1 -> worst-case pool floor sizing.
-        # quantize="int8": ABBA-measured +5.9% on decode-heavy waves at
-        # this scale (weight stream halves; docs/PERF.md round 2).  The
-        # LIBRARY default stays bf16 — weight-only int8 is a quality
-        # tradeoff a throughput bench need not pay but a user must opt
-        # into.
+        # quantize="int8": ABBA-measured +5.9-7.1% on decode-heavy waves at
+        # this scale (weight stream halves; docs/PERF.md round 2/3).
+        # kv_quantize="int8": +3.9% more (KV walk bytes halve, capacity
+        # doubles; docs/PERF.md round 3).  The LIBRARY defaults stay bf16 —
+        # int8 weights/KV are quality tradeoffs a throughput bench need not
+        # pay but a user must opt into.
         engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=24,
                             retry_delay=0.0, seed=0, page_size=512,
                             num_pages=1, decode_block=128, prefill_chunk=4096,
-                            quantize="int8"),
+                            quantize="int8", kv_quantize="int8"),
         model=model,
         reduce=ReduceConfig(max_tokens_per_batch=6000),
     )

@@ -73,6 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true", help="emit jax.profiler spans")
     p.add_argument("--quantize", default=None, choices=["int8"],
                    help="weight-only quantization for the jax backend")
+    p.add_argument("--kv-quantize", default=None, choices=["int8"],
+                   help="int8 KV-cache pages (halves decode KV bytes, "
+                        "doubles tokens per HBM GiB; page_size %% 32 == 0)")
     p.add_argument("--speculate-k", type=int, default=None,
                    help="prompt-lookup speculative decoding draft length "
                         "(0 = off; output distribution is unchanged)")
@@ -91,6 +94,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, max_concurrent_requests=args.max_concurrent_requests)
     if args.quantize:
         engine = dataclasses.replace(engine, quantize=args.quantize)
+    if args.kv_quantize:
+        engine = dataclasses.replace(engine, kv_quantize=args.kv_quantize)
     if args.speculate_k is not None:
         engine = dataclasses.replace(engine, speculate_k=args.speculate_k)
     if args.tokenizer and args.tokenizer != "approx":

@@ -155,6 +155,11 @@ class EngineConfig:
     speculate_k: int = 0
     checkpoint_path: str | None = None
     quantize: str | None = None  # None | "int8" (weight-only; ops/quant.py)
+    # int8 KV-cache pages (ops/quant.py KV section): halves decode's KV
+    # bytes and doubles tokens per HBM GiB; per-slot/head/channel scales
+    # fixed at prefill.  Gates packed + ring prefill off (per-slot scales
+    # can't cover a packed row's many prompts / sp-sharded writes).
+    kv_quantize: str | None = None  # None | "int8"
     # engine-side tokenizer spec ("" = model default: byte for random-init
     # vocabs, the checkpoint's tokenizer for real ones).  Accepts the same
     # forms as data.tokenizer.get_tokenizer: "byte", a *.model SentencePiece
@@ -168,6 +173,9 @@ class EngineConfig:
             self.backend = "mock"
         if self.quantize not in (None, "int8"):
             raise ValueError(f"unknown quantize mode {self.quantize!r}; "
+                             "supported: int8")
+        if self.kv_quantize not in (None, "int8"):
+            raise ValueError(f"unknown kv_quantize mode {self.kv_quantize!r}; "
                              "supported: int8")
 
 

@@ -108,12 +108,15 @@ class PagedKVCache:
 
     def __init__(self, model_cfg: ModelConfig, num_pages: int, page_size: int,
                  max_pages_per_slot: int, allocator: PageAllocator | None = None,
-                 mesh=None):
+                 mesh=None, kv_dtype: str | None = None):
         hd = model_cfg.hd
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_pages_per_slot = max_pages_per_slot
-        dt = jnp.dtype(model_cfg.dtype)
+        # int8 pools (EngineConfig.kv_quantize): half the bytes per streamed
+        # page and double the tokens per HBM GiB; scales are scheduler-owned
+        # (ops/quant.py KV section)
+        dt = jnp.dtype(kv_dtype) if kv_dtype else jnp.dtype(model_cfg.dtype)
         shape = (model_cfg.n_kv_heads, model_cfg.n_layers * num_pages,
                  page_size, hd)
         if mesh is not None:

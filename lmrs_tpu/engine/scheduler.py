@@ -124,8 +124,25 @@ class ContinuousScheduler:
             num_pages = max(engine_cfg.num_pages, max_pages_per_slot + 1)
         else:
             num_pages = self.B * max_pages_per_slot + 1
+        # int8 KV pages (EngineConfig.kv_quantize): per-(slot, kv head,
+        # channel) scales fixed at prefill ride [L, B, K, hd] buffers
+        # through the dispatch programs (ops/quant.py KV section)
+        self._kv_quant = engine_cfg.kv_quantize
+        if self._kv_quant and ps % 32:
+            # int8 VMEM tiles are (32, 128): the RMW window machinery needs
+            # 32-row-aligned windows that never straddle a page
+            raise ValueError(f"kv_quantize=int8 needs page_size % 32 == 0 "
+                             f"(got {ps})")
         self.cache = PagedKVCache(model_cfg, num_pages, ps, max_pages_per_slot,
-                                  mesh=mesh)
+                                  mesh=mesh,
+                                  kv_dtype="int8" if self._kv_quant else None)
+        if self._kv_quant:
+            sshape = (model_cfg.n_layers, self.B, model_cfg.n_kv_heads,
+                      model_cfg.hd)
+            self.kscale = jnp.ones(sshape, jnp.float32)
+            self.vscale = jnp.ones(sshape, jnp.float32)
+        else:
+            self.kscale = self.vscale = None
         # LMRS_FORCE_KERNELS=interpret: run the Pallas kernels in interpret
         # mode regardless of platform — the CPU-mesh test path for the
         # shard_map-wrapped kernels (tests can't see a real TPU)
@@ -142,6 +159,11 @@ class ContinuousScheduler:
         # (measured ~43% padded q rows at the bench shape).  LMRS_PACK_PREFILL=0
         # restores per-prompt prefill for A/B measurement.
         self._pack_prefill = os.environ.get("LMRS_PACK_PREFILL", "1") != "0"
+        if self._kv_quant:
+            # a packed row holds MANY prompts; per-slot scales can't cover it
+            # (packing measured neutral-to-+8%, docs/PERF.md round 2 — int8
+            # KV's halved decode bytes outweigh it on decode-bound runs)
+            self._pack_prefill = False
         # Serving-side context parallelism (SURVEY.md §5.7 tier b): under an
         # sp>1 mesh, LONG fresh prefills run cache-aware ring attention —
         # the sequence shards over sp, K/V still scatter into the page pool.
@@ -153,6 +175,14 @@ class ContinuousScheduler:
         # long-prompt strategy.
         self._sp = 1 if mesh is None else mesh.shape.get("sp", 1)
         self._use_ring = self._sp > 1
+        if self._kv_quant and self._use_ring:
+            raise ValueError(
+                "kv_quantize=int8 does not support ring (sp) prefill yet: "
+                "scales are per-slot and ring writes are sequence-sharded")
+        if self._kv_quant and self.spec_k:
+            raise ValueError(
+                "kv_quantize=int8 does not support speculative decoding "
+                "yet (the multi-token verify runs the bf16 kernel)")
         self._ring_min = 1024
         # Fail fast at construction: ring buckets are rounded UP to a
         # multiple of sp at dispatch, which stays <= max_len only when
@@ -560,11 +590,16 @@ class ContinuousScheduler:
                     table, jax.random.PRNGKey(7), ones,
                     jnp.zeros((1,), jnp.int32), ones)
             k, v = self.cache.k, self.cache.v
-            tok0, k, v = fn(self.params, k, v, *args)  # warm/compile
+            # scale_rows = B: the probe's scale scatter is dropped (its rows
+            # are not real slots), but the donated buffers must be carried
+            srow = jnp.full((1,), self.B, jnp.int32)
+            tok0, k, v, self.kscale, self.vscale = fn(
+                self.params, k, v, self.kscale, self.vscale, srow, *args)
             np.asarray(jax.device_get(tok0))
             t0 = time.time()
             for _ in range(prefill_reps):
-                tok0, k, v = fn(self.params, k, v, *args)
+                tok0, k, v, self.kscale, self.vscale = fn(
+                    self.params, k, v, self.kscale, self.vscale, srow, *args)
             np.asarray(jax.device_get(tok0))
             per_prefill = max((time.time() - t0 - rtt) / prefill_reps, 1e-9)
             self.cache.k, self.cache.v = k, v
@@ -612,11 +647,16 @@ class ContinuousScheduler:
                      jnp.zeros((B,), jnp.int32), onesB)
             dfn = self._get_decode_fn(w)
             k, v = self.cache.k, self.cache.v
-            toks, n_valid, k, v = dfn(self.params, k, v, *dargs)  # warm
+            srowsd = jnp.arange(self.B, dtype=jnp.int32)
+            toks, n_valid, k, v = dfn(
+                self.params, k, v, self.kscale, self.vscale, srowsd,
+                *dargs)  # warm
             np.asarray(jax.device_get(n_valid))
             t0 = time.time()
             for _ in range(decode_reps):
-                toks, n_valid, k, v = dfn(self.params, k, v, *dargs)
+                toks, n_valid, k, v = dfn(
+                    self.params, k, v, self.kscale, self.vscale, srowsd,
+                    *dargs)
             np.asarray(jax.device_get(n_valid))
             wall = time.time() - t0 - rtt
             self.cache.k, self.cache.v = k, v
@@ -626,7 +666,8 @@ class ContinuousScheduler:
 
         per_step = max(wall / (decode_reps * self.decode_block), 1e-9)
         step_bytes = decode_step_bytes(cfg_m, rows * live,
-                                       quantized=bool(self.cfg.quantize))
+                                       quantized=bool(self.cfg.quantize),
+                                       kv_quantized=bool(self._kv_quant))
         out["decode_tokens_per_sec"] = round(rows / per_step, 1)
         out["decode_step_ms"] = round(per_step * 1e3, 3)
         out["hbm_bw_utilization"] = round(
@@ -861,6 +902,10 @@ class ContinuousScheduler:
             temps = np.ones((n,), np.float32)
             tks = np.zeros((n,), np.int32)
             tps = np.ones((n,), np.float32)
+            # dispatch row -> slot id for the scale buffers: pad rows point
+            # one past the end (scatter drops them, gather clamps — their
+            # writes land on the null page anyway)
+            srows = np.full((n,), self.B, np.int32)
             table[: len(items)] = self.cache.page_table_array(
                 [st.seq for _, st, _, _, _ in items])
             for row, (b, st, chunk, pos, _) in enumerate(items):
@@ -871,11 +916,13 @@ class ContinuousScheduler:
                 temps[row] = st.req.temperature
                 tks[row] = st.req.top_k
                 tps[row] = min(max(st.req.top_p, 0.0), 1.0)
+                srows[row] = b
                 st.prefill_pos = pos + len(chunk)
                 self.metrics["prefill_tokens"] += len(chunk)
             self._key, sub = jax.random.split(self._key)
             args = (
                 self.params, self.cache.k, self.cache.v,
+                self.kscale, self.vscale, jnp.asarray(srows),
                 jnp.asarray(tokens), jnp.asarray(start), jnp.asarray(length),
                 jnp.asarray(alloc), jnp.asarray(table[:, :w]), sub,
                 jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
@@ -884,7 +931,8 @@ class ContinuousScheduler:
             try:
                 fn = (self._get_prefill_fn(s_bucket, use_ring=ring) if fresh
                       else self._get_prefill_window_fn(s_bucket, w))
-                tok0, self.cache.k, self.cache.v = fn(*args)
+                tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
+                    fn(*args)
             except Exception:
                 # compile-time lowering failure of the flash prefill kernel:
                 # rebuild without it and retry (cache buffers were not yet
@@ -900,7 +948,8 @@ class ContinuousScheduler:
                 self._packed_prefill_fns.clear()
                 fn = (self._get_prefill_fn(s_bucket, use_ring=ring) if fresh
                       else self._get_prefill_window_fn(s_bucket, w))
-                tok0, self.cache.k, self.cache.v = fn(*args)
+                tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
+                    fn(*args)
             self._ran_ok.add(key_)
             rows = [(b, row) for row, (b, _, _, _, is_final) in enumerate(items)
                     if is_final]
@@ -1037,14 +1086,16 @@ class ContinuousScheduler:
         use_flash = self._use_flash  # captured: rebuilt fns see the fallback
         mesh_ = self._kernel_mesh()
         interp = self._interpret
+        kv_q = bool(self._kv_quant)
         if use_ring and s_bucket % self._sp:
             raise ValueError(
                 f"ring prefill bucket {s_bucket} not divisible by "
                 f"sp={self._sp} — dispatch must round ring buckets up")
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, k_pages, v_pages, tokens, start, length,
-                    alloc_tokens, table, key, temp, tk, tp):
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4) if kv_q else (1, 2))
+        def prefill(params, k_pages, v_pages, kscale, vscale, scale_rows,
+                    tokens, start, length, alloc_tokens, table, key, temp,
+                    tk, tp):
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1])[None], tokens.shape)
             # Padded tail positions can exceed this sequence's allocated
@@ -1052,14 +1103,18 @@ class ContinuousScheduler:
             # the owned region — garbage there is masked by kv_lens, whereas
             # an out-of-table write would corrupt another sequence's page.
             write_pos = jnp.minimum(positions, alloc_tokens[:, None] - 1)
-            logits, k_pages, v_pages = forward_paged(
+            out = forward_paged(
                 params, cfg, tokens, write_pos, k_pages, v_pages, table,
                 length, rope_max, use_ragged_kernel=False, use_flash=use_flash,
                 mesh=mesh_, interpret=interp, use_ring=use_ring,
                 last_pos=length - 1,  # LM head on the sampled row only
+                kv_scales=(kscale, vscale) if kv_q else None,
+                scale_rows=scale_rows,
             )
+            logits, k_pages, v_pages = out[:3]
+            kscale, vscale = out[3] if kv_q else (None, None)
             tok0 = sample_logits(logits[:, 0], key, temp, tk, tp)
-            return tok0, k_pages, v_pages
+            return tok0, k_pages, v_pages, kscale, vscale
 
         logger.info("compiling paged prefill: bucket=%d (flash=%s ring=%s)",
                     s_bucket, use_flash, use_ring)
@@ -1074,21 +1129,27 @@ class ContinuousScheduler:
             return self._prefill_window_fns[key_]
         cfg = self.model_cfg
         rope_max = self.max_len
+        kv_q = bool(self._kv_quant)
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_chunk(params, k_pages, v_pages, tokens, start, length,
-                          alloc_tokens, table, key, temp, tk, tp):
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4) if kv_q else (1, 2))
+        def prefill_chunk(params, k_pages, v_pages, kscale, vscale,
+                          scale_rows, tokens, start, length, alloc_tokens,
+                          table, key, temp, tk, tp):
             positions = start[:, None] + jnp.broadcast_to(
                 jnp.arange(tokens.shape[1])[None], tokens.shape)
             write_pos = jnp.minimum(positions, alloc_tokens[:, None] - 1)
-            logits, k_pages, v_pages = forward_paged(
+            out = forward_paged(
                 params, cfg, tokens, write_pos, k_pages, v_pages, table,
                 start + length, rope_max, use_ragged_kernel=False,
                 window_prefill=True,
                 last_pos=length - 1,  # local row index within this chunk
+                kv_scales=(kscale, vscale) if kv_q else None,
+                scale_rows=scale_rows,
             )
+            logits, k_pages, v_pages = out[:3]
+            kscale, vscale = out[3] if kv_q else (None, None)
             tok0 = sample_logits(logits[:, 0], key, temp, tk, tp)
-            return tok0, k_pages, v_pages
+            return tok0, k_pages, v_pages, kscale, vscale
 
         logger.info("compiling chunked prefill: bucket=%d window=%d pages",
                     s_bucket, w)
@@ -1157,8 +1218,16 @@ class ContinuousScheduler:
             src = tok0_dev[jnp.asarray(np.array([r for _, r in prows], np.int32))]
             lt = lt.at[idx].set(src)
         self._key, sub = jax.random.split(self._key)
+        # dispatch row -> slot for the KV scale buffers (compact-batch rows
+        # are a gathered subset of slots; pad rows clamp harmlessly)
+        if bc < B:
+            srows = np.full((bc,), B, np.int32)
+            srows[: len(rows)] = rows
+        else:
+            srows = np.arange(B, dtype=np.int32)
         args = (
             self.params, self.cache.k, self.cache.v,
+            self.kscale, self.vscale, jnp.asarray(srows),
             lt, jnp.asarray(kv_lens),
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
@@ -1203,18 +1272,23 @@ class ContinuousScheduler:
         mesh_ = self._kernel_mesh()
         interp = self._interpret
 
+        kv_q = bool(self._kv_quant)
+
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode(params, k_pages, v_pages, last_tok, kv_lens, table, active,
-                   key, temps, tk, tp):
+        def decode(params, k_pages, v_pages, kscale, vscale, scale_rows,
+                   last_tok, kv_lens, table, active, key, temps, tk, tp):
             def step(carry, _):
                 k_pages, v_pages, tok, lens, done, key = carry
                 pos = jnp.minimum(lens, max_len - 1)[:, None]
-                logits, k_pages, v_pages = forward_paged(
+                out = forward_paged(
                     params, cfg, tok[:, None], pos, k_pages, v_pages, table,
                     jnp.minimum(lens + 1, max_len), rope_max,
                     use_ragged_kernel=use_ragged,
                     mesh=mesh_, interpret=interp,
+                    kv_scales=(kscale, vscale) if kv_q else None,
+                    scale_rows=scale_rows if kv_q else None,
                 )
+                logits, k_pages, v_pages = out[:3]
                 key, sub = jax.random.split(key)
                 nxt = sample_logits(logits[:, 0], sub, temps, tk, tp)
                 nxt = jnp.where(done, eos_id, nxt)

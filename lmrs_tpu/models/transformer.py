@@ -325,12 +325,22 @@ def forward_paged(
     use_ring: bool = False,  # sp-mesh fresh prefill: ring attention over sp
     last_pos: jnp.ndarray | None = None,  # [B] per-row last-token index
     multi_decode: bool = False,  # speculative verify: S tokens, ragged walk
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    kv_scales: tuple | None = None,  # (kscale, vscale) [L, Bs, K, hd] f32:
+                                     # int8 KV pools (ops/quant.py KV section)
+    scale_rows: jnp.ndarray | None = None,  # [B] dispatch row -> slot id
+                                            # (None: rows ARE slots); >= Bs
+                                            # rows are pads (updates dropped)
+) -> tuple:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
-    Returns (logits [B,S,V] f32, k_pages, v_pages).  K/V of `tokens` are
-    scattered into the pages named by ``page_tables`` at
-    (page_tables[b, pos//ps], pos%ps).
+    Returns (logits [B,S,V] f32, k_pages, v_pages) — plus a fourth element
+    ``(kscale, vscale)`` (the updated scale buffers) when ``kv_scales`` is
+    given.  K/V of `tokens` are scattered into the pages named by
+    ``page_tables`` at (page_tables[b, pos//ps], pos%ps); with
+    ``kv_scales`` the pools are int8 and the scattered rows quantize with
+    the dispatch rows' per-(slot, kv head, channel) scales — owned by the
+    prompt's FIRST prefill dispatch (fresh, or the start==0 window chunk),
+    reused and clamped to by everything after.
 
     Prefill (S>1, fresh sequence starting at position 0) attends the current
     tokens directly (flash path eligible); decode (S==1) attends the paged
@@ -374,6 +384,14 @@ def forward_paged(
         paged_decode_pallas_multi,
         paged_decode_xla,
     )
+    from lmrs_tpu.ops.quant import kv_dequant, kv_quant, kv_scale_from
+
+    if kv_scales is not None:
+        # int8 KV: the scheduler gates packing and ring off (per-slot scales
+        # don't cover a packed row's many prompts / sp-sharded writes)
+        assert segment_ids is None and not use_ring, (
+            "int8 KV pools are incompatible with packed/ring prefill "
+            "(scheduler gates these off)")
 
     dt = _dtype(cfg)
     b, s = tokens.shape
@@ -404,7 +422,13 @@ def forward_paged(
         # stacked scan output or a slice/update round trip moves the whole
         # pool (or a whole layer slice) every decode step — measured linear
         # in pool size; this layout moves only the tokens written.
-        x, kp_all, vp_all = carry  # pools: [K, L*P, ps, hd]
+        # With int8 pools the per-(slot, kv head, channel) scales ride the
+        # carry too (tiny): layer li reads/updates slice [li].
+        if kv_scales is not None:
+            x, kp_all, vp_all, ksc, vsc = carry
+        else:
+            x, kp_all, vp_all = carry  # pools: [K, L*P, ps, hd]
+            ksc = vsc = None
         lp, li = xs  # layer params, layer index
         g_page_idx = li * n_pool + page_idx      # [B, S] global page ids
         g_tables = li * n_pool + page_tables     # [B, W]
@@ -412,6 +436,38 @@ def forward_paged(
         q, k, v = qkv_proj(lp, cfg, h)
         q = apply_rope(q, positions, sin, cos)
         k = apply_rope(k, positions, sin, cos)
+
+        row_scales = None  # (k_scale, v_scale) [B, K, hd] for THIS dispatch
+        if kv_scales is not None:
+            is_fresh = (not is_decode and not window_prefill
+                        and not multi_decode)
+            if is_fresh or window_prefill:
+                # a prefill OWNS its slots' scales when it is the prompt's
+                # FIRST tokens: one-dispatch fresh prefill always, a window
+                # (chunked) dispatch only for rows whose chunk starts at
+                # position 0 — later chunks reuse (and clamp to) the first
+                # chunk's scales, since written pages can't be requantized
+                chunk_len = (kv_lens if is_fresh
+                             else kv_lens - positions[:, 0])
+                valid = jnp.arange(s)[None, :] < chunk_len[:, None]
+                s_k = kv_scale_from(k, valid)
+                s_v = kv_scale_from(v, valid)
+                rows_i = (jnp.arange(b, dtype=jnp.int32)
+                          if scale_rows is None else scale_rows)
+                ksc_l, vsc_l = ksc[li][rows_i], vsc[li][rows_i]
+                if window_prefill:
+                    own = (positions[:, 0] == 0)[:, None, None]
+                    s_k = jnp.where(own, s_k, ksc_l)
+                    s_v = jnp.where(own, s_v, vsc_l)
+                # pad rows carry scale_rows >= Bs: scatter drops them
+                ksc = ksc.at[li, rows_i].set(s_k)
+                vsc = vsc.at[li, rows_i].set(s_v)
+                row_scales = (s_k, s_v)
+            else:
+                ksc_l, vsc_l = ksc[li], vsc[li]
+                if scale_rows is not None:
+                    ksc_l, vsc_l = ksc_l[scale_rows], vsc_l[scale_rows]
+                row_scales = (ksc_l, vsc_l)
 
         if multi_decode:
             # speculative verify: the S tokens sit at consecutive positions
@@ -422,15 +478,15 @@ def forward_paged(
             # derive from kv_lens, which callers pass UNCLAMPED (base must
             # be the true position); tokens overhanging rope_max are
             # neither written nor attended (max_pos cap).
-            if use_ragged_kernel:
+            if use_ragged_kernel and kv_scales is None:
                 attn, kp_all, vp_all = paged_decode_pallas_multi(
                     q, k, v, kp_all, vp_all, g_tables, kv_lens,
                     interpret=interpret, max_pos=rope_max)
             else:
                 attn, kp_all, vp_all = paged_decode_multi_xla(
                     q, k, v, kp_all, vp_all, g_tables, kv_lens,
-                    max_pos=rope_max)
-            return _finish_layer(lp, x, attn, kp_all, vp_all)
+                    max_pos=rope_max, kv_scales=row_scales)
+            return _finish_layer(lp, x, attn, kp_all, vp_all, ksc, vsc)
 
         if is_decode and use_ragged_kernel:
             # write-fused ragged kernel: the current token's K/V lands in
@@ -438,25 +494,40 @@ def forward_paged(
             # aliased), replacing the XLA scatter below — which was measured
             # copying the whole pool every decode step.  Under a tp mesh the
             # kernel runs per kv-head shard via shard_map (XLA cannot
-            # auto-partition a pallas_call).
+            # auto-partition a pallas_call).  Int8 pools pass the dispatch
+            # rows' scales; the kernel folds dequant into q/acc per head.
+            ks_r = row_scales[0] if kv_scales is not None else None
+            vs_r = row_scales[1] if kv_scales is not None else None
             if mesh is not None:
                 attn, kp_all, vp_all = paged_decode_fused_sharded(
                     q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables,
-                    kv_lens, mesh, interpret=interpret)
+                    kv_lens, mesh, interpret=interpret,
+                    kscale=ks_r, vscale=vs_r)
             else:
                 attn, kp_all, vp_all = paged_decode_pallas_fused(
                     q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables,
-                    kv_lens, interpret=interpret)
+                    kv_lens, interpret=interpret,
+                    kscale=ks_r, vscale=vs_r)
             attn_out = attn[:, None]  # [B, 1, H, hd]
-            return _finish_layer(lp, x, attn_out, kp_all, vp_all)
+            return _finish_layer(lp, x, attn_out, kp_all, vp_all, ksc, vsc)
 
         # scatter current K/V into the pool: [K, L*P, ps, hd] at
-        # [kh, g_page_idx[b,s], offsets[b,s]]
-        kp_all = kp_all.at[:, g_page_idx, offsets].set(k.transpose(2, 0, 1, 3))
-        vp_all = vp_all.at[:, g_page_idx, offsets].set(v.transpose(2, 0, 1, 3))
+        # [kh, g_page_idx[b,s], offsets[b,s]] — int8 pools store the
+        # quantized rows; attention below reads the ORIGINAL k/v wherever
+        # the current tokens are the whole context (fresh prefill), so only
+        # pool readers pay quantization error
+        k_store, v_store = k, v
+        if kv_scales is not None:
+            k_store = kv_quant(k, row_scales[0])
+            v_store = kv_quant(v, row_scales[1])
+        kp_all = kp_all.at[:, g_page_idx, offsets].set(
+            k_store.transpose(2, 0, 1, 3))
+        vp_all = vp_all.at[:, g_page_idx, offsets].set(
+            v_store.transpose(2, 0, 1, 3))
 
         if is_decode:
-            attn = paged_decode_xla(q[:, 0], kp_all, vp_all, g_tables, kv_lens)
+            attn = paged_decode_xla(q[:, 0], kp_all, vp_all, g_tables, kv_lens,
+                                    kv_scales=row_scales)
             attn_out = attn[:, None]  # [B, 1, H, hd]
         elif segment_ids is not None:
             # packed fresh prefill: same-segment causal attention over the
@@ -485,6 +556,9 @@ def forward_paged(
                 b, w * ps, cfg.n_kv_heads, hd)
             v_win = vp_all[:, g_tables].transpose(1, 2, 3, 0, 4).reshape(
                 b, w * ps, cfg.n_kv_heads, hd)
+            if kv_scales is not None:
+                k_win = kv_dequant(k_win, row_scales[0], q.dtype)
+                v_win = kv_dequant(v_win, row_scales[1], q.dtype)
             attn_out = attention(q, k_win, v_win, positions, kv_lens)
         elif use_ring and mesh is not None:
             # serving CP: ring attention over the sp-sharded sequence; pad
@@ -513,18 +587,28 @@ def forward_paged(
                                                interpret=interpret)
             else:
                 attn_out = attention(q, k, v, positions, kv_lens)
-        return _finish_layer(lp, x, attn_out, kp_all, vp_all)
+        return _finish_layer(lp, x, attn_out, kp_all, vp_all, ksc, vsc)
 
-    def _finish_layer(lp, x, attn_out, kp_all, vp_all):
+    def _finish_layer(lp, x, attn_out, kp_all, vp_all, ksc, vsc):
         x = x + out_proj(lp, cfg, attn_out)
         h = rms_norm(x, lp["ln_mlp"]["scale"], cfg.norm_eps)
         ff, _ = ffn_block(lp, cfg, h)
+        if kv_scales is not None:
+            return (x + ff, kp_all, vp_all, ksc, vsc), None
         return (x + ff, kp_all, vp_all), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
-        layer_fn, (x, k_pages, v_pages),
+    init = ((x, k_pages, v_pages) if kv_scales is None
+            else (x, k_pages, v_pages, kv_scales[0], kv_scales[1]))
+    carry_out, _ = jax.lax.scan(
+        layer_fn, init,
         (params["layers"], jnp.arange(cfg.n_layers)),
     )
+    if kv_scales is None:
+        x, new_k, new_v = carry_out
+        new_scales = None
+    else:
+        x, new_k, new_v, new_ksc, new_vsc = carry_out
+        new_scales = (new_ksc, new_vsc)
     if packed_last_idx is not None:
         # LM head only where tokens are sampled: [B, S, D] -> [B, N, D]
         x = x[:, packed_last_idx]
@@ -540,4 +624,6 @@ def forward_paged(
     logits = logits.astype(jnp.float32)
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    if kv_scales is not None:
+        return logits, new_k, new_v, new_scales
     return logits, new_k, new_v

@@ -71,6 +71,7 @@ def paged_decode_xla(
     v_pages: jnp.ndarray,      # [K, P, ps, hd]
     page_tables: jnp.ndarray,  # [B, W] page ids (live window)
     kv_lens: jnp.ndarray,      # [B] tokens in cache (incl. current)
+    kv_scales=None,            # (k_scale, v_scale) [B, K, hd] for int8 pools
 ) -> jnp.ndarray:
     b, h, hd = q.shape
     kh, _, ps, _ = k_pages.shape
@@ -79,6 +80,11 @@ def paged_decode_xla(
     # gather pages: [K, B, W, ps, hd] -> [B, W*ps, K, hd]
     k = k_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(b, w * ps, kh, hd)
     v = v_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(b, w * ps, kh, hd)
+    if kv_scales is not None:
+        from lmrs_tpu.ops.quant import kv_dequant
+
+        k = kv_dequant(k, kv_scales[0], q.dtype)
+        v = kv_dequant(v, kv_scales[1], q.dtype)
     if n_rep > 1:
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
@@ -122,6 +128,12 @@ def _ragged_decode_all_heads(
     after_head=None,    # callback(ki) after head ki's page loop (cross-row
                         # software pipelining: the fused kernel runs the NEXT
                         # row's RMW cycle in these slots)
+    get_kscale=None,    # (row, ki) -> [hd] f32: int8 pools.  The scales are
+    get_vscale=None,    # per-CHANNEL on the contracted axis, so K's dequant
+                        # folds into q (one multiply per head, before the
+                        # loop) and V's into the accumulator (after it) —
+                        # pages stream as raw int8, only a type convert per
+                        # page
 ):
     """Walk every kv head's live pages for ONE batch row through a single
     double-buffered DMA pipeline.  The head loop is a static Python unroll
@@ -152,12 +164,19 @@ def _ragged_decode_all_heads(
         def _prime():
             fetch(0, 0, 0)
 
+    if get_kscale is not None:
+        assert n_tokens == 1, "int8 pools: multi-token verify not supported"
+
     for ki in range(kh):
         base = ki * n_pages  # global step index of this head's first page
         m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
         q = q_ref[ki].astype(jnp.float32)  # [n_rep_p, hd]
+        if get_kscale is not None:
+            # per-channel K scale on the contraction axis: q·(s⊙k8) =
+            # (q⊙s)·k8 — one multiply per head, pages stay raw int8
+            q = q * get_kscale(b, ki)[None, :]
 
         def body(p, _, ki=ki, base=base, q=q):
             g = base + p
@@ -220,7 +239,12 @@ def _ragged_decode_all_heads(
         @pl.when(n_pages > 0)
         def _write(ki=ki):
             l = l_scr[:, :1]
-            o_ref[ki] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+            out = acc_scr[:] / jnp.where(l > 0, l, 1.0)
+            if get_vscale is not None:
+                # per-channel V scale on the output axis: pw·(s⊙v8) =
+                # (pw·v8)⊙s — folded once per head after the loop
+                out = out * get_vscale(b, ki)[None, :]
+            o_ref[ki] = out.astype(o_ref.dtype)
 
         if after_head is not None:
             after_head(ki)
@@ -242,6 +266,10 @@ def _make_rmw(
     t_pad: int,
     hd: int,
     max_pos: int | None = None,
+    wh: int = 8,        # RMW window height = the pool dtype's sublane tile
+                        # (8 for bf16/f32 pools, 32 for int8)
+    get_kscale=None,    # (row, ki) -> [hd] f32: quantize new tokens into
+    get_vscale=None,    # int8 pools with the row's per-channel scales
 ):
     """Row-parametrized RMW scatter of T consecutive new tokens' K/V into
     the page pool in place.  ``for_row(row)`` returns the three phases —
@@ -262,15 +290,15 @@ def _make_rmw(
     (the caller passes the UNCLAMPED length, so the base position is
     always exact; a clamped length would slide the whole span backwards
     over real cache entries)."""
-    assert page_size % 8 == 0, (
-        "RMW window offsets are computed in 8-row units; a non-multiple "
+    assert page_size % wh == 0, (
+        f"RMW window offsets are computed in {wh}-row units; a non-multiple "
         f"page_size={page_size} would silently alias (scheduler gates this)")
-    n_win = 1 if n_tokens == 1 else (n_tokens - 2) // 8 + 2
+    n_win = 1 if n_tokens == 1 else (n_tokens - 2) // wh + 2
 
     def for_row(b):
         length = kv_lens_ref[b]
         base = jnp.maximum(length - n_tokens, 0)  # first new token's position
-        win0 = jax.lax.div(base, 8) * 8  # provably 8-aligned
+        win0 = jax.lax.div(base, wh) * wh  # provably wh-aligned
         # A window is touched ONLY if it holds a valid token position.  An
         # overhanging window (past the table span or max_pos) must be
         # skipped entirely, not clipped: a clipped page index keeps the raw
@@ -284,17 +312,17 @@ def _make_rmw(
             limit = jnp.minimum(limit, max_pos)
 
         def win_page(wi):
-            start = win0 + 8 * wi
+            start = win0 + wh * wi
             page_idx = jnp.clip(jax.lax.div(start, page_size), 0,
                                 page_tables_ref.shape[1] - 1)
             return start, page_tables_ref[b, page_idx]
 
         def read_copies(ki, wi, start, page):
             si = ki * n_win + wi
-            # rem(start, ps) is 8-aligned (start = 8k, ps % 8 == 0) but
-            # Mosaic's divisibility prover can't see through rem; the w*8
+            # rem(start, ps) is wh-aligned (start = wh*k, ps % wh == 0) but
+            # Mosaic's divisibility prover can't see through rem; the w*wh
             # form it can.
-            off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
+            off = pl.ds(jax.lax.rem(jax.lax.div(start, wh), page_size // wh) * wh, wh)
             return (pltpu.make_async_copy(k_out.at[ki, page, off],
                                           k8_scr.at[ki, wi], wsem.at[si, 0]),
                     pltpu.make_async_copy(v_out.at[ki, page, off],
@@ -302,7 +330,7 @@ def _make_rmw(
 
         def write_copies(ki, wi, start, page):
             si = ki * n_win + wi
-            off = pl.ds(jax.lax.rem(jax.lax.div(start, 8), page_size // 8) * 8, 8)
+            off = pl.ds(jax.lax.rem(jax.lax.div(start, wh), page_size // wh) * wh, wh)
             return (pltpu.make_async_copy(k8_scr.at[ki, wi],
                                           k_out.at[ki, page, off], wsem.at[si, 0]),
                     pltpu.make_async_copy(v8_scr.at[ki, wi],
@@ -334,8 +362,8 @@ def _make_rmw(
                         # when 0 <= j < T; select token rows with a tiny 0/1
                         # matmul (no dynamic VMEM indexing) and blend where
                         # a token lands
-                        row = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 0)
-                        tok = jax.lax.broadcasted_iota(jnp.int32, (8, t_pad), 1)
+                        row = jax.lax.broadcasted_iota(jnp.int32, (wh, t_pad), 0)
+                        tok = jax.lax.broadcasted_iota(jnp.int32, (wh, t_pad), 1)
                         j = start + row - base
                         valid = (j == tok) & (tok < n_tokens)
                         if max_pos is not None:
@@ -349,8 +377,17 @@ def _make_rmw(
                             sel, get_vnew(b, ki).astype(jnp.float32),
                             (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
+                        if get_kscale is not None:
+                            # quantize the landing rows with the row's
+                            # per-channel scales (int8 pools)
+                            k_rows = jnp.clip(jnp.round(
+                                k_rows / get_kscale(b, ki)[None, :]),
+                                -127, 127)
+                            v_rows = jnp.clip(jnp.round(
+                                v_rows / get_vscale(b, ki)[None, :]),
+                                -127, 127)
                         hit = (jnp.sum(sel, axis=1, keepdims=True) > 0)
-                        hit = jnp.broadcast_to(hit, (8, hd))
+                        hit = jnp.broadcast_to(hit, (wh, hd))
                         k8_scr[ki, wi] = jnp.where(
                             hit, k_rows.astype(k8_scr.dtype), k8_scr[ki, wi])
                         v8_scr[ki, wi] = jnp.where(
@@ -519,6 +556,7 @@ def paged_decode_multi_xla(
     page_tables: jnp.ndarray,  # [B, W]
     kv_lens: jnp.ndarray,      # [B] incl. the T tokens (unclamped; see kernel)
     max_pos: int | None = None,
+    kv_scales=None,            # (k_scale, v_scale) [B, K, hd] for int8 pools
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Scatter + gather reference for the multi-token verify: same contract
     as ``paged_decode_pallas_multi`` on any platform (correctness baseline
@@ -541,6 +579,11 @@ def paged_decode_multi_xla(
         in_span &= pos < max_pos
     page = jnp.where(in_span, page, 0)  # overhang lands on the null page
     off = jnp.where(in_span, off, 0)
+    if kv_scales is not None:
+        from lmrs_tpu.ops.quant import kv_quant
+
+        k_new = kv_quant(k_new, kv_scales[0])
+        v_new = kv_quant(v_new, kv_scales[1])
     k_pages = k_pages.at[:, page, off].set(k_new.transpose(2, 0, 1, 3))
     v_pages = v_pages.at[:, page, off].set(v_new.transpose(2, 0, 1, 3))
 
@@ -549,6 +592,11 @@ def paged_decode_multi_xla(
         b, w * ps, kh, hd)
     v_win = v_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
         b, w * ps, kh, hd)
+    if kv_scales is not None:
+        from lmrs_tpu.ops.quant import kv_dequant
+
+        k_win = kv_dequant(k_win, kv_scales[0], q.dtype)
+        v_win = kv_dequant(v_win, kv_scales[1], q.dtype)
     if n_rep > 1:
         k_win = jnp.repeat(k_win, n_rep, axis=2)
         v_win = jnp.repeat(v_win, n_rep, axis=2)
@@ -573,16 +621,27 @@ def paged_decode_pallas_fused(
     page_tables: jnp.ndarray,  # [B, W] GLOBAL page ids
     kv_lens: jnp.ndarray,      # [B] incl. current token
     interpret: bool = False,
+    kscale: jnp.ndarray | None = None,  # [B, K, hd] f32: int8 pools — the
+    vscale: jnp.ndarray | None = None,  # per-(slot, head, channel) scales
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write-fused ragged decode: scatter the current token's K/V into the
     page pool (in place — the pools are input/output aliased) and attend the
     live pages, in one kernel, one program per BATCH ROW (all kv heads).
     Replaces XLA scatter + kernel: the XLA scatter on the multi-GiB pool was
     measured copying the whole pool per decode step (no in-place aliasing
-    through the scan carry)."""
+    through the scan carry).
+
+    With ``kscale``/``vscale`` the pools are int8: pages stream as raw int8
+    (half the decode bytes), K's per-channel dequant folds into q before
+    the walk and V's into the accumulator after it, the RMW quantizes the
+    new token's rows, and windows are 32 rows (the int8 sublane tile)."""
     b, h, hd = q.shape
     kh = k_pages.shape[0]
     ps = k_pages.shape[2]
+    quantized = kscale is not None
+    assert quantized == (k_pages.dtype == jnp.int8), (
+        "int8 pools need scales and vice versa")
+    wh = 32 if quantized else 8
     n_rep = h // kh
     n_rep_p = -(-n_rep // 8) * 8
     qg = q.reshape(b, kh, n_rep, hd)
@@ -594,12 +653,21 @@ def paged_decode_pallas_fused(
     # knew/vnew live whole in VMEM (the cross-row RMW needs the next row's
     # slice — see in_specs) so their footprint scales with batch; keep it
     # well under the ~16 MiB core budget alongside the page scratch
-    new_tok_bytes = 2 * b * kh * 8 * hd * k_pages.dtype.itemsize
+    new_tok_bytes = 2 * b * kh * 8 * hd * knew.dtype.itemsize
     assert new_tok_bytes <= 4 * 1024 * 1024, (
         f"fused decode keeps all rows' new-token K/V in VMEM "
         f"({new_tok_bytes/2**20:.1f} MiB at B={b}, kh={kh}, hd={hd}); "
         "shard the batch or lower max_batch_slots")
 
+    scale_specs = []
+    scale_scratch = []
+    if quantized:
+        # whole-array f32 blocks (~100 KB at bench shape): the cross-row
+        # RMW quantizes the NEXT row's tokens, so per-row blocks can't work
+        scale_specs = [
+            pl.BlockSpec((b, kh, hd), lambda bi, *_: (0, 0, 0)),
+            pl.BlockSpec((b, kh, hd), lambda bi, *_: (0, 0, 0)),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b,),
@@ -610,6 +678,7 @@ def paged_decode_pallas_fused(
             # the NEXT row's slice — a per-row block can't cross iterations
             pl.BlockSpec((b, kh, 8, hd), lambda bi, *_: (0, 0, 0, 0)),
             pl.BlockSpec((b, kh, 8, hd), lambda bi, *_: (0, 0, 0, 0)),
+            *scale_specs,
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -624,16 +693,23 @@ def paged_decode_pallas_fused(
             pltpu.VMEM((n_rep_p, hd), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
             pltpu.VMEM((n_rep_p, 128), jnp.float32),
-            pltpu.VMEM((kh, 1, 8, hd), k_pages.dtype),  # one RMW window
-            pltpu.VMEM((kh, 1, 8, hd), v_pages.dtype),
+            pltpu.VMEM((kh, 1, wh, hd), k_pages.dtype),  # one RMW window
+            pltpu.VMEM((kh, 1, wh, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((kh, 2)),
         ],
     )
 
-    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
-               o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
-               k8_scr, v8_scr, sem, wsem):
+    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, *rest):
+        if quantized:
+            (ksc_ref, vsc_ref, k_hbm, v_hbm, o_ref, k_out, v_out, k_scr,
+             v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
+            gks = lambda row, ki: ksc_ref[row, ki]
+            gvs = lambda row, ki: vsc_ref[row, ki]
+        else:
+            (k_hbm, v_hbm, o_ref, k_out, v_out, k_scr, v_scr, acc_scr,
+             m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
+            gks = gvs = None
         # Cross-row software pipeline (round 3, after the kv-head fold):
         # the fixed decode cost was measured at ~7.7 us per batch row —
         # dominated by each grid iteration serializing RMW-write -> drain ->
@@ -656,6 +732,7 @@ def paged_decode_pallas_fused(
             lambda row, ki: knew_ref[row, ki], lambda row, ki: vnew_ref[row, ki],
             k_out, v_out, k8_scr, v8_scr, wsem,
             page_size=ps, kh=kh, n_tokens=1, t_pad=8, hd=hd,
+            wh=wh, get_kscale=gks, get_vscale=gvs,
         )
         nxt = bi + 1
         # clamp for closure creation only: for_row's scalar SMEM reads trace
@@ -700,8 +777,16 @@ def paged_decode_pallas_fused(
             k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
             page_size=ps, sm_scale=hd**-0.5, kh=kh,
             external_prime=True, after_head=after_head,
+            get_kscale=gks, get_vscale=gvs,
         )
 
+    # operand order after the 2 scalar-prefetch args: qg, knew, vnew,
+    # [kscale, vscale,] k_pages, v_pages — the pool alias indices shift by 2
+    # when the scale operands are present
+    operands = [qg, knew, vnew]
+    if quantized:
+        operands += [kscale.astype(jnp.float32), vscale.astype(jnp.float32)]
+    pool_at = 2 + len(operands)  # k_pages index among ALL args
     out, k_pages, v_pages = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -710,12 +795,12 @@ def paged_decode_pallas_fused(
             jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
             jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
         ],
-        # +2: indices count the scalar-prefetch operands; pools alias so the
+        # indices count the scalar-prefetch operands; pools alias so the
         # page write happens in the caller's buffers, no pool copy
-        input_output_aliases={5: 1, 6: 2},
+        input_output_aliases={pool_at: 1, pool_at + 1: 2},
         interpret=interpret,
     )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      qg, knew, vnew, k_pages, v_pages)
+      *operands, k_pages, v_pages)
     return out[:, :, :n_rep].reshape(b, h, hd), k_pages, v_pages
 
 
@@ -729,6 +814,8 @@ def paged_decode_fused_sharded(
     kv_lens: jnp.ndarray,      # [B] replicated
     mesh,
     interpret: bool = False,
+    kscale: jnp.ndarray | None = None,  # [B, K, hd] (K sharded over tp)
+    vscale: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write-fused ragged decode under a tensor-parallel mesh.
 
@@ -744,14 +831,29 @@ def paged_decode_fused_sharded(
 
     head = P(None, "tp", None)
     pool = P("tp", None, None, None)
+    extra_in = ()
+    extra_args = ()
+    if kscale is not None:
+        # scales shard with their kv heads (axis 1 of [B, K, hd])
+        extra_in = (head, head)
+        extra_args = (kscale, vscale)
+
+    def call(q_, kn_, vn_, kp_, vp_, pt_, kl_, *sc):
+        ks_, vs_ = sc if sc else (None, None)
+        return paged_decode_pallas_fused(
+            q_, kn_, vn_, kp_, vp_, pt_, kl_, interpret=interpret,
+            kscale=ks_, vscale=vs_)
+
     fn = jax.shard_map(
-        functools.partial(paged_decode_pallas_fused, interpret=interpret),
+        call,
         mesh=mesh,
-        in_specs=(head, head, head, pool, pool, P(None, None), P(None)),
+        in_specs=(head, head, head, pool, pool, P(None, None), P(None),
+                  *extra_in),
         out_specs=(head, pool, pool),
         check_vma=False,
     )
-    return fn(q, k_new, v_new, k_pages, v_pages, page_tables, kv_lens)
+    return fn(q, k_new, v_new, k_pages, v_pages, page_tables, kv_lens,
+              *extra_args)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -109,3 +109,43 @@ def match_quantized_specs(specs: Any, params: Any) -> Any:
         return spec
 
     return walk(specs, params)
+
+
+# ------------------------------------------------------- KV-cache int8 (r3)
+#
+# Decode's slope term is the KV page walk (docs/PERF.md round 3: 3.5
+# us/live-token vs a 2.16 us HBM floor); int8 pages halve the streamed
+# bytes AND double the tokens each HBM GiB holds.  Scheme: symmetric int8
+# with one scale per (slot, kv head, channel), fixed at prefill time from
+# the prompt's K/V stats (per-channel handles K's channel-consistent
+# outliers — the KIVI finding; the per-slot factor tracks sequence-level
+# magnitude).  Decode/verify tokens quantize with the SAME slot scale
+# (clamped): requantizing written pages on scale change is a non-starter.
+# Scales live in scheduler-owned [L, B, K, hd] f32 buffers threaded
+# through the dispatch programs — VMEM-resident at kernel time, no
+# per-page scale DMAs (the layout analysis that rejected per-token scale
+# pools, docs/PERF.md round 3).
+
+
+def kv_scale_from(kv: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Per-(row, kv head, channel) symmetric scale from a prefill's K or V.
+
+    kv: [B, S, K, hd]; valid: [B, S] bool (True where the token is a real
+    prompt token — padding and out-of-prompt rows must not inflate the
+    scale).  Returns [B, K, hd] f32, floored so dequant never divides by
+    ~0 on all-masked rows."""
+    a = jnp.where(valid[:, :, None, None], jnp.abs(kv.astype(jnp.float32)), 0.0)
+    return jnp.maximum(jnp.max(a, axis=1) / 127.0, 1e-8)
+
+
+def kv_quant(kv: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Quantize K or V rows with their row scales.  kv [B, S, K, hd],
+    scale [B, K, hd] -> int8 [B, S, K, hd] (clipped: decode tokens reuse
+    the prefill-time scale, so out-of-range values saturate)."""
+    q = jnp.round(kv.astype(jnp.float32) / scale[:, None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
+def kv_dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Dequantize gathered int8 KV.  q [B, T, K, hd], scale [B, K, hd]."""
+    return (q.astype(jnp.float32) * scale[:, None]).astype(dtype)

@@ -38,6 +38,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "path, or an HF tokenizer dir (the checkpoint's own "
                         "vocabulary; default: model-derived)")
     p.add_argument("--quantize", default=None, choices=["int8"])
+    p.add_argument("--kv-quantize", default=None, choices=["int8"])
     p.add_argument("--batch-slots", type=int, default=8,
                    help="continuous-batching decode slots")
     p.add_argument("--max-tokens-cap", type=int, default=4096,
@@ -61,6 +62,7 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_path=args.checkpoint,
         tokenizer=args.tokenizer or "",
         quantize=args.quantize,
+        kv_quantize=args.kv_quantize,
         max_tokens=args.max_tokens_cap,
     )
     mesh_cfg = parse_mesh(args.mesh) if args.mesh else None

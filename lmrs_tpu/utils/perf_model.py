@@ -102,7 +102,12 @@ def kv_bytes_per_token(cfg: ModelConfig) -> float:
 
 
 def decode_step_bytes(cfg: ModelConfig, total_live_tokens: int,
-                      quantized: bool = False) -> float:
+                      quantized: bool = False,
+                      kv_quantized: bool = False) -> float:
     """HBM bytes one batched decode step moves: every weight once (batch
-    amortized — one read serves all rows) + every live KV token's K and V."""
-    return weight_bytes(cfg, quantized) + kv_bytes_per_token(cfg) * total_live_tokens
+    amortized — one read serves all rows) + every live KV token's K and V
+    (halved when the pages are int8)."""
+    kv = kv_bytes_per_token(cfg) * total_live_tokens
+    if kv_quantized:
+        kv /= 2
+    return weight_bytes(cfg, quantized) + kv

@@ -44,11 +44,13 @@ def main():
                  jnp.ones((B,), bool), jax.random.PRNGKey(8), onesB,
                  jnp.zeros((B,), jnp.int32), onesB)
         k, v = sched.cache.k, sched.cache.v
-        toks, n_valid, k, v = dfn(sched.params, k, v, *dargs)
+        toks, n_valid, k, v = dfn(sched.params, k, v, sched.kscale,
+                          sched.vscale, None, *dargs)
         np.asarray(jax.device_get(n_valid))
         t0 = time.time()
         for _ in range(3):
-            toks, n_valid, k, v = dfn(sched.params, k, v, *dargs)
+            toks, n_valid, k, v = dfn(sched.params, k, v, sched.kscale,
+                          sched.vscale, None, *dargs)
         np.asarray(jax.device_get(n_valid))
         wall = time.time() - t0 - rtt
         sched.cache.k, sched.cache.v = k, v

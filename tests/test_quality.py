@@ -123,3 +123,37 @@ def test_trained_model_beats_extractive_baseline(trained_summarizer):
     # non-trivial absolute gate + wide margin over the trivial baseline
     assert mean_model >= 0.30, (mean_model, model_f)
     assert mean_model > 3 * mean_extract, (mean_model, mean_extract)
+
+
+def test_trained_model_quality_survives_kv_int8(trained_summarizer):
+    """The REAL numerics gate for kv_quantize=int8 (per-slot/head/channel
+    scales, ops/quant.py KV section): the fine-tuned model decoded through
+    int8 KV pages must keep its learned-summarization quality, not merely
+    not crash.  A scale-wiring bug (wrong rows, wrong channel axis) floors
+    ROUGE-L to extractive-baseline territory instantly."""
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+    from lmrs_tpu.eval.rouge import rouge_l
+    from lmrs_tpu.eval.synthetic import make_dataset
+
+    cfg, tok, params = trained_summarizer
+    engine = JaxEngine(
+        EngineConfig(backend="jax", scheduler="continuous", max_tokens=48,
+                     max_batch_slots=4, seed=0, decode_block=8,
+                     page_size=32, kv_quantize="int8"),
+        cfg, params=params, tokenizer=tok)
+    train_prompts = {ex["prompt"] for ex in make_dataset(192, seed=0)}
+    held = [ex for ex in make_dataset(32, seed=999)
+            if ex["prompt"] not in train_prompts][:8]
+    reqs = [GenerationRequest(prompt=ex["prompt"], request_id=i,
+                              temperature=0.0, max_new_tokens=48)
+            for i, ex in enumerate(held)]
+    outs = engine.generate_batch(reqs)
+    engine.shutdown()
+    model_f = [rouge_l(o.text, ex["summary"])["f"]
+               for ex, o in zip(held, outs)]
+    mean_model = float(np.mean(model_f))
+    # same absolute gate as the full-precision test: int8 KV must not cost
+    # the learned behavior (small per-example wobble is expected)
+    assert mean_model >= 0.28, (mean_model, model_f)
