@@ -1,7 +1,7 @@
 """Paged KV cache: fixed page pool + host-side page allocator.
 
 The serving-memory design SURVEY.md §7.4 ranks as hard part #1: a fixed-size
-page pool in HBM ([K, n_layers * num_pages, page_size, hd] — see PagedKVCache
+page pool in HBM ([n_layers * num_pages, K, page_size, hd] — see PagedKVCache
 for the layer-flattened layout rationale) with per-slot page tables, so KV
 memory is allocated in O(page) quanta instead of one max_seq_len region per
 slot.  Admission control = free pages (the reference's semaphore analog,
@@ -94,10 +94,12 @@ class SequencePages:
 class PagedKVCache:
     """Device page pool + per-slot host page tables.
 
-    Layout [K, L*P, page_size, hd] — kv-head-major, so one (kv head, page)
-    pair is a contiguous [page_size, hd] block (a single DMA in the ragged
-    decode kernel), with the layer axis FLATTENED into the page axis: layer
-    ``li``'s copy of logical page ``p`` is physical page ``li * P + p``.
+    Layout [L*P, K, page_size, hd] — PAGE-major (round 3): one page's ALL
+    kv heads are a contiguous [K, page_size, hd] block, so the ragged
+    decode kernel fetches a page with ONE DMA instead of one per head (the
+    decode walk measured DMA-issue-bound; docs/PERF.md round 3).  The
+    layer axis is FLATTENED into the page axis: layer ``li``'s copy of
+    logical page ``p`` is physical page ``li * P + p``.
     That lets the per-layer decode scatter write straight into the full
     carried pool with global page ids — no per-layer slice/update round
     trip, which would otherwise move the whole layer slice every decode
@@ -117,7 +119,7 @@ class PagedKVCache:
         # page and double the tokens per HBM GiB; scales are scheduler-owned
         # (ops/quant.py KV section)
         dt = jnp.dtype(kv_dtype) if kv_dtype else jnp.dtype(model_cfg.dtype)
-        shape = (model_cfg.n_kv_heads, model_cfg.n_layers * num_pages,
+        shape = (model_cfg.n_layers * num_pages, model_cfg.n_kv_heads,
                  page_size, hd)
         if mesh is not None:
             # tensor-parallel serving: pages shard on the kv-head axis,
@@ -132,7 +134,7 @@ class PagedKVCache:
                 raise ValueError(
                     f"n_kv_heads={model_cfg.n_kv_heads} not divisible by "
                     f"tp={tp}")
-            sh = NamedSharding(mesh, P("tp") if tp > 1 else P())
+            sh = NamedSharding(mesh, P(None, "tp") if tp > 1 else P())
             self.k = jnp.zeros(shape, dt, device=sh)
             self.v = jnp.zeros(shape, dt, device=sh)
         else:

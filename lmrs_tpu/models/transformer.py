@@ -309,8 +309,8 @@ def forward_paged(
     cfg: ModelConfig,
     tokens: jnp.ndarray,       # [B, S] int32
     positions: jnp.ndarray,    # [B, S] absolute positions
-    k_pages: jnp.ndarray,      # [K, L*P, ps, hd] (layer-flattened pool)
-    v_pages: jnp.ndarray,      # [K, L*P, ps, hd]
+    k_pages: jnp.ndarray,      # [L*P, K, ps, hd] (page-major, layer-flattened)
+    v_pages: jnp.ndarray,      # [L*P, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W] LOGICAL page ids (< P)
     kv_lens: jnp.ndarray,      # [B] valid tokens AFTER this call's writes
     rope_max: int,
@@ -427,7 +427,7 @@ def forward_paged(
         if kv_scales is not None:
             x, kp_all, vp_all, ksc, vsc = carry
         else:
-            x, kp_all, vp_all = carry  # pools: [K, L*P, ps, hd]
+            x, kp_all, vp_all = carry  # pools: [L*P, K, ps, hd]
             ksc = vsc = None
         lp, li = xs  # layer params, layer index
         g_page_idx = li * n_pool + page_idx      # [B, S] global page ids
@@ -511,8 +511,10 @@ def forward_paged(
             attn_out = attn[:, None]  # [B, 1, H, hd]
             return _finish_layer(lp, x, attn_out, kp_all, vp_all, ksc, vsc)
 
-        # scatter current K/V into the pool: [K, L*P, ps, hd] at
-        # [kh, g_page_idx[b,s], offsets[b,s]] — int8 pools store the
+        # scatter current K/V into the page-major pool: [L*P, K, ps, hd]
+        # at [g_page_idx[b,s], :, offsets[b,s]] (advanced indices around
+        # the head slice put the advanced dims first: updates are
+        # [B, S, K, hd] — the K/V's own layout).  Int8 pools store the
         # quantized rows; attention below reads the ORIGINAL k/v wherever
         # the current tokens are the whole context (fresh prefill), so only
         # pool readers pay quantization error
@@ -520,10 +522,8 @@ def forward_paged(
         if kv_scales is not None:
             k_store = kv_quant(k, row_scales[0])
             v_store = kv_quant(v, row_scales[1])
-        kp_all = kp_all.at[:, g_page_idx, offsets].set(
-            k_store.transpose(2, 0, 1, 3))
-        vp_all = vp_all.at[:, g_page_idx, offsets].set(
-            v_store.transpose(2, 0, 1, 3))
+        kp_all = kp_all.at[g_page_idx, :, offsets].set(k_store)
+        vp_all = vp_all.at[g_page_idx, :, offsets].set(v_store)
 
         if is_decode:
             attn = paged_decode_xla(q[:, 0], kp_all, vp_all, g_tables, kv_lens,
@@ -552,9 +552,9 @@ def forward_paged(
             # continuation prefill: attend the page window (self K/V included
             # — this chunk was scattered into its pages above)
             w = page_tables.shape[1]
-            k_win = kp_all[:, g_tables].transpose(1, 2, 3, 0, 4).reshape(
+            k_win = kp_all[g_tables].transpose(0, 1, 3, 2, 4).reshape(
                 b, w * ps, cfg.n_kv_heads, hd)
-            v_win = vp_all[:, g_tables].transpose(1, 2, 3, 0, 4).reshape(
+            v_win = vp_all[g_tables].transpose(0, 1, 3, 2, 4).reshape(
                 b, w * ps, cfg.n_kv_heads, hd)
             if kv_scales is not None:
                 k_win = kv_dequant(k_win, row_scales[0], q.dtype)

@@ -21,10 +21,12 @@ model step) — the round-2 decode fixed cost was diagnosed as program +
 small-DMA launch latency, not bandwidth (docs/PERF.md round 2: 9.39 ms
 fitted fixed cost vs a 2.49 ms weight-stream floor).
 
-Cache layout: [K, P_total, page_size, hd] (kv-head-major so one page of one
-kv head is a contiguous [page_size, hd] DMA; P_total flattens the layer axis
-into the page axis — engine/kv_cache.PagedKVCache — and callers pass GLOBAL
-page ids, shared across kv heads).
+Cache layout: [P_total, K, page_size, hd] (PAGE-major, round 3: one page's
+ALL kv heads are a single contiguous [K, page_size, hd] DMA — the
+head-major layout issued kh separate per-head page DMAs, and the decode
+fixed-cost split measured the walk DMA-issue-bound, not bandwidth-bound;
+docs/PERF.md round 3).  P_total flattens the layer axis into the page axis
+— engine/kv_cache.PagedKVCache — and callers pass GLOBAL page ids.
 """
 
 from __future__ import annotations
@@ -51,15 +53,16 @@ def _n_live_pages(page_tables_ref, kv_lens_ref, row, page_size):
 
 
 def _fetch_page(page_tables_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
-                row, ki, p, slot):
-    """Start the K+V page DMAs for (row, head ki, page index p) into
-    double-buffer ``slot``.  ONE shared implementation: the walk's
-    steady-state prefetches and the fused kernel's cross-row prime must
-    agree on the slot/semaphore layout or the next wait pairs with the
-    wrong DMA."""
+                row, p, slot):
+    """Start the K+V page DMAs for (row, page index p) into double-buffer
+    ``slot`` — ONE [K, ps, hd] copy each brings every kv head's rows of the
+    page (the page-major layout's point).  ONE shared implementation: the
+    walk's steady-state prefetches and the fused kernel's cross-row prime
+    must agree on the slot/semaphore layout or the next wait pairs with
+    the wrong DMA."""
     page = page_tables_ref[row, p]
-    pltpu.make_async_copy(k_hbm.at[ki, page], k_scr.at[slot], sem.at[slot, 0]).start()
-    pltpu.make_async_copy(v_hbm.at[ki, page], v_scr.at[slot], sem.at[slot, 1]).start()
+    pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sem.at[slot, 0]).start()
+    pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sem.at[slot, 1]).start()
 
 
 # ------------------------------------------------------------ XLA fallback
@@ -74,12 +77,12 @@ def paged_decode_xla(
     kv_scales=None,            # (k_scale, v_scale) [B, K, hd] for int8 pools
 ) -> jnp.ndarray:
     b, h, hd = q.shape
-    kh, _, ps, _ = k_pages.shape
+    _, kh, ps, _ = k_pages.shape
     n_rep = h // kh
     w = page_tables.shape[1]
-    # gather pages: [K, B, W, ps, hd] -> [B, W*ps, K, hd]
-    k = k_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(b, w * ps, kh, hd)
-    v = v_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(b, w * ps, kh, hd)
+    # gather pages: [B, W, K, ps, hd] -> [B, W*ps, K, hd]
+    k = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(b, w * ps, kh, hd)
+    v = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(b, w * ps, kh, hd)
     if kv_scales is not None:
         from lmrs_tpu.ops.quant import kv_dequant
 
@@ -105,16 +108,16 @@ def _ragged_decode_all_heads(
     kv_lens_ref,      # SMEM [B]
     # inputs
     q_ref,            # VMEM [kh, n_tokens*n_rep_p, hd] (this row, all heads)
-    k_hbm,            # ANY  [K, P, ps, hd] (full page pool)
-    v_hbm,            # ANY  [K, P, ps, hd]
+    k_hbm,            # ANY  [P, K, ps, hd] (full page-major pool)
+    v_hbm,            # ANY  [P, K, ps, hd]
     # output
     o_ref,            # VMEM [kh, n_tokens*n_rep_p, hd]
     # scratch
-    k_scr,            # VMEM [2, ps, hd] double-buffered
-    v_scr,            # VMEM [2, ps, hd]
-    acc_scr,          # VMEM [n_tokens*n_rep_p, hd] f32 (current head)
-    m_scr,            # VMEM [n_tokens*n_rep_p, 128] f32
-    l_scr,            # VMEM [n_tokens*n_rep_p, 128] f32
+    k_scr,            # VMEM [2, K, ps, hd] double-buffered whole pages
+    v_scr,            # VMEM [2, K, ps, hd]
+    acc_scr,          # VMEM [kh, n_tokens*n_rep_p, hd] f32
+    m_scr,            # VMEM [kh, n_tokens*n_rep_p, 128] f32
+    l_scr,            # VMEM [kh, n_tokens*n_rep_p, 128] f32
     sem,              # DMA semaphores (2, 2): [buffer parity, k/v]
     *,
     page_size: int,
@@ -125,9 +128,6 @@ def _ragged_decode_all_heads(
     max_pos: int | None = None,  # static cap: no position >= this is valid
     row=None,           # batch row to walk (default: this program's row)
     external_prime: bool = False,  # caller already DMA'd page 0 into slot 0
-    after_head=None,    # callback(ki) after head ki's page loop (cross-row
-                        # software pipelining: the fused kernel runs the NEXT
-                        # row's RMW cycle in these slots)
     get_kscale=None,    # (row, ki) -> [hd] f32: int8 pools.  The scales are
     get_vscale=None,    # per-CHANNEL on the contracted axis, so K's dequant
                         # folds into q (one multiply per head, before the
@@ -135,12 +135,14 @@ def _ragged_decode_all_heads(
                         # pages stream as raw int8, only a type convert per
                         # page
 ):
-    """Walk every kv head's live pages for ONE batch row through a single
-    double-buffered DMA pipeline.  The head loop is a static Python unroll
-    (kh is a shape), so all VMEM indexing is static — only the page DMAs
-    carry dynamic indices — and the page prefetched at the end of head
-    ``ki`` is head ``ki+1``'s first page: the pipeline never drains at a
-    head boundary, which is the entire point of the fold.
+    """Walk ONE batch row's live pages through a double-buffered DMA
+    pipeline — PAGE-major (round 3): each loop step DMAs one page's ALL kv
+    heads as a single [K, ps, hd] copy and unrolls the head compute over
+    the buffered block.  The head-major predecessor issued kh separate
+    per-head page DMAs; the decode fixed-cost split measured the walk
+    DMA-issue-bound (docs/PERF.md round 3), so fewer/bigger copies is the
+    lever.  Every head keeps its own online-softmax state (acc/m/l gain a
+    leading kh axis, statically indexed).
 
     With ``n_tokens > 1`` (ragged speculative verify) the q rows group as
     [token j][query head group]: token j sits at absolute position
@@ -151,9 +153,9 @@ def _ragged_decode_all_heads(
     length = kv_lens_ref[b]
     n_pages = _n_live_pages(page_tables_ref, kv_lens_ref, b, page_size)
 
-    def fetch(ki, p, slot):
+    def fetch(p, slot):
         _fetch_page(page_tables_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
-                    b, ki, p, slot)
+                    b, p, slot)
 
     @pl.when(n_pages == 0)
     def _zero():  # inactive row: defined output, no page walk
@@ -162,92 +164,87 @@ def _ragged_decode_all_heads(
     if not external_prime:
         @pl.when(n_pages > 0)
         def _prime():
-            fetch(0, 0, 0)
+            fetch(0, 0)
 
     if get_kscale is not None:
         assert n_tokens == 1, "int8 pools: multi-token verify not supported"
 
+    m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+    l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+    acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+    # per-head q, pre-scaled for int8 pools: q·(s⊙k8) = (q⊙s)·k8
+    qs = []
     for ki in range(kh):
-        base = ki * n_pages  # global step index of this head's first page
-        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
-        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
-        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
-        q = q_ref[ki].astype(jnp.float32)  # [n_rep_p, hd]
+        q = q_ref[ki].astype(jnp.float32)  # [rows, hd]
         if get_kscale is not None:
-            # per-channel K scale on the contraction axis: q·(s⊙k8) =
-            # (q⊙s)·k8 — one multiply per head, pages stay raw int8
             q = q * get_kscale(b, ki)[None, :]
+        qs.append(q)
+    rows = qs[0].shape[0]
 
-        def body(p, _, ki=ki, base=base, q=q):
-            g = base + p
-            slot = jax.lax.rem(g, 2)
+    def body(p, _):
+        slot = jax.lax.rem(p, 2)
 
-            # overlap: the NEXT page's DMA streams while this one computes —
-            # next page of this head, or the next head's first page
-            @pl.when(p + 1 < n_pages)
-            def _prefetch():
-                fetch(ki, p + 1, jax.lax.rem(g + 1, 2))
+        # overlap: the NEXT page's DMA streams while this one computes
+        @pl.when(p + 1 < n_pages)
+        def _prefetch():
+            fetch(p + 1, jax.lax.rem(p + 1, 2))
 
-            if ki + 1 < kh:
-                @pl.when(p + 1 == n_pages)
-                def _prefetch_next_head():
-                    fetch(ki + 1, 0, jax.lax.rem(g + 1, 2))
+        page = page_tables_ref[b, p]
+        pltpu.make_async_copy(
+            k_hbm.at[page], k_scr.at[slot], sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(
+            v_hbm.at[page], v_scr.at[slot], sem.at[slot, 1]).wait()
 
-            page = page_tables_ref[b, p]
-            pltpu.make_async_copy(
-                k_hbm.at[ki, page], k_scr.at[slot], sem.at[slot, 0]).wait()
-            pltpu.make_async_copy(
-                v_hbm.at[ki, page], v_scr.at[slot], sem.at[slot, 1]).wait()
-            k = k_scr[slot].astype(jnp.float32)  # [ps, hd]
+        # positional causal mask: identical for every head, computed once
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 1)
+        if n_tokens == 1:
+            limit = length  # every row is the newest token
+        else:
+            # row r belongs to token j = r // n_rep_p at absolute position
+            # length - n_tokens + j: strict per-row causality
+            j = jax.lax.broadcasted_iota(
+                jnp.int32, (rows, page_size), 0) // n_rep_p
+            limit = length - n_tokens + j + 1
+            if max_pos is not None:
+                # positions >= max_pos were never written (write cap in the
+                # RMW): a query past the cap sees the real prefix only
+                limit = jnp.minimum(limit, max_pos)
+        masked = pos < limit
+
+        for ki in range(kh):
+            k = k_scr[slot, ki].astype(jnp.float32)  # [ps, hd]
             s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            ) * sm_scale  # [rows, ps]
-            pos = p * page_size + jax.lax.broadcasted_iota(
-                jnp.int32, (q.shape[0], page_size), 1
-            )
-            if n_tokens == 1:
-                limit = length  # every row is the newest token
-            else:
-                # row r belongs to token j = r // n_rep_p at absolute
-                # position length - n_tokens + j: strict per-row causality
-                j = jax.lax.broadcasted_iota(
-                    jnp.int32, (q.shape[0], page_size), 0) // n_rep_p
-                limit = length - n_tokens + j + 1
-                if max_pos is not None:
-                    # positions >= max_pos were never written (write cap
-                    # below): a query past the cap sees the real prefix only
-                    limit = jnp.minimum(limit, max_pos)
-            s = jnp.where(pos < limit, s, NEG_INF)
-
-            m_prev = m_scr[:, :1]
+                qs[ki], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale  # [rows, ps]
+            s = jnp.where(masked, s, NEG_INF)
+            m_prev = m_scr[ki, :, :1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
             alpha = jnp.exp(m_prev - m_new)
             pw = jnp.exp(s - m_new)
             pw = jnp.where(m_new > NEG_INF * 0.5, pw, 0.0)
-            l_scr[:] = jnp.broadcast_to(
-                alpha * l_scr[:, :1] + jnp.sum(pw, axis=1, keepdims=True), l_scr.shape
-            )
-            vv = v_scr[slot].astype(jnp.float32)
-            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-                pw, vv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-            return _
+            l_scr[ki] = jnp.broadcast_to(
+                alpha * l_scr[ki, :, :1] + jnp.sum(pw, axis=1, keepdims=True),
+                l_scr.shape[1:])
+            vv = v_scr[slot, ki].astype(jnp.float32)
+            acc_scr[ki] = acc_scr[ki] * alpha + jax.lax.dot_general(
+                pw, vv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[ki] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+        return _
 
-        jax.lax.fori_loop(0, n_pages, body, None)
+    jax.lax.fori_loop(0, n_pages, body, None)
 
-        @pl.when(n_pages > 0)
-        def _write(ki=ki):
-            l = l_scr[:, :1]
-            out = acc_scr[:] / jnp.where(l > 0, l, 1.0)
+    @pl.when(n_pages > 0)
+    def _write():
+        for ki in range(kh):
+            l = l_scr[ki, :, :1]
+            out = acc_scr[ki] / jnp.where(l > 0, l, 1.0)
             if get_vscale is not None:
                 # per-channel V scale on the output axis: pw·(s⊙v8) =
                 # (pw·v8)⊙s — folded once per head after the loop
                 out = out * get_vscale(b, ki)[None, :]
             o_ref[ki] = out.astype(o_ref.dtype)
-
-        if after_head is not None:
-            after_head(ki)
 
 
 def _make_rmw(
@@ -323,18 +320,18 @@ def _make_rmw(
             # Mosaic's divisibility prover can't see through rem; the w*wh
             # form it can.
             off = pl.ds(jax.lax.rem(jax.lax.div(start, wh), page_size // wh) * wh, wh)
-            return (pltpu.make_async_copy(k_out.at[ki, page, off],
+            return (pltpu.make_async_copy(k_out.at[page, ki, off],
                                           k8_scr.at[ki, wi], wsem.at[si, 0]),
-                    pltpu.make_async_copy(v_out.at[ki, page, off],
+                    pltpu.make_async_copy(v_out.at[page, ki, off],
                                           v8_scr.at[ki, wi], wsem.at[si, 1]))
 
         def write_copies(ki, wi, start, page):
             si = ki * n_win + wi
             off = pl.ds(jax.lax.rem(jax.lax.div(start, wh), page_size // wh) * wh, wh)
             return (pltpu.make_async_copy(k8_scr.at[ki, wi],
-                                          k_out.at[ki, page, off], wsem.at[si, 0]),
+                                          k_out.at[page, ki, off], wsem.at[si, 0]),
                     pltpu.make_async_copy(v8_scr.at[ki, wi],
-                                          v_out.at[ki, page, off], wsem.at[si, 1]))
+                                          v_out.at[page, ki, off], wsem.at[si, 1]))
 
         def start_reads():
             for ki in range(kh):
@@ -470,7 +467,7 @@ def paged_decode_pallas_multi(
     attended — a clamped length would instead slide the whole write span
     backwards over real cache entries."""
     b, t, h, hd = q.shape
-    kh = k_pages.shape[0]
+    kh = k_pages.shape[1]
     ps = k_pages.shape[2]
     n_rep = h // kh
     n_rep_p = -(-n_rep // 8) * 8
@@ -504,11 +501,11 @@ def paged_decode_pallas_multi(
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, ps, hd), k_pages.dtype),
-            pltpu.VMEM((2, ps, hd), v_pages.dtype),
-            pltpu.VMEM((rows, hd), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),  # whole pages
+            pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+            pltpu.VMEM((kh, rows, hd), jnp.float32),
+            pltpu.VMEM((kh, rows, 128), jnp.float32),
+            pltpu.VMEM((kh, rows, 128), jnp.float32),
             pltpu.VMEM((kh, n_win, 8, hd), k_pages.dtype),
             pltpu.VMEM((kh, n_win, 8, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
@@ -567,7 +564,7 @@ def paged_decode_multi_xla(
     page (the stale-length degenerate class).  Skipped writes park on the
     reserved null page (id 0)."""
     b, t, h, hd = q.shape
-    kh, _, ps, _ = k_pages.shape
+    _, kh, ps, _ = k_pages.shape
     w = page_tables.shape[1]
     base = jnp.maximum(kv_lens - t, 0)
     pos = base[:, None] + jnp.arange(t)[None, :]  # [B, T]
@@ -584,13 +581,15 @@ def paged_decode_multi_xla(
 
         k_new = kv_quant(k_new, kv_scales[0])
         v_new = kv_quant(v_new, kv_scales[1])
-    k_pages = k_pages.at[:, page, off].set(k_new.transpose(2, 0, 1, 3))
-    v_pages = v_pages.at[:, page, off].set(v_new.transpose(2, 0, 1, 3))
+    # page-major scatter: advanced indices (page, off) with the head slice
+    # between put the advanced dims first -> updates take [B, T, K, hd]
+    k_pages = k_pages.at[page, :, off].set(k_new)
+    v_pages = v_pages.at[page, :, off].set(v_new)
 
     n_rep = h // kh
-    k_win = k_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+    k_win = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(
         b, w * ps, kh, hd)
-    v_win = v_pages[:, page_tables].transpose(1, 2, 3, 0, 4).reshape(
+    v_win = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(
         b, w * ps, kh, hd)
     if kv_scales is not None:
         from lmrs_tpu.ops.quant import kv_dequant
@@ -636,7 +635,7 @@ def paged_decode_pallas_fused(
     the walk and V's into the accumulator after it, the RMW quantizes the
     new token's rows, and windows are 32 rows (the int8 sublane tile)."""
     b, h, hd = q.shape
-    kh = k_pages.shape[0]
+    kh = k_pages.shape[1]
     ps = k_pages.shape[2]
     quantized = kscale is not None
     assert quantized == (k_pages.dtype == jnp.int8), (
@@ -688,11 +687,11 @@ def paged_decode_pallas_fused(
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, ps, hd), k_pages.dtype),  # double-buffered pages
-            pltpu.VMEM((2, ps, hd), v_pages.dtype),
-            pltpu.VMEM((n_rep_p, hd), jnp.float32),
-            pltpu.VMEM((n_rep_p, 128), jnp.float32),
-            pltpu.VMEM((n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),  # whole pages x2
+            pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+            pltpu.VMEM((kh, n_rep_p, hd), jnp.float32),
+            pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
             pltpu.VMEM((kh, 1, wh, hd), k_pages.dtype),  # one RMW window
             pltpu.VMEM((kh, 1, wh, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
@@ -710,17 +709,14 @@ def paged_decode_pallas_fused(
             (k_hbm, v_hbm, o_ref, k_out, v_out, k_scr, v_scr, acc_scr,
              m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
             gks = gvs = None
-        # Cross-row software pipeline (round 3, after the kv-head fold):
-        # the fixed decode cost was measured at ~7.7 us per batch row —
-        # dominated by each grid iteration serializing RMW-write -> drain ->
-        # walk and by the walk's first-page DMA stall.  Rows' pages are
-        # DISJOINT (slots own their pages exclusively), so iteration b now:
-        #   1. walks row b (its first page was DMA'd by iteration b-1),
-        #   2. runs row b+1's RMW cycle between head loops (reads after
-        #      head 0, blend+write after head 1, drain after the last head
-        #      — each phase's DMA latency hides behind page streaming),
-        #   3. primes row b+1's first page fetch (safe: the RMW for b+1
-        #      drained in step 2, so even a 1-page row reads fresh K/V).
+        # Cross-row software pipeline (round 3): rows' pages are DISJOINT
+        # (slots own their pages exclusively), so iteration b
+        #   1. starts row b+1's RMW window READS (tiny DMAs that land
+        #      while row b's pages stream),
+        #   2. walks row b (its first page was DMA'd by iteration b-1),
+        #   3. blends + writes + drains row b+1's RMW and primes row b+1's
+        #      first page fetch (safe: the RMW just drained, so even a
+        #      1-page row reads fresh K/V).
         # Iteration 0 bootstraps its own RMW + prime inline.  Exactly one
         # RMW cycle is in flight at a time, so the shared scratch/sems are
         # race-free; the n_tokens=1 degenerate of the multi-token writer
@@ -743,11 +739,11 @@ def paged_decode_pallas_fused(
 
         def prime_row(row):
             # same fetch layout as the walk's body: the wait at the next
-            # iteration's step 0 is fetch(head 0, page 0, slot 0)
+            # iteration's step 0 is fetch(page 0, slot 0)
             @pl.when(_n_live_pages(pt_ref, len_ref, row, ps) > 0)
             def _():
                 _fetch_page(pt_ref, k_out, v_out, k_scr, v_scr, sem,
-                            row, 0, 0, 0)
+                            row, 0, 0)
 
         @pl.when(bi == 0)
         def _bootstrap():
@@ -757,28 +753,23 @@ def paged_decode_pallas_fused(
             dr()
             prime_row(0)
 
-        def after_head(ki):
-            if ki == 0:
-                @pl.when(nxt < nb)
-                def _():
-                    nxt_reads()
-            if ki == min(1, kh - 1):
-                @pl.when(nxt < nb)
-                def _():
-                    nxt_blend()
-            if ki == kh - 1:
-                @pl.when(nxt < nb)
-                def _():
-                    nxt_drain()
-                    prime_row(nxt)
+        @pl.when(nxt < nb)
+        def _next_rmw_reads():
+            nxt_reads()
 
         _ragged_decode_all_heads(
             pt_ref, len_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
             k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
             page_size=ps, sm_scale=hd**-0.5, kh=kh,
-            external_prime=True, after_head=after_head,
+            external_prime=True,
             get_kscale=gks, get_vscale=gvs,
         )
+
+        @pl.when(nxt < nb)
+        def _next_rmw_write():
+            nxt_blend()
+            nxt_drain()
+            prime_row(nxt)
 
     # operand order after the 2 scalar-prefetch args: qg, knew, vnew,
     # [kscale, vscale,] k_pages, v_pages — the pool alias indices shift by 2
@@ -830,7 +821,7 @@ def paged_decode_fused_sharded(
     from jax.sharding import PartitionSpec as P
 
     head = P(None, "tp", None)
-    pool = P("tp", None, None, None)
+    pool = P(None, "tp", None, None)  # page-major: kv heads are axis 1
     extra_in = ()
     extra_args = ()
     if kscale is not None:
@@ -866,7 +857,7 @@ def paged_decode_pallas(
     interpret: bool = False,
 ) -> jnp.ndarray:
     b, h, hd = q.shape
-    kh, _, ps, _ = k_pages.shape
+    _, kh, ps, _ = k_pages.shape
     n_rep = h // kh
     # group query heads by kv head: [B, K, n_rep, hd].  The group dim is a
     # Mosaic block sublane dim, so pad it to 8 rows (bf16/f32 tiling both
@@ -887,11 +878,11 @@ def paged_decode_pallas(
         ],
         out_specs=pl.BlockSpec((1, kh, n_rep_p, hd), lambda bi, *_: (bi, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, ps, hd), k_pages.dtype),  # double-buffered pages
-            pltpu.VMEM((2, ps, hd), v_pages.dtype),
-            pltpu.VMEM((n_rep_p, hd), jnp.float32),
-            pltpu.VMEM((n_rep_p, 128), jnp.float32),
-            pltpu.VMEM((n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),  # whole pages x2
+            pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+            pltpu.VMEM((kh, n_rep_p, hd), jnp.float32),
+            pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
+            pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )

@@ -99,8 +99,8 @@ def check_fused_ragged_decode(interpret: bool) -> float:
     q = jnp.asarray(rng.standard_normal((b, h, hd)), jnp.bfloat16)
     k_new = jnp.asarray(rng.standard_normal((b, kh, hd)), jnp.bfloat16)
     v_new = jnp.asarray(rng.standard_normal((b, kh, hd)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((kh, n_pages, ps, hd)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((kh, n_pages, ps, hd)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((n_pages, kh, ps, hd)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((n_pages, kh, ps, hd)), jnp.bfloat16)
     # distinct pages per row; page 0 reserved as the null page
     tables = jnp.asarray(1 + np.arange(b * w).reshape(b, w), jnp.int32)
     # lengths: first-page partial / exact page boundary / mid window + odd
@@ -115,8 +115,8 @@ def check_fused_ragged_decode(interpret: bool) -> float:
     kp_ref, vp_ref = np.asarray(kp, np.float32), np.asarray(vp, np.float32)
     for i in range(b):
         page = int(np.asarray(tables)[i, pos[i] // ps])
-        kp_ref[:, page, pos[i] % ps] = np.asarray(k_new, np.float32)[i]
-        vp_ref[:, page, pos[i] % ps] = np.asarray(v_new, np.float32)[i]
+        kp_ref[page, :, pos[i] % ps] = np.asarray(k_new, np.float32)[i]
+        vp_ref[page, :, pos[i] % ps] = np.asarray(v_new, np.float32)[i]
     kp_ref = jnp.asarray(kp_ref, jnp.bfloat16)
     vp_ref = jnp.asarray(vp_ref, jnp.bfloat16)
     want = paged_decode_xla(q, kp_ref, vp_ref, tables, kv_lens)
@@ -124,8 +124,8 @@ def check_fused_ragged_decode(interpret: bool) -> float:
     d = _maxdiff(got, want)
     # the in-place write must also land exactly (pool parity at the touched
     # slots — only compare allocated pages; untouched pages must be intact)
-    d = max(d, _maxdiff(kp_out[:, 1:1 + b * w], kp_ref[:, 1:1 + b * w]))
-    d = max(d, _maxdiff(vp_out[:, 1:1 + b * w], vp_ref[:, 1:1 + b * w]))
+    d = max(d, _maxdiff(kp_out[1:1 + b * w], kp_ref[1:1 + b * w]))
+    d = max(d, _maxdiff(vp_out[1:1 + b * w], vp_ref[1:1 + b * w]))
     return d
 
 
@@ -141,8 +141,8 @@ def check_multi_token_verify(interpret: bool) -> float:
     q = jnp.asarray(rng.standard_normal((b, t, h, hd)), jnp.bfloat16)
     k_new = jnp.asarray(rng.standard_normal((b, t, kh, hd)), jnp.bfloat16)
     v_new = jnp.asarray(rng.standard_normal((b, t, kh, hd)), jnp.bfloat16)
-    kp = jnp.asarray(rng.standard_normal((kh, n_pages, ps, hd)), jnp.bfloat16)
-    vp = jnp.asarray(rng.standard_normal((kh, n_pages, ps, hd)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((n_pages, kh, ps, hd)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((n_pages, kh, ps, hd)), jnp.bfloat16)
     tables = jnp.asarray(1 + np.arange(b * 3).reshape(b, 3), jnp.int32)
     kv_lens = jnp.asarray([ps + 2, 131], jnp.int32)  # page + window straddles
 
@@ -151,8 +151,8 @@ def check_multi_token_verify(interpret: bool) -> float:
     got, k_out, v_out = paged_decode_pallas_multi(
         q, k_new, v_new, kp, vp, tables, kv_lens, interpret=interpret)
     d = _maxdiff(got, want)
-    d = max(d, _maxdiff(k_out[:, 1:1 + b * 3], k_ref[:, 1:1 + b * 3]))
-    return max(d, _maxdiff(v_out[:, 1:1 + b * 3], v_ref[:, 1:1 + b * 3]))
+    d = max(d, _maxdiff(k_out[1:1 + b * 3], k_ref[1:1 + b * 3]))
+    return max(d, _maxdiff(v_out[1:1 + b * 3], v_ref[1:1 + b * 3]))
 
 
 def check_int8_forward() -> float:
@@ -189,8 +189,8 @@ def check_int8_kv_decode(interpret: bool) -> float:
 
     rng = np.random.default_rng(9)
     B, H, K, hd, ps, P, W = 8, 16, 8, 128, 512, 40, 4
-    kq = jnp.asarray(rng.integers(-127, 128, (K, P, ps, hd)), jnp.int8)
-    vq = jnp.asarray(rng.integers(-127, 128, (K, P, ps, hd)), jnp.int8)
+    kq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
     tables = jnp.asarray(
         rng.permutation(P - 1)[: B * W].reshape(B, W) + 1, jnp.int32)
     lens = jnp.asarray(rng.integers(33, W * ps, (B,)), jnp.int32)
@@ -206,10 +206,10 @@ def check_int8_kv_decode(interpret: bool) -> float:
     pos = lens - 1
     page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
     off = pos % ps
-    kq_ref = kq.at[:, page, off].set(
-        kv_quant(kn[:, None].astype(jnp.float32), ks)[:, 0].transpose(1, 0, 2))
-    vq_ref = vq.at[:, page, off].set(
-        kv_quant(vn[:, None].astype(jnp.float32), vs)[:, 0].transpose(1, 0, 2))
+    kq_ref = kq.at[page, :, off].set(
+        kv_quant(kn[:, None].astype(jnp.float32), ks)[:, 0])
+    vq_ref = vq.at[page, :, off].set(
+        kv_quant(vn[:, None].astype(jnp.float32), vs)[:, 0])
     want = paged_decode_xla(q, kq_ref, vq_ref, tables, lens,
                             kv_scales=(ks, vs))
     wdiff = int(jnp.sum(kq1 != kq_ref)) + int(jnp.sum(vq1 != vq_ref))

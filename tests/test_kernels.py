@@ -73,8 +73,8 @@ def test_fused_decode_matches_scatter_plus_xla():
 
     b, h, kh, hd, ps, n_pages = 2, 4, 4, 128, 16, 12
     rng = jax.random.split(jax.random.PRNGKey(0), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
@@ -87,8 +87,8 @@ def test_fused_decode_matches_scatter_plus_xla():
     pos = kv_lens - 1
     page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
     off = pos % ps
-    k_ref = k_pages.at[:, page, off].set(k_new.transpose(1, 0, 2))
-    v_ref = v_pages.at[:, page, off].set(v_new.transpose(1, 0, 2))
+    k_ref = k_pages.at[page, :, off].set(k_new)
+    v_ref = v_pages.at[page, :, off].set(v_new)
     want = paged_decode_xla(q, k_ref, v_ref, tables, kv_lens)
 
     got, k_out, v_out = paged_decode_pallas_fused(
@@ -111,8 +111,8 @@ def test_ragged_decode_clamps_stale_lengths():
 
     b, h, kh, hd, ps, n_pages = 2, 4, 4, 128, 16, 12
     rng = jax.random.split(jax.random.PRNGKey(1), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
@@ -135,8 +135,8 @@ def test_ragged_decode_clamps_stale_lengths():
     # — so output parity here genuinely discriminates fixed vs broken.
     pos0 = int(kv_lens[0]) - 1  # row 0 only; row 1's write is skipped
     page0, off0 = int(tables[0, pos0 // ps]), pos0 % ps
-    k_ref = k_pages.at[:, page0, off0].set(k_new[0])
-    v_ref = v_pages.at[:, page0, off0].set(v_new[0])
+    k_ref = k_pages.at[page0, :, off0].set(k_new[0])
+    v_ref = v_pages.at[page0, :, off0].set(v_new[0])
     want = paged_decode_xla(q, k_ref, v_ref, tables, clamped)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -167,8 +167,8 @@ def test_fused_decode_sharded_matches_xla():
 
     b, h, kh, hd, ps, n_pages = 3, 8, 2, 128, 16, 12
     rng = jax.random.split(jax.random.PRNGKey(2), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
@@ -178,8 +178,8 @@ def test_fused_decode_sharded_matches_xla():
     pos = kv_lens - 1
     page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
     off = pos % ps
-    k_ref = k_pages.at[:, page, off].set(k_new.transpose(1, 0, 2))
-    v_ref = v_pages.at[:, page, off].set(v_new.transpose(1, 0, 2))
+    k_ref = k_pages.at[page, :, off].set(k_new)
+    v_ref = v_pages.at[page, :, off].set(v_new)
     want = paged_decode_xla(q, k_ref, v_ref, tables, kv_lens)
 
     got, k_out, v_out = paged_decode_fused_sharded(
@@ -224,8 +224,8 @@ def test_multi_token_verify_matches_xla_reference():
 
     b, t, h, kh, hd, ps, n_pages = 3, 5, 8, 4, 128, 16, 16
     rng = jax.random.split(jax.random.PRNGKey(3), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
@@ -257,8 +257,8 @@ def test_multi_token_verify_gqa_and_t1_degenerate():
     b, h, kh, hd, ps, n_pages = 2, 8, 2, 128, 16, 8
     for t in (1, 4):
         rng = jax.random.split(jax.random.PRNGKey(10 + t), 5)
-        k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-        v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+        k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+        v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
         q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
         k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
         v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
@@ -287,8 +287,8 @@ def test_multi_token_verify_max_pos_boundary():
     b, t, h, kh, hd, ps, n_pages = 2, 4, 4, 2, 128, 16, 8
     max_pos = 32  # 2 pages of capacity
     rng = jax.random.split(jax.random.PRNGKey(5), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
@@ -306,14 +306,14 @@ def test_multi_token_verify_max_pos_boundary():
                                rtol=2e-5, atol=2e-5)
     # pool parity on the real pages (null page 0 excluded: the reference
     # parks overhang writes there by contract)
-    np.testing.assert_array_equal(np.asarray(k_out[:, 1:5]),
-                                  np.asarray(k_ref[:, 1:5]))
-    np.testing.assert_array_equal(np.asarray(v_out[:, 1:5]),
-                                  np.asarray(v_ref[:, 1:5]))
+    np.testing.assert_array_equal(np.asarray(k_out[1:5]),
+                                  np.asarray(k_ref[1:5]))
+    np.testing.assert_array_equal(np.asarray(v_out[1:5]),
+                                  np.asarray(v_ref[1:5]))
     # and the overhang really was suppressed: row 0's pre-cap cache entries
     # at positions 28..29 (page 2, offsets 12..13) are untouched
-    np.testing.assert_array_equal(np.asarray(k_out[:, 2, 12:14]),
-                                  np.asarray(k_pages[:, 2, 12:14]))
+    np.testing.assert_array_equal(np.asarray(k_out[2, :, 12:14]),
+                                  np.asarray(k_pages[2, :, 12:14]))
 
 
 def test_multi_token_verify_no_window_alias_at_table_edge():
@@ -330,8 +330,8 @@ def test_multi_token_verify_no_window_alias_at_table_edge():
 
     b, t, h, kh, hd, ps, n_pages = 1, 5, 4, 2, 128, 8, 8
     rng = jax.random.split(jax.random.PRNGKey(9), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
@@ -348,10 +348,10 @@ def test_multi_token_verify_no_window_alias_at_table_edge():
                                rtol=2e-5, atol=2e-5)
     # the freshly written rows must SURVIVE (an aliased stale write-back
     # reverted them before this fix); pages 1-2 are the row's real pages
-    np.testing.assert_array_equal(np.asarray(k_out[:, 1:3]),
-                                  np.asarray(k_ref[:, 1:3]))
-    np.testing.assert_array_equal(np.asarray(v_out[:, 1:3]),
-                                  np.asarray(v_ref[:, 1:3]))
+    np.testing.assert_array_equal(np.asarray(k_out[1:3]),
+                                  np.asarray(k_ref[1:3]))
+    np.testing.assert_array_equal(np.asarray(v_out[1:3]),
+                                  np.asarray(v_ref[1:3]))
 
 
 def test_multi_token_verify_out_of_span_skips_on_both_paths():
@@ -368,8 +368,8 @@ def test_multi_token_verify_out_of_span_skips_on_both_paths():
 
     b, t, h, kh, hd, ps, n_pages = 2, 3, 4, 2, 128, 16, 8
     rng = jax.random.split(jax.random.PRNGKey(21), 5)
-    k_pages = jax.random.normal(rng[0], (kh, n_pages, ps, hd), jnp.float32)
-    v_pages = jax.random.normal(rng[1], (kh, n_pages, ps, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
     q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
     k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
     v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
@@ -387,5 +387,5 @@ def test_multi_token_verify_out_of_span_skips_on_both_paths():
     # row 1's real pages (3, 4) untouched on BOTH paths
     for pool_out, pool_in in ((k_ref, k_pages), (v_ref, v_pages),
                               (k_out, k_pages), (v_out, v_pages)):
-        np.testing.assert_array_equal(np.asarray(pool_out[:, 3:5]),
-                                      np.asarray(pool_in[:, 3:5]))
+        np.testing.assert_array_equal(np.asarray(pool_out[3:5]),
+                                      np.asarray(pool_in[3:5]))

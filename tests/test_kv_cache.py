@@ -61,8 +61,8 @@ def test_ragged_kernel_matches_xla_fallback():
     key = jax.random.PRNGKey(0)
     B, H, K, hd, P, ps, W = 2, 4, 2, 128, 12, 32, 5
     q = jax.random.normal(key, (B, H, hd), jnp.float32)
-    kp = jax.random.normal(jax.random.fold_in(key, 1), (K, P, ps, hd), jnp.float32)
-    vp = jax.random.normal(jax.random.fold_in(key, 2), (K, P, ps, hd), jnp.float32)
+    kp = jax.random.normal(jax.random.fold_in(key, 1), (P, K, ps, hd), jnp.float32)
+    vp = jax.random.normal(jax.random.fold_in(key, 2), (P, K, ps, hd), jnp.float32)
     pt = jnp.asarray(np.random.default_rng(0).permutation(P)[: B * W].reshape(B, W))
     kv_lens = jnp.array([150, 33])
     ref = paged_decode_xla(q, kp, vp, pt, kv_lens)

@@ -130,8 +130,8 @@ def test_decode_logits_match_fake_quant_reference():
     lens = jnp.asarray([S, S - 5], jnp.int32)
 
     # int8 run: fresh prefill computes scales + writes int8
-    kq = jnp.zeros((K, npages, ps, hd), jnp.int8)
-    vq = jnp.zeros((K, npages, ps, hd), jnp.int8)
+    kq = jnp.zeros((npages, K, ps, hd), jnp.int8)
+    vq = jnp.zeros((npages, K, ps, hd), jnp.int8)
     ksc = jnp.ones((cfg.n_layers, B, K, hd), jnp.float32)
     vsc = jnp.ones((cfg.n_layers, B, K, hd), jnp.float32)
     lg_q, kq, vq, (ksc, vsc) = forward_paged(
@@ -139,8 +139,8 @@ def test_decode_logits_match_fake_quant_reference():
         cfg.max_seq_len, kv_scales=(ksc, vsc))
 
     # full-precision run, then fake-quantize the pool contents in place
-    kf = jnp.zeros((K, npages, ps, hd), jnp.float32)
-    vf = jnp.zeros((K, npages, ps, hd), jnp.float32)
+    kf = jnp.zeros((npages, K, ps, hd), jnp.float32)
+    vf = jnp.zeros((npages, K, ps, hd), jnp.float32)
     lg_f, kf, vf = forward_paged(
         params, cfg, tokens, positions, kf, vf, tables, lens,
         cfg.max_seq_len)
@@ -158,8 +158,8 @@ def test_decode_logits_match_fake_quant_reference():
                 g = li * 8 + pg
                 sk = ksc_n[li, b][:, None]  # [K, 1, hd]
                 sv = vsc_n[li, b][:, None]
-                kf_n[:, g] = np.clip(np.round(kf_n[:, g] / sk), -127, 127) * sk
-                vf_n[:, g] = np.clip(np.round(vf_n[:, g] / sv), -127, 127) * sv
+                kf_n[g] = np.clip(np.round(kf_n[g] / sk), -127, 127) * sk
+                vf_n[g] = np.clip(np.round(vf_n[g] / sv), -127, 127) * sv
     # the WRITE path must be exact: dequantizing the int8 pool reproduces
     # the fake-quantized full-precision pool bit-for-bit (same scales, same
     # round/clip) on every tabled page
@@ -169,10 +169,10 @@ def test_decode_logits_match_fake_quant_reference():
             for w_, pg in enumerate(np.asarray(tables)[b]):
                 g = li * 8 + pg
                 rows = slice(0, max(0, min(ps, n_valid - w_ * ps)))
-                deq_k = np.asarray(kq)[:, g].astype(np.float32) \
+                deq_k = np.asarray(kq)[g].astype(np.float32) \
                     * ksc_n[li, b][:, None]
                 np.testing.assert_allclose(
-                    deq_k[:, rows], kf_n[:, g][:, rows], atol=1e-5)
+                    deq_k[:, rows], kf_n[g][:, rows], atol=1e-5)
 
     tok = jnp.asarray([[7], [9]], jnp.int32)
     pos1 = lens[:, None]
@@ -212,8 +212,8 @@ def test_int8_fused_kernel_matches_xla(monkeypatch):
     rng = np.random.default_rng(3)
     B, H, K, hd, ps, P = 3, 4, 2, 128, 64, 16
     W = 3
-    kq = jnp.asarray(rng.integers(-127, 128, (K, P, ps, hd)), jnp.int8)
-    vq = jnp.asarray(rng.integers(-127, 128, (K, P, ps, hd)), jnp.int8)
+    kq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
     tables = jnp.asarray(rng.permutation(P - 1)[: B * W].reshape(B, W) + 1,
                          jnp.int32)
     lens = jnp.asarray([ps * 2 + 17, 33, ps * 3], jnp.int32)
@@ -233,10 +233,8 @@ def test_int8_fused_kernel_matches_xla(monkeypatch):
     pos = lens - 1
     page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
     off = pos % ps
-    kq_ref = kq.at[:, page, off].set(
-        kv_quant(kn[:, None], ks)[:, 0].transpose(1, 0, 2))
-    vq_ref = vq.at[:, page, off].set(
-        kv_quant(vn[:, None], vs)[:, 0].transpose(1, 0, 2))
+    kq_ref = kq.at[page, :, off].set(kv_quant(kn[:, None], ks)[:, 0])
+    vq_ref = vq.at[page, :, off].set(kv_quant(vn[:, None], vs)[:, 0])
     want = paged_decode_xla(q, kq_ref, vq_ref, tables, lens,
                             kv_scales=(ks, vs))
 
