@@ -397,7 +397,11 @@ def forward_paged(
     b, s = tokens.shape
     hd = cfg.hd
     ps = k_pages.shape[2]
-    n_pool = k_pages.shape[1] // cfg.n_layers  # logical pages per layer
+    n_pool = k_pages.shape[0] // cfg.n_layers  # logical pages per layer
+    # (page-major pool [L*P, K, ps, hd]: pages are axis 0.  The round-3
+    # relayout left this reading axis 1 — the kv-head count — which
+    # collapsed every layer's global page ids onto the same few pages and
+    # corrupted all paged generation; VERDICT r3.)
     x = params["embed"]["weight"][tokens]
     if cfg.embed_scale:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(dt)

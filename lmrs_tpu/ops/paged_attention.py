@@ -70,8 +70,8 @@ def _fetch_page(page_tables_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
 
 def paged_decode_xla(
     q: jnp.ndarray,            # [B, H, hd]
-    k_pages: jnp.ndarray,      # [K, P, ps, hd]
-    v_pages: jnp.ndarray,      # [K, P, ps, hd]
+    k_pages: jnp.ndarray,      # [P, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W] page ids (live window)
     kv_lens: jnp.ndarray,      # [B] tokens in cache (incl. current)
     kv_scales=None,            # (k_scale, v_scale) [B, K, hd] for int8 pools
@@ -251,7 +251,7 @@ def _make_rmw(
     page_tables_ref, kv_lens_ref,
     get_knew,         # (row, ki) -> VMEM [t_pad, hd] the T new tokens' K
     get_vnew,
-    k_out,            # ANY  [K, P, ps, hd] aliased pool
+    k_out,            # ANY  [P, K, ps, hd] aliased pool
     v_out,
     k8_scr,           # VMEM [kh, n_win, 8, hd]
     v8_scr,
@@ -300,7 +300,7 @@ def _make_rmw(
         # overhanging window (past the table span or max_pos) must be
         # skipped entirely, not clipped: a clipped page index keeps the raw
         # offset and can ALIAS an earlier window's rows when
-        # page_size <= 8*(n_win-1) (e.g. ps=8 with any draft span ending at
+        # page_size <= wh*(n_win-1) (e.g. ps=8 with any draft span ending at
         # the table edge) — its stale write-back would then revert the valid
         # window's freshly written K/V.
         limit = jnp.minimum(base + n_tokens,
@@ -412,7 +412,7 @@ def _write_new_tokens_all_heads(
     page_tables_ref, kv_lens_ref,
     knew_ref,         # VMEM [kh, t_pad, hd] the T new tokens' K (rows 0..T-1)
     vnew_ref,
-    k_out,            # ANY  [K, P, ps, hd] aliased pool
+    k_out,            # ANY  [P, K, ps, hd] aliased pool
     v_out,
     k8_scr,           # VMEM [kh, n_win, 8, hd]
     v8_scr,
@@ -444,8 +444,8 @@ def paged_decode_pallas_multi(
     q: jnp.ndarray,            # [B, T, H, hd] queries (token-major)
     k_new: jnp.ndarray,        # [B, T, K, hd] the T tokens' K (post-rope)
     v_new: jnp.ndarray,        # [B, T, K, hd]
-    k_pages: jnp.ndarray,      # [K, P_total, ps, hd]
-    v_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    k_pages: jnp.ndarray,      # [P_total, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P_total, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W] GLOBAL page ids
     kv_lens: jnp.ndarray,      # [B] length INCLUDING all T tokens (UNclamped:
                                # may exceed max_pos near the cap; the base
@@ -548,8 +548,8 @@ def paged_decode_multi_xla(
     q: jnp.ndarray,            # [B, T, H, hd]
     k_new: jnp.ndarray,        # [B, T, K, hd]
     v_new: jnp.ndarray,        # [B, T, K, hd]
-    k_pages: jnp.ndarray,      # [K, P, ps, hd]
-    v_pages: jnp.ndarray,      # [K, P, ps, hd]
+    k_pages: jnp.ndarray,      # [P, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W]
     kv_lens: jnp.ndarray,      # [B] incl. the T tokens (unclamped; see kernel)
     max_pos: int | None = None,
@@ -615,8 +615,8 @@ def paged_decode_pallas_fused(
     q: jnp.ndarray,            # [B, H, hd]
     k_new: jnp.ndarray,        # [B, K, hd] current token K (post-rope)
     v_new: jnp.ndarray,        # [B, K, hd]
-    k_pages: jnp.ndarray,      # [K, P_total, ps, hd]
-    v_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    k_pages: jnp.ndarray,      # [P_total, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P_total, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W] GLOBAL page ids
     kv_lens: jnp.ndarray,      # [B] incl. current token
     interpret: bool = False,
@@ -799,8 +799,8 @@ def paged_decode_fused_sharded(
     q: jnp.ndarray,            # [B, H, hd] (H sharded over tp)
     k_new: jnp.ndarray,        # [B, K, hd] (K sharded over tp)
     v_new: jnp.ndarray,        # [B, K, hd]
-    k_pages: jnp.ndarray,      # [K, P_total, ps, hd] (kv-head sharded)
-    v_pages: jnp.ndarray,      # [K, P_total, ps, hd]
+    k_pages: jnp.ndarray,      # [P_total, K, ps, hd] (kv-head sharded)
+    v_pages: jnp.ndarray,      # [P_total, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W] replicated
     kv_lens: jnp.ndarray,      # [B] replicated
     mesh,
@@ -850,8 +850,8 @@ def paged_decode_fused_sharded(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_pallas(
     q: jnp.ndarray,            # [B, H, hd]
-    k_pages: jnp.ndarray,      # [K, P, ps, hd]
-    v_pages: jnp.ndarray,      # [K, P, ps, hd]
+    k_pages: jnp.ndarray,      # [P, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P, K, ps, hd]
     page_tables: jnp.ndarray,  # [B, W]
     kv_lens: jnp.ndarray,      # [B]
     interpret: bool = False,

@@ -144,7 +144,7 @@ def test_ragged_decode_clamps_stale_lengths():
     # 5); row 1's out-of-span write is skipped, not clipped; K and V both
     for name, out_pool, in_pool in (("k", k_out, k_pages), ("v", v_out, v_pages)):
         touched = set(np.flatnonzero(
-            (np.asarray(out_pool) != np.asarray(in_pool)).any(axis=(0, 2, 3))))
+            (np.asarray(out_pool) != np.asarray(in_pool)).any(axis=(1, 2, 3))))
         assert touched == {5}, f"{name} wrote pages {touched}, want {{5}}"
 
 

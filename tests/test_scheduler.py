@@ -176,7 +176,8 @@ def test_tp_sharded_continuous_serving_matches_single_device():
                                 max_tokens=16, max_batch_slots=2, seed=0),
                    tiny_model(), mesh_cfg=MeshConfig(dp=1, tp=2))
     kv = tp._scheduler.cache.k
-    assert kv.sharding.shard_shape(kv.shape)[0] == tiny_model().n_kv_heads // 2
+    # page-major pool [L*P, K, ps, hd]: kv heads shard on axis 1
+    assert kv.sharding.shard_shape(kv.shape)[1] == tiny_model().n_kv_heads // 2
     got = [r.text for r in tp.generate_batch(reqs)]
     tp.shutdown()
     assert got == want
