@@ -41,7 +41,7 @@ class GenerationResult:
     text: str = ""
     prompt_tokens: int = 0
     completion_tokens: int = 0
-    finish_reason: str = "stop"  # stop | length | error
+    finish_reason: str = "stop"  # stop | length | error | cancelled
     # which request stop string ended generation, if any — lets wire formats
     # that distinguish stop-sequence hits from EOS (Anthropic's
     # stop_reason="stop_sequence") report faithfully
@@ -117,6 +117,16 @@ class Engine(Protocol):
     # Protocol data member: runtime_checkable isinstance would then require
     # it on every implementation, and a Protocol class default is not
     # inherited structurally anyway.
+    #
+    # ``cancel(request_id: int) -> None`` — optional abort hook (same
+    # getattr convention).  Best-effort: aborts the id within the CURRENT
+    # generate_batch call at the backend's next safe point (the continuous
+    # scheduler frees the slot's pages at the next block boundary); the
+    # result comes back with finish_reason="cancelled" and whatever text
+    # was generated.  Callable from another thread while generate_batch
+    # runs — this is how the HTTP server propagates a client disconnect
+    # (the reference's asyncio gave cancellation for free,
+    # llm_executor.py:290-296; a batch engine must expose it).
 
 
 def drain_with_callback(run_batch, requests: list["GenerationRequest"],

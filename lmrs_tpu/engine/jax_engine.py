@@ -151,6 +151,15 @@ class JaxEngine:
     def shutdown(self) -> None:
         self._gen_fns.clear()
 
+    def cancel(self, request_id: int) -> None:
+        """Abort a request in the current generate_batch call (Engine
+        optional hook).  Continuous scheduler: slot freed at the next block
+        boundary.  Static scheduler: no mid-wave abort point exists (whole
+        completions decode in one on-device while_loop) — best-effort means
+        a no-op there."""
+        if self._scheduler is not None:
+            self._scheduler.cancel(request_id)
+
     def engine_metrics(self) -> dict:
         return self._scheduler.metrics_report() if self._scheduler else {}
 

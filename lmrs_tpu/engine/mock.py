@@ -34,9 +34,17 @@ class MockEngine:
         self.latency_s = latency_s
         self.fail_pattern = fail_pattern
         self._tok = ApproxTokenizer()
+        # ids cancel() was called for — generation is instantaneous here, so
+        # the hook only records (tests assert the server propagated a
+        # disconnect) and flags ids not yet generated in this batch
+        self.cancelled: set[int] = set()
 
     def generate_batch(self, requests: list[GenerationRequest],
                        on_result=None, on_tokens=None) -> list[GenerationResult]:
+        # request ids are only unique within one call (same contract as the
+        # continuous scheduler): stale cancels must not leak across batches
+        self.cancelled.clear()
+
         def one(req: GenerationRequest) -> GenerationResult:
             res = self._one(req)
             if on_tokens is not None and res.text:
@@ -54,12 +62,21 @@ class MockEngine:
     def shutdown(self) -> None:
         pass
 
+    def cancel(self, request_id: int) -> None:
+        """Engine optional abort hook (see engine/api.py).  Recorded; any
+        request of the current batch not yet generated when its id lands
+        here comes back finish_reason="cancelled"."""
+        self.cancelled.add(request_id)
+
     def engine_metrics(self) -> dict:
         return {}
 
     def _one(self, req: GenerationRequest) -> GenerationResult:
         if self.latency_s:
             time.sleep(self.latency_s)
+        if req.request_id in self.cancelled:
+            return GenerationResult(request_id=req.request_id,
+                                    finish_reason="cancelled")
         if self.fail_pattern and self.fail_pattern in req.prompt:
             return GenerationResult(
                 request_id=req.request_id,

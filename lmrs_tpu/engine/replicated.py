@@ -197,6 +197,14 @@ class ReplicatedEngine:
                 out[pos] = res
         return [r for r in out if r is not None]
 
+    def cancel(self, request_id: int) -> None:
+        """Engine optional abort hook: forward to every replica — request
+        ids are unique across the wave (shards keep the caller's ids) and
+        unknown ids are a no-op per the contract, so broadcasting is
+        sufficient and race-free (scheduler.cancel is thread-safe)."""
+        for replica in self.replicas:
+            replica.cancel(request_id)
+
     def shutdown(self) -> None:
         for replica in self.replicas:
             replica.shutdown()

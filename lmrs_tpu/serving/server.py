@@ -41,7 +41,7 @@ logger = logging.getLogger("lmrs.serving")
 
 
 class _Job:
-    __slots__ = ("request", "result", "event", "deltas")
+    __slots__ = ("request", "result", "event", "deltas", "rid", "cancelled")
 
     def __init__(self, request: GenerationRequest, stream: bool = False):
         self.request = request
@@ -52,6 +52,8 @@ class _Job:
         # ``result`` is set) ends the stream
         self.deltas: queue.Queue[str | None] | None = (
             queue.Queue() if stream else None)
+        self.rid: int | None = None  # wave-relative id, set by the dispatcher
+        self.cancelled = False  # set by _Batcher.cancel (handler threads)
 
 
 class _Batcher:
@@ -69,6 +71,10 @@ class _Batcher:
         # completes it) or rejected fast — event.wait() can never hang a
         # handler thread on a job the dispatcher will never see
         self._close_lock = threading.Lock()
+        # jobs of the wave currently inside generate_batch, by wave rid —
+        # cancel() consults it to route an abort into the running engine
+        # call (handler threads read it; only the dispatcher writes it)
+        self._inflight: dict[int, _Job] = {}
         self.batches_run = 0
         self.requests_served = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -99,6 +105,20 @@ class _Batcher:
                 return job
             self.queue.put(job)
         return job
+
+    def cancel(self, job: _Job) -> None:
+        """Abort ``job`` (client disconnected).  Queued jobs are dropped
+        before dispatch; a job already inside the running engine wave is
+        aborted through the engine's optional ``cancel`` hook — the
+        continuous scheduler then frees its slot and pages at the next
+        block boundary instead of decoding to max_tokens.  Thread-safe:
+        called from HTTP handler threads."""
+        job.cancelled = True
+        rid = job.rid
+        if rid is not None and self._inflight.get(rid) is job:
+            eng_cancel = getattr(self.engine, "cancel", None)
+            if eng_cancel is not None:
+                eng_cancel(rid)
 
     def shutdown(self) -> None:
         with self._close_lock:
@@ -150,10 +170,29 @@ class _Batcher:
     def _run(self, jobs: list[_Job]) -> None:
         for i, job in enumerate(jobs):  # engine results map back by id
             job.request.request_id = i
+            job.rid = i
+        # publish the wave BEFORE dispatch so cancel() can route a
+        # disconnect into the running engine call; then drop jobs already
+        # cancelled while queued (their clients are gone — finish them
+        # without spending engine work).  A cancel racing between these two
+        # steps at worst does both: an inert engine.cancel for an
+        # undispatched rid, cleared at the engine run's end.
+        self._inflight = {i: j for i, j in enumerate(jobs)}
+        skipped = [j for j in jobs if j.cancelled]
+        jobs = [j for j in jobs if not j.cancelled]
+        for job in skipped:
+            job.result = GenerationResult(request_id=job.rid,
+                                          finish_reason="cancelled")
+            job.event.set()
+            if job.deltas is not None:
+                job.deltas.put(None)
+        if not jobs:
+            self._inflight = {}
+            return
         # route engine token deltas to their job's stream queue (rids are
         # the wave indices assigned above); queue.put is thread-safe, which
         # the replicated engine's concurrent fan-in requires
-        stream_jobs = {i: j for i, j in enumerate(jobs) if j.deltas is not None}
+        stream_jobs = {j.rid: j for j in jobs if j.deltas is not None}
         on_tokens = None
         if stream_jobs:
             def on_tokens(rid: int, delta: str) -> None:
@@ -169,16 +208,19 @@ class _Batcher:
         except Exception as e:  # degrade, never kill the dispatcher
             logger.exception("engine batch failure")
             results = [
-                GenerationResult(request_id=i, finish_reason="error", error=str(e))
-                for i in range(len(jobs))
+                GenerationResult(request_id=j.rid, finish_reason="error",
+                                 error=str(e))
+                for j in jobs
             ]
         self.batches_run += 1
         self.requests_served += len(jobs)
+        self._inflight = {}
         by_id = {r.request_id: r for r in results}
-        for i, job in enumerate(jobs):
+        for job in jobs:
             job.result = by_id.get(
-                i, GenerationResult(request_id=i, finish_reason="error",
-                                    error="engine returned no result"))
+                job.rid, GenerationResult(request_id=job.rid,
+                                          finish_reason="error",
+                                          error="engine returned no result"))
             job.event.set()
             if job.deltas is not None:  # sentinel strictly after result
                 job.deltas.put(None)
@@ -395,8 +437,11 @@ class EngineHTTPServer:
                                      "total_tokens": res.total_tokens}
                               if want_usage else None)
                     self._sse("[DONE]")
-                except OSError:  # client went away: stop writing, don't 500
-                    logger.debug("stream client disconnected")
+                except OSError:  # client went away: stop writing AND abort
+                    # the generation — without this the engine decodes an
+                    # abandoned request to max_tokens holding its slot+pages
+                    logger.debug("stream client disconnected; cancelling")
+                    outer.batcher.cancel(job)
 
             def _stream_anthropic(self, body: dict, job: _Job) -> None:
                 """Anthropic messages SSE (llm_executor.py:378's API,
@@ -447,8 +492,9 @@ class EngineHTTPServer:
                         event="message_delta")
                     self._sse(json.dumps({"type": "message_stop"}),
                               event="message_stop")
-                except OSError:
-                    logger.debug("stream client disconnected")
+                except OSError:  # same contract as the OpenAI stream path
+                    logger.debug("stream client disconnected; cancelling")
+                    outer.batcher.cancel(job)
 
             def _respond_openai(self, body: dict, res: GenerationResult) -> None:
                 if res.error is not None:
