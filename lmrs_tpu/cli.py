@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backend", "--provider", dest="backend", default=None,
                    help="engine backend: mock | jax (default: env/config)")
     p.add_argument("--model", default=None, help="model preset or checkpoint name")
+    p.add_argument("--checkpoint", default=None,
+                   help="Orbax checkpoint directory with the model weights "
+                        "(lmrs-train output or lmrs-convert from HF)")
     p.add_argument("--max-tokens-per-chunk", type=int, default=4000)
     p.add_argument("--overlap-tokens", type=int, default=200)
     p.add_argument("--max-concurrent-requests", type=int, default=None)
@@ -92,6 +95,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, model=args.model)
     if args.max_concurrent_requests is not None:
         engine = dataclasses.replace(engine, max_concurrent_requests=args.max_concurrent_requests)
+    if args.checkpoint:
+        engine = dataclasses.replace(engine, checkpoint_path=args.checkpoint)
     if args.quantize:
         engine = dataclasses.replace(engine, quantize=args.quantize)
     if args.kv_quantize:

@@ -153,6 +153,9 @@ class EngineConfig:
     # Exact-distribution verify (ops/speculative.py) — output quality is
     # unchanged; latency drops when summaries quote their source.
     speculate_k: int = 0
+    # n-gram length for prompt-lookup drafting (ops/speculative.draft_lookup):
+    # 3 collides far less than 2 on byte-level vocabularies (measured r4)
+    speculate_ngram: int = 3
     checkpoint_path: str | None = None
     quantize: str | None = None  # None | "int8" (weight-only; ops/quant.py)
     # int8 KV-cache pages (ops/quant.py KV section): halves decode's KV
@@ -274,6 +277,18 @@ def model_preset(name: str) -> ModelConfig:
         ),
         "tiny-moe": dict(
             hidden_dim=512, n_experts=4, n_experts_per_token=2,
+        ),
+        "quality-tiny": dict(
+            # CLI end-to-end quality gate (tests/test_quality.py): a byte-
+            # level model small enough to fine-tune inside the test suite on
+            # CPU, with a context window that fits the product-formatted map
+            # prompt (template + chunk context header + chunk body) without
+            # middle-truncation at the CLI's default generation budget.
+            # max_seq_len 1024: the product-formatted prompts are ~460
+            # bytes; CPU XLA compile time scales badly with the window
+            # (tests run this preset through the full CLI)
+            vocab_size=512, dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=256, max_seq_len=1024, dtype="float32",
         ),
         "bench-smoke": dict(
             # CPU smoke of the bench HARNESS itself (LMRS_BENCH_MODEL=

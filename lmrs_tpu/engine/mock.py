@@ -41,9 +41,9 @@ class MockEngine:
 
     def generate_batch(self, requests: list[GenerationRequest],
                        on_result=None, on_tokens=None) -> list[GenerationResult]:
-        # request ids are only unique within one call (same contract as the
-        # continuous scheduler): stale cancels must not leak across batches
-        self.cancelled.clear()
+        # no start-of-batch clear: a cancel can legitimately race the batch
+        # boundary (same reasoning as the scheduler's run()); callers keep
+        # ids unique across cancels (the HTTP batcher's rids are global)
 
         def one(req: GenerationRequest) -> GenerationResult:
             res = self._one(req)

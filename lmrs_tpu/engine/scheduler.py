@@ -159,11 +159,9 @@ class ContinuousScheduler:
         # (measured ~43% padded q rows at the bench shape).  LMRS_PACK_PREFILL=0
         # restores per-prompt prefill for A/B measurement.
         self._pack_prefill = os.environ.get("LMRS_PACK_PREFILL", "1") != "0"
-        if self._kv_quant:
-            # a packed row holds MANY prompts; per-slot scales can't cover it
-            # (packing measured neutral-to-+8%, docs/PERF.md round 2 — int8
-            # KV's halved decode bytes outweigh it on decode-bound runs)
-            self._pack_prefill = False
+        # int8 KV composes with packing since r4 (VERDICT r3 item 3): the
+        # packed program computes per-SEGMENT scales and scatters them into
+        # each segment's slot row — no gate needed
         # Serving-side context parallelism (SURVEY.md §5.7 tier b): under an
         # sp>1 mesh, LONG fresh prefills run cache-aware ring attention —
         # the sequence shards over sp, K/V still scatter into the page pool.
@@ -333,11 +331,14 @@ class ContinuousScheduler:
         tracked per request id, not per slot).
         """
         t_run = time.time()
-        # request ids are only unique within one run: a cancel that raced
-        # in after the previous run's end-of-run clear (or survived one
-        # that died mid-run) must not cancel an unrelated request that
-        # happens to reuse the same id in THIS run
-        self._cancelled.clear()
+        # NOTE: the cancel set is deliberately NOT cleared here.  A client
+        # disconnect can race the run boundary (cancel lands after
+        # generate_batch is invoked but before run() begins executing); a
+        # start-of-run clear would erase that legitimate cancel and the
+        # abandoned request would decode to max_tokens after all.  Cross-run
+        # id collisions are prevented by callers instead: the HTTP batcher
+        # assigns globally-unique wave rids, and the end-of-run clear (the
+        # finally below) drops ids that were never matched.
         self._on_tokens = on_tokens
         self._streamed: dict[int, str] = {}  # rid -> text already emitted
         # queue entries: (req, prefill_ids, max_new, n_prompt,
@@ -1089,6 +1090,9 @@ class ContinuousScheduler:
         temps = np.ones((self.B,), np.float32)
         tks = np.zeros((self.B,), np.int32)
         tps = np.ones((self.B,), np.float32)
+        # segment -> slot for the KV scale buffers (int8 KV): unused
+        # segments point one past the end (scale scatter drops them)
+        srows = np.full((self.B,), self.B, np.int32)
         off = 0
         for si, (b, st, chunk) in enumerate(items):
             n = len(chunk)
@@ -1102,12 +1106,14 @@ class ContinuousScheduler:
             temps[si] = st.req.temperature
             tks[si] = st.req.top_k
             tps[si] = min(max(st.req.top_p, 0.0), 1.0)
+            srows[si] = b
             st.prefill_pos = n
             self.metrics["prefill_tokens"] += n
             off += n
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
+            self.kscale, self.vscale, jnp.asarray(srows),
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(token_pages), jnp.asarray(seg_ids),
             jnp.asarray(last_idx), jnp.asarray([s_real], np.int32), sub,
@@ -1115,7 +1121,7 @@ class ContinuousScheduler:
         )
         key_ = ("packed", s_bucket)
         try:
-            tok0, self.cache.k, self.cache.v = \
+            tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
                 self._get_packed_prefill_fn(s_bucket)(*args)
         except Exception:
             # same contract as the fresh-prefill fallback: only degrade on a
@@ -1130,7 +1136,7 @@ class ContinuousScheduler:
             self._prefill_fns.clear()
             self._prefill_window_fns.clear()
             self._packed_prefill_fns.clear()
-            tok0, self.cache.k, self.cache.v = \
+            tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
                 self._get_packed_prefill_fn(s_bucket)(*args)
         self._ran_ok.add(key_)
         return tok0, [(b, si) for si, (b, _, _) in enumerate(items)]
@@ -1143,21 +1149,26 @@ class ContinuousScheduler:
         use_flash = self._use_flash
         mesh_ = self._kernel_mesh()
         interp = self._interpret
+        kv_q = bool(self._kv_quant)
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def packed_prefill(params, k_pages, v_pages, tokens, positions,
-                           token_pages, seg_ids, last_idx, length, key,
-                           temp, tk, tp):
-            logits, k_pages, v_pages = forward_paged(
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4) if kv_q else (1, 2))
+        def packed_prefill(params, k_pages, v_pages, kscale, vscale,
+                           scale_rows, tokens, positions, token_pages,
+                           seg_ids, last_idx, length, key, temp, tk, tp):
+            out = forward_paged(
                 params, cfg, tokens, positions, k_pages, v_pages,
                 jnp.zeros((1, 1), jnp.int32),  # tables unused: token_pages
                 length, rope_max, use_ragged_kernel=False,
                 use_flash=use_flash, mesh=mesh_, interpret=interp,
                 token_pages=token_pages, segment_ids=seg_ids,
                 packed_last_idx=last_idx,
+                kv_scales=(kscale, vscale) if kv_q else None,
+                scale_rows=scale_rows,
             )
+            logits, k_pages, v_pages = out[:3]
+            kscale, vscale = out[3] if kv_q else (None, None)
             tok0 = sample_logits(logits[0], key, temp, tk, tp)  # [B]
-            return tok0, k_pages, v_pages
+            return tok0, k_pages, v_pages, kscale, vscale
 
         logger.info("compiling packed prefill: bucket=%d segments<=%d "
                     "(flash=%s)", s_bucket, self.B, use_flash)
@@ -1461,6 +1472,7 @@ class ContinuousScheduler:
         cfg = self.model_cfg
         n_steps = self.decode_steps
         k = self.spec_k
+        ngram = max(2, self.cfg.speculate_ngram)
         eos_id = self.tokenizer.eos_id
         max_len = self.max_len
         rope_max = self.max_len
@@ -1485,7 +1497,8 @@ class ContinuousScheduler:
                 k_pages, v_pages, buf, tok, lens, done, key = carry
                 # current token enters the history at index == its KV position
                 buf = buf.at[b_rows[:, 0], jnp.minimum(lens, max_len - 1)].set(tok)
-                draft, n_valid = draft_lookup(buf, lens + 1, k, pad_id=eos_id)
+                draft, n_valid = draft_lookup(buf, lens + 1, k, pad_id=eos_id,
+                                              n=ngram)
 
                 toks_in = jnp.concatenate([tok[:, None], draft], axis=1)
                 positions = jnp.minimum(lens[:, None] + offs, max_len - 1)

@@ -138,12 +138,22 @@ def kv_scale_from(kv: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(jnp.max(a, axis=1) / 127.0, 1e-8)
 
 
+def kv_quant_tokens(kv: jnp.ndarray, token_scales: jnp.ndarray) -> jnp.ndarray:
+    """Quantize K or V rows with PER-TOKEN scales: the packed-prefill path,
+    where one [1, S] row holds many prompts and each token quantizes with
+    its own segment's (slot's) scales.  kv [B, S, K, hd],
+    token_scales [B, S, K, hd] (or broadcastable) -> int8 [B, S, K, hd].
+    THE int8 KV quantization rule — ``kv_quant`` delegates here so the
+    packed and per-row paths can never diverge."""
+    q = jnp.round(kv.astype(jnp.float32) / token_scales)
+    return jnp.clip(q, -127, 127).astype(jnp.int8)
+
+
 def kv_quant(kv: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """Quantize K or V rows with their row scales.  kv [B, S, K, hd],
     scale [B, K, hd] -> int8 [B, S, K, hd] (clipped: decode tokens reuse
     the prefill-time scale, so out-of-range values saturate)."""
-    q = jnp.round(kv.astype(jnp.float32) / scale[:, None])
-    return jnp.clip(q, -127, 127).astype(jnp.int8)
+    return kv_quant_tokens(kv, scale[:, None])
 
 
 def kv_dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:

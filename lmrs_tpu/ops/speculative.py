@@ -44,31 +44,39 @@ def draft_lookup(
     hist_len: jnp.ndarray,  # [B] valid tokens in buf
     k: int,
     pad_id: int = 0,
+    n: int = 2,         # n-gram length to match (EngineConfig.speculate_ngram)
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Propose k draft tokens per row by bigram lookup over the history.
+    """Propose k draft tokens per row by n-gram lookup over the history.
 
-    Finds the most recent position i < hist_len-2 with
-    (buf[i], buf[i+1]) == (buf[hist_len-2], buf[hist_len-1]) and drafts the
-    k tokens that followed it.  Returns (draft [B, k], n_valid [B]) with
-    n_valid == 0 when the row has no earlier occurrence (or < 2 tokens).
+    Finds the most recent position i with buf[i : i+n] equal to the LAST n
+    history tokens and drafts the k tokens that followed it.  Returns
+    (draft [B, k], n_valid [B]) with n_valid == 0 when the row has no
+    earlier occurrence (or < n tokens).  Longer n-grams collide less —
+    decisive for byte-level vocabularies, where bigrams recur everywhere
+    and drafts then continue the WRONG earlier occurrence (measured on the
+    trained copy-task model, docs/PERF.md round 4: acceptance ~1.0/step at
+    n=2 vs ~k at n=3 on verbatim-quoting decodes).
     """
     b, L = buf.shape
-    c1 = jnp.take_along_axis(buf, jnp.maximum(hist_len - 2, 0)[:, None], 1)  # [B,1]
-    c2 = jnp.take_along_axis(buf, jnp.maximum(hist_len - 1, 0)[:, None], 1)
-    idx = jnp.arange(L - 1)[None, :]  # candidate bigram start positions
-    match = (buf[:, :-1] == c1) & (buf[:, 1:] == c2)
-    # exclude the query bigram itself and anything whose draft window would
+    w = L - (n - 1)  # candidate n-gram start positions
+    idx = jnp.arange(w)[None, :]
+    match = jnp.ones((b, w), bool)
+    for j in range(n):
+        cj = jnp.take_along_axis(
+            buf, jnp.maximum(hist_len - n + j, 0)[:, None], 1)  # [B, 1]
+        match &= buf[:, j: j + w] == cj
+    # exclude the query n-gram itself and anything whose draft window would
     # start at/after the history end
-    match &= idx + 2 < hist_len[:, None]
+    match &= idx + n < hist_len[:, None]
     # a match so close to the buffer end that its k-token continuation
     # window would run past L can't be drafted from (the clip below would
     # silently slide the window onto unrelated tokens) — require room
-    match &= idx + 2 <= L - k
-    has = jnp.any(match, axis=1) & (hist_len >= 2)
+    match &= idx + n <= L - k
+    has = jnp.any(match, axis=1) & (hist_len >= n)
     # most recent match: argmax over idx * match
     pos = jnp.max(jnp.where(match, idx, -1), axis=1)  # [B], -1 if none
 
-    start = jnp.clip(pos + 2, 0, L - k)  # draft source window
+    start = jnp.clip(pos + n, 0, L - k)  # draft source window
     draft = jax.vmap(
         lambda row, s: jax.lax.dynamic_slice_in_dim(row, s, k)
     )(buf, start)

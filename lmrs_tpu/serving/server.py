@@ -75,6 +75,11 @@ class _Batcher:
         # cancel() consults it to route an abort into the running engine
         # call (handler threads read it; only the dispatcher writes it)
         self._inflight: dict[int, _Job] = {}
+        # rids increase monotonically ACROSS waves: a cancel that races a
+        # wave boundary (issued for wave N, observed by the engine around
+        # wave N+1) can then never alias another client's request — the
+        # stale id just no-ops (engine contract, engine/api.py)
+        self._next_rid = 0
         self.batches_run = 0
         self.requests_served = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -168,16 +173,18 @@ class _Batcher:
                 job.deltas.put(None)
 
     def _run(self, jobs: list[_Job]) -> None:
+        base = self._next_rid
+        self._next_rid += len(jobs)
         for i, job in enumerate(jobs):  # engine results map back by id
-            job.request.request_id = i
-            job.rid = i
+            job.request.request_id = base + i
+            job.rid = base + i
         # publish the wave BEFORE dispatch so cancel() can route a
         # disconnect into the running engine call; then drop jobs already
         # cancelled while queued (their clients are gone — finish them
         # without spending engine work).  A cancel racing between these two
         # steps at worst does both: an inert engine.cancel for an
         # undispatched rid, cleared at the engine run's end.
-        self._inflight = {i: j for i, j in enumerate(jobs)}
+        self._inflight = {j.rid: j for j in jobs}
         skipped = [j for j in jobs if j.cancelled]
         jobs = [j for j in jobs if not j.cancelled]
         for job in skipped:

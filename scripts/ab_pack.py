@@ -3,8 +3,11 @@
 One engine; sched._pack_prefill toggled between runs (both program families
 compile once).  Order A B B A per round; map-stage wall per arm.
 Run on the real chip: python scripts/ab_pack.py [max_new]
+LMRS_AB_KV=int8: both arms run int8 KV pools (the r4 composition row —
+packed+int8 vs unpacked+int8, VERDICT r3 item 3).
 """
 import _pathfix  # noqa: F401  (repo-root import shim)
+import os
 import sys
 import time
 
@@ -21,10 +24,13 @@ def main():
     max_new = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     setup_logging(quiet=True)
     model = model_preset("bench-1b")
+    kv = os.environ.get("LMRS_AB_KV") or None
     eng = JaxEngine(EngineConfig(
         backend="jax", max_tokens=max_new, max_batch_slots=24,
         retry_delay=0.0, seed=0, page_size=512, num_pages=1,
-        decode_block=max_new, prefill_chunk=4096), model)
+        decode_block=max_new, prefill_chunk=4096, kv_quantize=kv), model)
+    if kv:
+        print(f"kv_quantize={kv} (both arms)", flush=True)
     sched = eng._scheduler
     n = 48  # two full admission waves
 

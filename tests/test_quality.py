@@ -157,3 +157,169 @@ def test_trained_model_quality_survives_kv_int8(trained_summarizer):
     # same absolute gate as the full-precision test: int8 KV must not cost
     # the learned behavior (small per-example wobble is expected)
     assert mean_model >= 0.28, (mean_model, model_f)
+
+
+# ---------------------------------------------------- CLI end-to-end gate
+
+
+MAP_TEMPLATE = "List the topics.\n{transcript}\nTopics:"
+REDUCE_TEMPLATE = "List the topics.\n{summaries}\nTopics:"
+CLI_CHUNK_TOKENS = 384  # forces multi-chunk map on the held-out transcript
+
+
+def _make_cli_transcript(rng):
+    """A transcript in the CLI input schema (reference README.md:162-175)
+    whose ground-truth summary is its topic list in order of appearance."""
+    from lmrs_tpu.eval.synthetic import _FILLER, _OPENERS, TOPICS
+
+    n_topics = int(rng.integers(3, 6))
+    topics = [TOPICS[i] for i in rng.choice(len(TOPICS), n_topics,
+                                            replace=False)]
+    segs, t = [], 0.0
+    for topic in topics:
+        if rng.random() < 0.6:
+            segs.append({"start": t, "end": t + 4.0, "speaker": "SPEAKER_00",
+                         "text": str(rng.choice(_FILLER))})
+            t += float(rng.integers(20, 50))
+        opener = str(rng.choice(_OPENERS)).format(t=topic)
+        segs.append({"start": t, "end": t + 4.0, "speaker": "SPEAKER_00",
+                     "text": opener + "."})
+        t += float(rng.integers(20, 50))
+    return {"segments": segs}, topics
+
+
+def _product_format_pairs(transcript, topics):
+    """(prompt, summary) pairs in the EXACT formats the CLI will produce:
+    map prompts through the real preprocessor + chunker (context header
+    included), the reduce prompt through the real aggregator formatter."""
+    from types import SimpleNamespace
+
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.data.chunker import TranscriptChunker
+    from lmrs_tpu.data.preprocessor import format_timestamp, preprocess_transcript
+    from lmrs_tpu.data.tokenizer import ByteTokenizer
+    from lmrs_tpu.prompts import safe_format
+    from lmrs_tpu.reduce.aggregator import ResultAggregator
+
+    chunker = TranscriptChunker(max_tokens_per_chunk=CLI_CHUNK_TOKENS,
+                                overlap_tokens=0, context_tokens=150,
+                                tokenizer=ByteTokenizer())
+    agg = ResultAggregator(SimpleNamespace(config=EngineConfig()),
+                           tokenizer=ByteTokenizer())
+    processed = preprocess_transcript(transcript["segments"])
+    chunks = chunker.chunk_transcript(processed)
+    pairs, tagged = [], []
+    for c in chunks:
+        in_chunk = sorted((t for t in topics if t in c.text),
+                          key=c.text.find)
+        target = " " + ", ".join(in_chunk) + "." if in_chunk else " none."
+        pairs.append({
+            "prompt": safe_format(MAP_TEMPLATE,
+                                  transcript=c.text_with_context),
+            "summary": target,
+        })
+        tagged.append(
+            f"[Time: {format_timestamp(c.start_time)} - "
+            f"{format_timestamp(c.end_time)}]\n{target}")
+    red = agg._build_request(tagged, REDUCE_TEMPLATE, metadata=None)
+    pairs.append({"prompt": red.prompt,
+                  "summary": " " + ", ".join(topics) + "."})
+    return pairs
+
+
+@pytest.fixture(scope="module")
+def cli_checkpoint(tmp_path_factory):
+    """Fine-tune quality-tiny on product-formatted pairs through the
+    production training stack, save through the production Orbax path."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from lmrs_tpu.config import model_preset
+    from lmrs_tpu.data.tokenizer import ByteTokenizer
+    from lmrs_tpu.models.loader import save_checkpoint
+    from lmrs_tpu.models.transformer import init_params
+    from lmrs_tpu.training.cli import batches, load_examples
+    from lmrs_tpu.training.train import make_train_step
+
+    cfg = model_preset("quality-tiny")
+    rng = np.random.default_rng(0)
+    pairs = []
+    for _ in range(1000):
+        transcript, topics = _make_cli_transcript(rng)
+        pairs.extend(_product_format_pairs(transcript, topics))
+
+    import tempfile
+    from pathlib import Path as P
+
+    with tempfile.TemporaryDirectory() as td:
+        data_path = P(td) / "train.jsonl"
+        data_path.write_text("\n".join(json.dumps(p) for p in pairs))
+        seqs, masks = load_examples(str(data_path), ByteTokenizer())
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    # warmup-cosine matters here: constant-lr runs oscillate and plateau at
+    # held-out map ROUGE-L ~0.6 (calibration 2026-07-31); with decay the
+    # same budget reaches ~0.94 map / 1.0 reduce (teacher-forced)
+    steps = 1500
+    sched = optax.warmup_cosine_decay_schedule(0.0, 3e-3, 100, steps,
+                                               3e-3 * 0.02)
+    optimizer = optax.adamw(sched)
+    opt_state = optimizer.init(params)
+    step_fn = make_train_step(cfg, optimizer, None, masked=True)
+    it = batches(seqs, masks, 8, 704, 0)
+    loss = None
+    for _ in range(steps):
+        t, m = next(it)
+        params, opt_state, loss = step_fn(params, opt_state,
+                                          jnp.asarray(t), jnp.asarray(m))
+    assert float(loss) < 0.25, f"CLI-format training failed: loss {float(loss)}"
+    ckpt = tmp_path_factory.mktemp("cli_ckpt") / "quality-tiny"
+    save_checkpoint(str(ckpt), params)
+    return str(ckpt)
+
+
+def test_cli_end_to_end_quality_gate(cli_checkpoint, tmp_path, monkeypatch):
+    """The PRODUCT surface, quality-gated (VERDICT r3 item 7): `lmrs`
+    CLI -> preprocess -> chunk -> continuous-batching map -> reduce, with
+    a trained checkpoint loaded via --checkpoint, scored against the
+    held-out transcript's ground-truth topic summary.  Calibration
+    (2026-07-31, CPU, fixed seeds): model 0.889 ROUGE-L end-to-end,
+    extractive baseline 0.0 — gate at 0.45 is a format-or-content
+    collapse tripwire, not a near-miss trap."""
+    from lmrs_tpu import cli
+    from lmrs_tpu.eval.rouge import rouge_l
+
+    monkeypatch.setenv("TEMPERATURE", "0.0")  # greedy map (env-config path)
+    # generation budget via the reference's env knob (MAX_TOKENS,
+    # SURVEY.md §5.6): the default 1000 would push the scheduler's prompt
+    # truncation limit below the ~460-byte product prompts at this window
+    monkeypatch.setenv("MAX_TOKENS", "96")
+    held, topics = _make_cli_transcript(np.random.default_rng(4242))
+    truth = " " + ", ".join(topics) + "."
+
+    inp = tmp_path / "transcript.json"
+    inp.write_text(json.dumps(held))
+    out = tmp_path / "summary.txt"
+    mapf = tmp_path / "map_prompt.txt"
+    mapf.write_text(MAP_TEMPLATE)
+    redf = tmp_path / "reduce_prompt.txt"
+    redf.write_text(REDUCE_TEMPLATE)
+
+    rc = cli.main([
+        "--input", str(inp), "--output", str(out),
+        "--backend", "jax", "--model", "quality-tiny",
+        "--checkpoint", cli_checkpoint, "--tokenizer", "byte",
+        "--max-tokens-per-chunk", str(CLI_CHUNK_TOKENS),
+        "--overlap-tokens", "0",
+        "--prompt-file", str(mapf),
+        "--aggregator-prompt-file", str(redf),
+        "--report", "--quiet",
+    ])
+    assert rc == 0
+    text = out.read_text()
+    score = rouge_l(text, truth)["f"]
+    assert score >= 0.45, (score, text, truth)
+    report = json.loads((tmp_path / "summary.txt.report.json").read_text())
+    assert report["num_chunks"] >= 2, "held-out transcript must multi-chunk"
+    assert report["failed_requests"] == 0

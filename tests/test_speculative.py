@@ -174,3 +174,28 @@ def test_spec_greedy_through_multi_kernel_matches_plain(monkeypatch):
     spec.shutdown()
     assert all(r.error is None for r in got_res)
     assert got == want
+
+
+def test_draft_lookup_ngram3_rejects_bigram_collision():
+    """n=3 must skip a position where only the last TWO tokens match — the
+    byte-vocab collision class that capped trained-model acceptance at ~1
+    token/step (docs/PERF.md round 4)."""
+    import jax.numpy as jnp
+
+    # history: 7 8 9 1 2 5 5 8 9 1 -> query 3-gram (8, 9, 1); the early
+    # "8 9 1" at positions 1..3 is the ONLY 3-gram match (continuation 2 5);
+    # a bigram matcher would also accept nothing else here, so add a decoy
+    # "9 1" with a different predecessor: ... 4 9 1 ...
+    hist = [7, 8, 9, 1, 2, 5, 4, 9, 1, 6, 8, 9, 1]
+    buf = [hist + [0] * 7]
+    draft, n = draft_lookup(jnp.asarray(buf), jnp.asarray([len(hist)]), k=2,
+                            n=3)
+    assert int(n[0]) == 2
+    assert draft[0].tolist() == [2, 5]  # from the true 3-gram match
+
+    # bigram matching at the same history picks the MOST RECENT "9 1"
+    # (position 7), drafting its continuation (6, 8) — the collision
+    draft2, n2 = draft_lookup(jnp.asarray(buf), jnp.asarray([len(hist)]),
+                              k=2, n=2)
+    assert int(n2[0]) == 2
+    assert draft2[0].tolist() == [6, 8]
