@@ -41,9 +41,12 @@ class MockEngine:
 
     def generate_batch(self, requests: list[GenerationRequest],
                        on_result=None, on_tokens=None) -> list[GenerationResult]:
-        # no start-of-batch clear: a cancel can legitimately race the batch
-        # boundary (same reasoning as the scheduler's run()); callers keep
-        # ids unique across cancels (the HTTP batcher's rids are global)
+        # cancel-set lifecycle mirrors ContinuousScheduler.run(): no
+        # start-of-batch clear (a cancel can legitimately race the batch
+        # boundary) but a full clear in the finally, so stale ids never
+        # cancel a later batch's same-numbered request or accumulate
+        # unboundedly; callers keep ids unique across cancels (the HTTP
+        # batcher's rids are global)
 
         def one(req: GenerationRequest) -> GenerationResult:
             res = self._one(req)
@@ -52,12 +55,15 @@ class MockEngine:
                 on_tokens(res.request_id, res.text)
             return res
 
-        if on_result is not None:
-            from lmrs_tpu.engine.api import drain_with_callback
+        try:
+            if on_result is not None:
+                from lmrs_tpu.engine.api import drain_with_callback
 
-            return drain_with_callback(
-                lambda reqs: [one(r) for r in reqs], requests, on_result)
-        return [one(r) for r in requests]
+                return drain_with_callback(
+                    lambda reqs: [one(r) for r in reqs], requests, on_result)
+            return [one(r) for r in requests]
+        finally:
+            self.cancelled.clear()
 
     def shutdown(self) -> None:
         pass

@@ -1,6 +1,6 @@
 """Speculation with REAL acceptance (VERDICT r3 item 6, the close-the-file
 measurement): random-weight models cannot accept drafts (ab_spec.py measures
-pure overhead, 0.5x), so this script TRAINS a ~140M byte-level model on chip
+pure overhead, 0.5x), so this script TRAINS a ~370M byte-level model on chip
 on an extractive agenda-copy task — the canonical prompt-lookup win case
 (summaries quoting their source verbatim; ops/speculative.py module doc) —
 then runs the k=0 vs k=4 ABBA on held-out prompts through the production
@@ -145,9 +145,9 @@ def main():
     pred = (1 + a_hat) / 1.09  # 1.09x = measured verify-kernel cost ratio
     print(f"speedup: measured {m0 / m4:.2f}x  "
           f"(weight-stream prediction (1+a)/1.09 = {pred:.2f}x)")
-    print(f"VERDICT: speculation {'WINS >= 1.2x — flip default ON for '
-          'extractive workloads' if m0 / m4 >= 1.2 else 'stays OFF'}",
-          flush=True)
+    verdict = ("WINS >= 1.2x — flip default ON for extractive workloads"
+               if m0 / m4 >= 1.2 else "stays OFF")
+    print(f"VERDICT: speculation {verdict}", flush=True)
 
 
 if __name__ == "__main__":

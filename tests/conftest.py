@@ -19,6 +19,17 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite's wall-clock is dominated by
+# CPU XLA compiles of the engine programs (the quality-gate file alone
+# compiles ~40 min cold); cached, repeat runs skip every previously-seen
+# shape.  Harmless if unsupported — correctness never depends on it.
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/lmrs_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:  # older jax: no persistent cache knobs
+    pass
+
 import json
 import math
 import random
