@@ -27,11 +27,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-# Default q/kv tile: at S=2048 a 256 tile means 1024 grid programs per
-# layer call and per-program overhead shows up in the MFU; 512 quarters
-# the program count — measured +3.6 MFU points at bench-1b (37.9% -> 41.5%
-# bf16; docs/PERF.md round 2).  Env knob for A/B sweeps.
-_DEFAULT_BLOCK = int(os.environ.get("LMRS_FLASH_BLOCK", "512"))
+# Default q/kv tile: bigger tiles = fewer grid programs = less per-program
+# overhead, up to the VMEM ceiling (2048 tiles fail to compile at hd=128).
+# Measured r2: 256 -> 512 was +3.6 MFU points; r4 interleaved sweep
+# (min-of-4-rounds, RTT-amortized chains): 512 -> 1024 is a further 1.5x
+# on the kernel at the bench packed shape (S=4096: 1.79 -> 1.20 ms, 19.5 ->
+# 29.1% MFU; S=2048: 1.8x).  The wrapper clamps blocks to the sequence, so
+# small buckets degrade gracefully.  Env knob for A/B sweeps.
+_DEFAULT_BLOCK = int(os.environ.get("LMRS_FLASH_BLOCK", "1024"))
 
 
 def _flash_kernel(
