@@ -10,9 +10,12 @@ boundary, the slot's pages free immediately, and the result carries
 from __future__ import annotations
 
 import json
+import random
 import socket
 import threading
 import time
+
+import pytest
 
 from lmrs_tpu.config import EngineConfig, ModelConfig
 from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
@@ -223,3 +226,53 @@ def test_batcher_drops_cancelled_before_dispatch():
     finally:
         eng.release.set()
         b.shutdown()
+
+
+@pytest.mark.parametrize("seed", [7, 19, 43])
+def test_fuzzed_cancellation_keeps_pool_consistent(seed):
+    """Random cancels fired from the streaming callback at random points,
+    across random scheduler shapes: every request resolves (cancelled or
+    finished, never errored), no KV page leaks, and freed-row invariants
+    hold well enough for the run to complete — the fuzz analog of
+    tests/test_fuzz_scheduler.py for the abort path."""
+    rng = random.Random(seed)
+    eng = JaxEngine(
+        EngineConfig(backend="jax", scheduler="continuous",
+                     max_tokens=24, seed=0,
+                     max_batch_slots=rng.choice((1, 2, 3)),
+                     page_size=rng.choice((16, 32)),
+                     num_pages=rng.choice((1, 40)),
+                     decode_block=rng.choice((2, 4))),
+        tiny_model())
+    sched = eng._scheduler
+    usable = sched.cache.num_pages - 1
+    n = rng.randint(3, 7)
+    reqs = [GenerationRequest(prompt=f"fuzz cancel {i} " * rng.randint(1, 6),
+                              request_id=i, temperature=0.8,
+                              max_new_tokens=rng.randint(4, 24))
+            for i in range(n)]
+    to_cancel = {i for i in range(n) if rng.random() < 0.5}
+    calls = [0]
+
+    def on_tokens(rid, delta):
+        calls[0] += 1
+        # cancel a random victim (possibly the streaming request itself,
+        # possibly one still queued) on a random subset of callbacks
+        if to_cancel and calls[0] % 3 == 0:
+            eng.cancel(to_cancel.pop())
+
+    out = eng.generate_batch(reqs, on_tokens=on_tokens)
+    assert [r.request_id for r in out] == list(range(n))
+    by_id = {r.request_id: r for r in reqs}
+    for r in out:
+        assert r.error is None
+        assert r.finish_reason in ("stop", "length", "cancelled")
+        # per-request budget, not the global cap (matches the sibling
+        # fuzz contract): the sweep's _trimmed_output must keep capping
+        assert r.completion_tokens <= by_id[r.request_id].max_new_tokens
+    # the abort path actually ran (verified: every seed lands >= 1 cancel
+    # — without this the test could silently stop testing cancellation)
+    assert sched.metrics["cancelled"] >= 1
+    # every page went back to the pool, cancelled or not
+    assert sched.cache.allocator.free_count == usable
+    eng.shutdown()
