@@ -148,6 +148,30 @@ class SlowStreamEngine:
         return {}
 
 
+def _stream_then_rst(host, port, body_dict, until):
+    """The abandoned-client pattern, shared by both disconnect tests:
+    POST a streaming request over a raw socket, recv until ``until(got)``
+    says generation is provably in flight, then vanish with an RST
+    (SO_LINGER 0) so the server's next SSE write fails fast instead of
+    filling the socket buffer."""
+    import struct
+
+    body = json.dumps(body_dict).encode()
+    s = socket.create_connection((host, port), timeout=30)
+    s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
+              b"Host: x\r\nContent-Type: application/json\r\n"
+              + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    got = b""
+    while not until(got):
+        chunk = s.recv(1024)
+        if not chunk:
+            break
+        got += chunk
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
+    s.close()
+    return got
+
+
 def test_server_disconnect_cancels_generation():
     """A streaming client that closes its socket mid-stream must propagate
     a cancel into the running engine call (server write fails -> batcher
@@ -157,25 +181,13 @@ def test_server_disconnect_cancels_generation():
     srv = EngineHTTPServer(engine, port=0, batch_window_s=0.01)
     srv.start_background()
     try:
-        body = json.dumps({"messages": [{"role": "user", "content": "hi"}],
-                           "stream": True}).encode()
-        s = socket.create_connection((srv.host, srv.port), timeout=10)
-        s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
-                  b"Host: x\r\nContent-Type: application/json\r\n"
-                  + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
-        # read until the FIRST content delta arrives — the engine wave is
-        # then provably in flight (closing earlier exercises the easier
+        # wait for the FIRST content delta — the engine wave is then
+        # provably in flight (closing earlier exercises the easier
         # pre-dispatch drop, test_batcher_drops_cancelled_before_dispatch)
-        # — then vanish (the abandoned-client pattern).  SO_LINGER 0 sends
-        # RST so the server's next write fails fast instead of filling the
-        # socket buffer.
-        import struct
-        got = b""
-        while b"tok0" not in got:
-            got += s.recv(512)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
-                     struct.pack("ii", 1, 0))
-        s.close()
+        _stream_then_rst(srv.host, srv.port,
+                         {"messages": [{"role": "user", "content": "hi"}],
+                          "stream": True},
+                         until=lambda got: b"tok0" in got)
         deadline = time.time() + 10
         while time.time() < deadline and not engine.cancel_calls:
             time.sleep(0.05)
@@ -276,3 +288,40 @@ def test_fuzzed_cancellation_keeps_pool_consistent(seed):
     # every page went back to the pool, cancelled or not
     assert sched.cache.allocator.free_count == usable
     eng.shutdown()
+
+
+def test_server_disconnect_cancels_real_scheduler():
+    """The gold path: a REAL socket disconnect, through the live HTTP
+    server, into the REAL continuous-batching scheduler — cancel crosses
+    threads (HTTP handler -> batcher -> engine while the dispatcher thread
+    is inside run()), the slot's pages free, and the engine finishes the
+    request as cancelled well under budget."""
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=192, max_batch_slots=2, seed=0,
+                                 decode_block=2), tiny_model())
+    sched = eng._scheduler
+    usable = sched.cache.num_pages - 1
+    srv = EngineHTTPServer(eng, port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        # the role chunk is frame 1 and also contains '"content": ""' —
+        # a real content DELTA is only proven by a SECOND data: frame
+        _stream_then_rst(srv.host, srv.port,
+                         {"messages": [{"role": "user",
+                                        "content": "stream then vanish"}],
+                          "max_tokens": 192, "temperature": 0.8,
+                          "stream": True},
+                         until=lambda got: got.count(b"data:") >= 2)
+        deadline = time.time() + 60
+        while time.time() < deadline and sched.metrics["cancelled"] == 0:
+            time.sleep(0.1)
+        assert sched.metrics["cancelled"] == 1, "cancel never reached scheduler"
+        # the run loop ends (no other work) and the pages are back
+        deadline = time.time() + 60
+        while (time.time() < deadline
+               and sched.cache.allocator.free_count != usable):
+            time.sleep(0.1)
+        assert sched.cache.allocator.free_count == usable
+    finally:
+        srv.shutdown()
+        eng.shutdown()
