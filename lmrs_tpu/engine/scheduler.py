@@ -539,8 +539,12 @@ class ContinuousScheduler:
         finally:
             # runs on normal completion AND mid-run failure: a dead
             # callback, stale streamed text, or stale cancel ids must not
-            # leak into a later run (the start-of-run clear backstops the
-            # cancel set against ids raced in between runs)
+            # leak into a later run.  There is deliberately NO start-of-run
+            # clear (see the NOTE at the top of run()): ids raced in
+            # between runs persist until THIS clear fires at the end of
+            # the next run, which is harmless because the HTTP batcher's
+            # wave rids are globally unique — a stale id can never match a
+            # future request.
             self.metrics["run_seconds"] += time.time() - t_run
             self._on_tokens = None
             self._streamed = {}
@@ -558,14 +562,22 @@ class ContinuousScheduler:
         for i in range(len(queue) - 1, -1, -1):
             req = queue[i][0]
             if req.request_id in pending:
-                _, _, _, n_prompt, prior, _ = queue[i]
+                _, _, max_new, n_prompt, prior, _ = queue[i]
                 del queue[i]
+                # route the preemption-carry tokens through the same
+                # trimming as the slot path — a preempted slot can't have
+                # hit EOS/stop/budget (it would have finished instead),
+                # but the two cancel paths must not be able to diverge if
+                # preemption semantics ever change
+                gen, text, stop_hit, _ = self._trim_tokens(
+                    list(prior), max_new, req.stop)
                 results[req.request_id] = GenerationResult(
                     request_id=req.request_id,
-                    text=self.tokenizer.decode(prior) if prior else "",
+                    text=text,
                     prompt_tokens=n_prompt,
-                    completion_tokens=len(prior),
+                    completion_tokens=len(gen),
                     finish_reason="cancelled",
+                    stop_sequence=stop_hit,
                 )
                 fresh.append(req.request_id)
                 hit.add(req.request_id)
@@ -587,14 +599,19 @@ class ContinuousScheduler:
         """(gen, text, stop_hit, hit_eos) for a slot's output so far —
         budget-trimmed, EOS-trimmed, stop-sequence-applied.  The ONE
         implementation of output trimming, shared by the normal finish
-        path, the per-block streaming cut, and the cancel sweep."""
-        gen = (st.prior + st.generated)[: st.max_new]
+        path, the per-block streaming cut, and both cancel-sweep paths
+        (live slots here; queued preempted entries via _trim_tokens)."""
+        return self._trim_tokens(st.prior + st.generated, st.max_new,
+                                 st.req.stop)
+
+    def _trim_tokens(self, gen: list[int], max_new: int, stop):
+        gen = gen[:max_new]
         eos = self.tokenizer.eos_id
         hit_eos = eos in gen
         if hit_eos:
             gen = gen[: gen.index(eos)]
         text, stop_hit = apply_stop_sequences(
-            self.tokenizer.decode(gen), st.req.stop)
+            self.tokenizer.decode(gen), stop)
         return gen, text, stop_hit, hit_eos
 
     def _finish_slot(self, b, slots, results, active, fresh, kv_lens,

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import queue
+import socket
 import threading
 import time
 import uuid
@@ -85,14 +86,34 @@ class _Batcher:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def submit(self, request: GenerationRequest) -> GenerationResult:
+    def submit(self, request: GenerationRequest,
+               poll_disconnect=None,
+               poll_interval: float = 0.5) -> GenerationResult:
+        """Enqueue and block until the result is ready.
+
+        ``poll_disconnect``, when given, is called every ``poll_interval``
+        seconds while waiting; returning True means the client went away —
+        the job is cancelled (queued jobs are dropped before dispatch, a
+        job inside the running wave is aborted through the engine's cancel
+        hook) and submit() keeps waiting for the cancellation result.
+        This closes the non-streaming disconnect gap: without it only SSE
+        paths (which notice via OSError on a stream write) could abort an
+        abandoned request, and a dropped non-stream request would decode
+        to max_tokens holding its slot and pages."""
         job = _Job(request)
         with self._close_lock:
             if self.closed:
                 return GenerationResult(request_id=0, finish_reason="error",
                                         error="server shutting down")
             self.queue.put(job)
-        job.event.wait()
+        if poll_disconnect is None:
+            job.event.wait()
+        else:
+            while not job.event.wait(poll_interval):
+                if not job.cancelled and poll_disconnect():
+                    logger.debug(
+                        "non-stream client disconnected; cancelling")
+                    self.cancel(job)
         assert job.result is not None
         return job.result
 
@@ -354,6 +375,23 @@ class EngineHTTPServer:
                 else:
                     self._send(404, {"error": {"message": f"no route {self.path}"}})
 
+            def _client_gone(self) -> bool:
+                """Best-effort disconnect probe for non-streaming waits: a
+                MSG_PEEK read returning b'' means the peer sent FIN.  The
+                request body was fully read before submit, so pending data
+                (→ still connected) is not expected but also not an error."""
+                try:
+                    self.connection.setblocking(False)
+                    try:
+                        data = self.connection.recv(1, socket.MSG_PEEK)
+                    finally:
+                        self.connection.setblocking(True)
+                except (BlockingIOError, InterruptedError):
+                    return False  # nothing to read: still connected
+                except OSError:
+                    return True
+                return data == b""
+
             def do_POST(self):
                 body = self._read_json()
                 if body is None:
@@ -366,16 +404,30 @@ class EngineHTTPServer:
                             self._stream_openai(
                                 body, outer.batcher.submit_stream(req))
                             return
-                        res = outer.batcher.submit(req)
-                        self._respond_openai(body, res)
+                        res = outer.batcher.submit(
+                            req, poll_disconnect=self._client_gone)
+                        # always attempt the write: a half-closed client
+                        # (shutdown(SHUT_WR)) peeks as gone but still reads,
+                        # and a disconnect can race normal completion — a
+                        # dead socket just raises, swallowed below
+                        try:
+                            self._respond_openai(body, res)
+                        except OSError:
+                            logger.debug("client gone before response write")
+                        return
                     elif self.path == "/v1/messages":
                         req = _messages_to_request(body, outer.max_tokens_cap)
                         if body.get("stream"):
                             self._stream_anthropic(
                                 body, outer.batcher.submit_stream(req))
                             return
-                        res = outer.batcher.submit(req)
-                        self._respond_anthropic(body, res)
+                        res = outer.batcher.submit(
+                            req, poll_disconnect=self._client_gone)
+                        try:
+                            self._respond_anthropic(body, res)
+                        except OSError:
+                            logger.debug("client gone before response write")
+                        return
                     else:
                         self._send(404, {"error": {"message": f"no route {self.path}"}})
                 except Exception as e:

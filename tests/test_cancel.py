@@ -148,27 +148,38 @@ class SlowStreamEngine:
         return {}
 
 
-def _stream_then_rst(host, port, body_dict, until):
-    """The abandoned-client pattern, shared by both disconnect tests:
-    POST a streaming request over a raw socket, recv until ``until(got)``
-    says generation is provably in flight, then vanish with an RST
-    (SO_LINGER 0) so the server's next SSE write fails fast instead of
-    filling the socket buffer."""
-    import struct
-
+def _post_raw(host, port, body_dict) -> socket.socket:
+    """POST a chat-completions body over a raw socket and return the live
+    socket (abandoned-client pattern, part 1)."""
     body = json.dumps(body_dict).encode()
     s = socket.create_connection((host, port), timeout=30)
     s.sendall(b"POST /v1/chat/completions HTTP/1.1\r\n"
               b"Host: x\r\nContent-Type: application/json\r\n"
               + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    return s
+
+
+def _rst_close(s: socket.socket) -> None:
+    """Vanish with an RST (SO_LINGER 0) so the server's next write on the
+    socket fails fast instead of filling the socket buffer (abandoned-
+    client pattern, part 2)."""
+    import struct
+
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
+    s.close()
+
+
+def _stream_then_rst(host, port, body_dict, until):
+    """POST a streaming request, recv until ``until(got)`` says generation
+    is provably in flight, then RST — shared by the SSE disconnect tests."""
+    s = _post_raw(host, port, body_dict)
     got = b""
     while not until(got):
         chunk = s.recv(1024)
         if not chunk:
             break
         got += chunk
-    s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0))
-    s.close()
+    _rst_close(s)
     return got
 
 
@@ -325,3 +336,34 @@ def test_server_disconnect_cancels_real_scheduler():
     finally:
         srv.shutdown()
         eng.shutdown()
+
+def test_nonstream_disconnect_cancels_generation():
+    """ADVICE r4: a NON-streaming client that disconnects mid-generation
+    must also be detected (MSG_PEEK poll inside _Batcher.submit) and
+    cancelled — previously only SSE paths noticed (OSError on a stream
+    write), so a dropped non-stream request decoded to max_tokens holding
+    its slot and pages."""
+    engine = SlowStreamEngine(n_deltas=60, delay_s=0.05)  # 3s if uncancelled
+    srv = EngineHTTPServer(engine, port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        s = _post_raw(srv.host, srv.port,
+                      {"messages": [{"role": "user", "content": "hi"}]})
+        # no bytes ever reach a non-streaming client before completion —
+        # wait until the engine is provably generating, then vanish
+        deadline = time.time() + 10
+        while time.time() < deadline and engine.deltas_emitted == 0:
+            time.sleep(0.02)
+        assert engine.deltas_emitted > 0, "wave never started"
+        _rst_close(s)
+        deadline = time.time() + 10
+        while time.time() < deadline and not engine.cancel_calls:
+            time.sleep(0.05)
+        assert engine.cancel_calls, \
+            "non-stream disconnect never reached engine.cancel"
+        settled = engine.deltas_emitted
+        time.sleep(0.4)
+        assert engine.deltas_emitted in (settled, settled + 1)
+        assert engine.deltas_emitted < engine.n_deltas
+    finally:
+        srv.shutdown()
