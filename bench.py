@@ -206,7 +206,9 @@ def run_bench() -> tuple[float, dict]:
     from lmrs_tpu.pipeline import TranscriptSummarizer
     from lmrs_tpu.utils.logging import setup_logging
 
-    setup_logging(quiet=True)
+    # logs -> stderr: this process's stdout is the one-JSON-line artifact
+    # the driver parses; a WARNING on stdout would corrupt it
+    setup_logging(quiet=True, stream=sys.stderr)
     transcript = load_transcript()
 
     # ~1.03B-param GQA decoder (config.model_preset "bench-1b"): big enough
@@ -264,6 +266,11 @@ def run_bench() -> tuple[float, dict]:
     # Timed region, repeated: the tunneled link's weather produces 2-7x
     # run-to-run spread on identical code; the median + per-rep values let
     # the judge tell a real regression from a bad link day.
+    # Latency samples reset here so warmup's compile-time dispatch gaps
+    # (orders of magnitude over steady state) don't pollute the
+    # percentiles; counter metrics are windowed via the snapshot below.
+    sched.reset_latency_stats()
+    metrics_before = dict(sched.metrics)
     reps = max(1, int(os.environ.get("LMRS_BENCH_REPS", "3")))
     rep_rows = _partial_reps  # shared with the watchdog (see start_watchdog)
     for _ in range(reps):
@@ -288,8 +295,34 @@ def run_bench() -> tuple[float, dict]:
         "params_m": round(_param_count_m(sched.params), 1),
         "backend": "jax",
         **roofline,
+        **_scheduler_window(sched, metrics_before),
     })
     return float(value), detail
+
+
+def _scheduler_window(sched, before: dict) -> dict:
+    """Scheduler-level detail over the timed reps only (VERDICT r4 items
+    2 and 5): decode occupancy, stall/preemption counts, the
+    prefill/decode phase split, and the serving-latency percentiles —
+    the e2e numbers needed to attribute any roofline-vs-e2e gap from the
+    bench artifact alone, without rerunning a one-off script."""
+    m = sched.metrics
+    d_disp = m["decode_dispatches"] - before["decode_dispatches"]
+    occ = ((m["occupancy_sum"] - before["occupancy_sum"]) / d_disp
+           if d_disp else 0.0)
+    report = sched.metrics_report()  # latency pct reset at window start
+    return {
+        "mean_decode_occupancy": round(occ, 3),
+        "decode_dispatches": d_disp,
+        "stalls": m["stalls"] - before["stalls"],
+        "preemptions": m["preemptions"] - before["preemptions"],
+        "phase_split_tokens": {
+            "prefill": m["prefill_tokens"] - before["prefill_tokens"],
+            "decode": m["decode_tokens"] - before["decode_tokens"],
+        },
+        "ttft_ms": report["ttft_ms"],
+        "decode_block_gap_ms": report["decode_block_gap_ms"],
+    }
 
 
 def main() -> int:

@@ -52,6 +52,25 @@ def _pow2_bucket(n: int, lo: int) -> int:
     return b
 
 
+def _latency_pct(samples: list[float]) -> dict | None:
+    """p50/p90/p99 (ms) over latency samples; None when nothing measured
+    (metrics consumers then omit the block instead of reporting zeros)."""
+    if not samples:
+        return None
+    p50, p90, p99 = np.percentile(np.asarray(samples), [50, 90, 99])
+    return {"p50": round(float(p50) * 1e3, 1),
+            "p90": round(float(p90) * 1e3, 1),
+            "p99": round(float(p99) * 1e3, 1),
+            "n": len(samples)}
+
+
+def _append_bounded(samples: list[float], value: float,
+                    cap: int = 200_000) -> None:
+    samples.append(value)
+    if len(samples) > cap:  # drop the oldest half; percentiles stay recent
+        del samples[: cap // 2]
+
+
 # NOTE: quarter-step sequence buckets (p*1.25/1.5/1.75 between powers of
 # two) were tried to cut prefill padding for prompts just past a power of
 # two — measured 3x WORSE end-to-end: the extra compile shapes thrash the
@@ -177,10 +196,12 @@ class ContinuousScheduler:
             raise ValueError(
                 "kv_quantize=int8 does not support ring (sp) prefill yet: "
                 "scales are per-slot and ring writes are sequence-sharded")
-        if self._kv_quant and self.spec_k:
-            raise ValueError(
-                "kv_quantize=int8 does not support speculative decoding "
-                "yet (the multi-token verify runs the bf16 kernel)")
+        # kv_quantize=int8 composes with speculative decoding since r5:
+        # the multi-token verify kernel carries the same per-channel
+        # dequant folds as the single-token fused kernel (q-prescale /
+        # accumulator-postscale are row-count-agnostic) and its RMW
+        # quantizes draft rows with the slot's frozen scales
+        # (ops/paged_attention.paged_decode_pallas_multi).
         self._ring_min = 1024
         # Fail fast at construction: ring buckets are rounded UP to a
         # multiple of sp at dispatch, which stays <= max_len only when
@@ -203,6 +224,16 @@ class ContinuousScheduler:
         # dispatches is the per-block token latency active slots see)
         self._trace_dispatch: list[float] | None = (
             [] if os.environ.get("LMRS_TRACE_DISPATCH") == "1" else None)
+        # Always-on serving-latency samples (VERDICT r4 item 5: a latency
+        # regression must not ship silently because the numbers lived only
+        # in a one-off script).  _ttft: submit->first-token seconds per
+        # fresh request; _block_gaps: seconds between consecutive decode
+        # dispatches within a run — the cadence at which a streaming
+        # client receives delta batches.  Bounded (oldest half dropped)
+        # so a long-lived serving process cannot grow without limit;
+        # percentiles surface in metrics_report()/the bench detail.
+        self._ttft: list[float] = []
+        self._block_gaps: list[float] = []
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         # Request abort (VERDICT r3 item 4): ids land here from any thread
         # (set.add is atomic under the GIL — the HTTP server cancels from a
@@ -252,10 +283,21 @@ class ContinuousScheduler:
                 m["peak_pages_in_use"] / (self.cache.num_pages - 1), 3),
             "scheduler_seconds": round(m["run_seconds"], 3),
             "preemptions": m["preemptions"],
+            "stalls": m["stalls"],
+            "cancelled": m["cancelled"],
             "peak_active_slots": m["peak_active_slots"],
+            "ttft_ms": _latency_pct(self._ttft),
+            "decode_block_gap_ms": _latency_pct(self._block_gaps),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
         }
+
+    def reset_latency_stats(self) -> None:
+        """Drop accumulated TTFT / block-gap samples.  Benchmarks call
+        this after warmup so compile-time dispatch gaps (orders of
+        magnitude above steady state) don't pollute the percentiles."""
+        self._ttft.clear()
+        self._block_gaps.clear()
 
     def _pick_kernel(self) -> bool:
         from lmrs_tpu.utils.platform import on_tpu
@@ -346,17 +388,24 @@ class ContinuousScheduler:
         # continuation state (len(ids), [], None for fresh requests)
         queue: deque[tuple] = deque()
         all_requests = list(requests)
+        # rid -> enqueue time, consumed at the request's FIRST generated
+        # token (TTFT sample).  Run-local: ids cancelled while queued just
+        # leave their entry to be dropped with the dict.
+        t_enq: dict[int, float] = {}
+        last_block_t: float | None = None  # prev decode-dispatch timestamp
 
         def submit(new_requests: list[GenerationRequest]) -> None:
             for req in new_requests:
                 ids, max_new = self._encode(req)
                 queue.append((req, ids, max_new, len(ids), [], None))
                 all_requests.append(req)
+                t_enq[req.request_id] = time.time()
 
         fresh: deque[int] = deque()  # completed rids awaiting delivery
         for req in requests:
             ids, max_new = self._encode(req)
             queue.append((req, ids, max_new, len(ids), [], None))
+            t_enq[req.request_id] = time.time()
 
         slots: list[_SlotState | None] = [None] * self.B
         last_tok = np.zeros((self.B,), np.int32)
@@ -462,6 +511,7 @@ class ContinuousScheduler:
                         st = slots[b]
                         tok0 = int(fetched[p][row])
                         st.generated.append(tok0)
+                        self._note_first_token(st, t_enq)
                         last_tok[b] = tok0
                         self.seed_history(b, st)
                         self._maybe_finish(b, slots, results, active, fresh,
@@ -487,6 +537,7 @@ class ContinuousScheduler:
                                 continue
                             tok0 = int(fetched[p][row])
                             slots[b].generated.append(tok0)
+                            self._note_first_token(slots[b], t_enq)
                             last_tok[b] = tok0
                             self._maybe_finish(b, slots, results, active, fresh,
                                                kv_lens, last_tok)
@@ -496,8 +547,12 @@ class ContinuousScheduler:
                     continue
                 self.metrics["occupancy_sum"] += float(np.mean(active))
                 self.metrics["decode_dispatches"] += 1
+                now = time.time()
+                if last_block_t is not None:
+                    _append_bounded(self._block_gaps, now - last_block_t)
+                last_block_t = now
                 if self._trace_dispatch is not None:
-                    self._trace_dispatch.append(time.time())
+                    self._trace_dispatch.append(now)
                 if self.spec_k:
                     emitted = self._spec_decode_block(
                         slots, last_tok, kv_lens, active, temps, top_k, top_p)
@@ -510,6 +565,7 @@ class ContinuousScheduler:
                             continue  # preempted: tok0 is resampled on re-prefill
                         tok0 = int(tok0s[p][row])
                         slots[b].generated.append(tok0)
+                        self._note_first_token(slots[b], t_enq)
                         last_tok[b] = tok0
                         if not active[b]:
                             # STALLED this dispatch (no pages to grow): the slot
@@ -603,6 +659,19 @@ class ContinuousScheduler:
         (live slots here; queued preempted entries via _trim_tokens)."""
         return self._trim_tokens(st.prior + st.generated, st.max_new,
                                  st.req.stop)
+
+    def _note_first_token(self, st: _SlotState, t_enq: dict) -> None:
+        """Record a TTFT sample at a request's FIRST host-visible token.
+        The clock starts at SCHEDULER enqueue (run()/submit() encode), so
+        the sample covers queue wait + prefill + first decode block within
+        this engine stream; time spent upstream (the HTTP batcher's
+        ~20 ms micro-batch window, or waiting behind a PREVIOUS wave's
+        run()) is not included — this is an engine metric, not a wire
+        metric.  ``prior`` non-empty means a preemption continuation whose
+        real first token was already recorded in an earlier slot life."""
+        t0 = t_enq.pop(st.req.request_id, None)
+        if t0 is not None and not st.prior:
+            _append_bounded(self._ttft, time.time() - t0)
 
     def _trim_tokens(self, gen: list[int], max_new: int, stop):
         gen = gen[:max_new]
@@ -1452,6 +1521,8 @@ class ContinuousScheduler:
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v, self._spec_buf,
+            self.kscale, self.vscale,
+            jnp.arange(self.B, dtype=jnp.int32),  # dispatch row -> slot
             jnp.asarray(last_tok), jnp.asarray(kv_lens),
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
@@ -1500,13 +1571,15 @@ class ContinuousScheduler:
         # is single-device everywhere else too.
         use_ragged = self._use_ragged and self._kernel_mesh() is None
         interp = self._interpret
+        kv_q = bool(self._kv_quant)
 
         from lmrs_tpu.ops.sampling import filtered_probs
         from lmrs_tpu.ops.speculative import draft_lookup, verify_tokens
 
         @partial(jax.jit, donate_argnums=(1, 2, 3))
-        def spec_decode(params, k_pages, v_pages, buf, last_tok, kv_lens,
-                        table, active, key, temps, tk, tp):
+        def spec_decode(params, k_pages, v_pages, buf, kscale, vscale,
+                        srows, last_tok, kv_lens, table, active, key,
+                        temps, tk, tp):
             b_rows = jnp.arange(buf.shape[0])[:, None]
             offs = jnp.arange(k + 1)[None, :]
 
@@ -1524,12 +1597,17 @@ class ContinuousScheduler:
                 # when drafts overhang max_len (the max_pos cap masks the
                 # overhang; a clamped length would slide the write span
                 # backwards over real cache entries)
-                logits, k_pages, v_pages = forward_paged(
+                out = forward_paged(
                     params, cfg, toks_in, positions, k_pages, v_pages, table,
                     lens + 1 + k, rope_max,
                     use_ragged_kernel=use_ragged, multi_decode=True,
                     interpret=interp,
+                    kv_scales=(kscale, vscale) if kv_q else None,
+                    scale_rows=srows if kv_q else None,
                 )
+                # scales are read-only in decode (frozen at prefill):
+                # out[3:] returns them unchanged when kv_q
+                logits, k_pages, v_pages = out[:3]
                 probs = jax.vmap(filtered_probs, in_axes=(1, None, None, None),
                                  out_axes=1)(logits, temps, tk, tp)
                 key, sub = jax.random.split(key)

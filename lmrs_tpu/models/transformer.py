@@ -512,10 +512,13 @@ def forward_paged(
             # derive from kv_lens, which callers pass UNCLAMPED (base must
             # be the true position); tokens overhanging rope_max are
             # neither written nor attended (max_pos cap).
-            if use_ragged_kernel and kv_scales is None:
+            if use_ragged_kernel:
+                ks_m = row_scales[0] if kv_scales is not None else None
+                vs_m = row_scales[1] if kv_scales is not None else None
                 attn, kp_all, vp_all = paged_decode_pallas_multi(
                     q, k, v, kp_all, vp_all, g_tables, kv_lens,
-                    interpret=interpret, max_pos=rope_max)
+                    interpret=interpret, max_pos=rope_max,
+                    kscale=ks_m, vscale=vs_m)
             else:
                 attn, kp_all, vp_all = paged_decode_multi_xla(
                     q, k, v, kp_all, vp_all, g_tables, kv_lens,

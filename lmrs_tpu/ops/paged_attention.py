@@ -166,8 +166,11 @@ def _ragged_decode_all_heads(
         def _prime():
             fetch(0, 0)
 
-    if get_kscale is not None:
-        assert n_tokens == 1, "int8 pools: multi-token verify not supported"
+    # int8 dequant is row-count-agnostic: the K scale folds into EVERY q
+    # row (all tokens share the slot's per-channel scales — draft tokens
+    # were quantized with the same scales in the RMW) and the V scale
+    # folds into every accumulator row after the walk, so n_tokens > 1
+    # (speculative verify) needs no special casing here.
 
     m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
     l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
@@ -422,6 +425,9 @@ def _write_new_tokens_all_heads(
     kh: int,
     n_tokens: int,
     max_pos: int | None = None,
+    wh: int = 8,
+    get_kscale=None,    # (row, ki) -> [hd] f32: int8 pools (quantize the
+    get_vscale=None,    # new tokens with the row's per-channel scales)
 ):
     """One whole RMW cycle for this program's own row (the multi-token
     verify kernel's path; the fused decode kernel uses ``_make_rmw``
@@ -432,6 +438,7 @@ def _write_new_tokens_all_heads(
         k_out, v_out, k8_scr, v8_scr, wsem,
         page_size=page_size, kh=kh, n_tokens=n_tokens,
         t_pad=knew_ref.shape[1], hd=knew_ref.shape[-1], max_pos=max_pos,
+        wh=wh, get_kscale=get_kscale, get_vscale=get_vscale,
     )
     start_reads, blend_write, drain = rmw(pl.program_id(0))
     start_reads()
@@ -452,6 +459,8 @@ def paged_decode_pallas_multi(
                                # position kv_lens - T must be the true one)
     interpret: bool = False,
     max_pos: int | None = None,  # static position cap (max_seq_len)
+    kscale: jnp.ndarray | None = None,  # [B, K, hd] f32: int8 pools — the
+    vscale: jnp.ndarray | None = None,  # per-(slot, head, channel) scales
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Ragged multi-token verify: the speculative-decoding analog of
     ``paged_decode_pallas_fused``.  One program per batch row writes all T
@@ -465,10 +474,21 @@ def paged_decode_pallas_multi(
     (base = kv_lens - T is then always the true first-token position) and
     ``max_pos``: tokens overhanging the cap are neither written nor
     attended — a clamped length would instead slide the whole write span
-    backwards over real cache entries."""
+    backwards over real cache entries.
+
+    With ``kscale``/``vscale`` the pools are int8 (VERDICT r4 item 4): the
+    RMW quantizes the draft tokens' rows with the slot's frozen
+    per-channel scales, windows widen to the int8 sublane tile (32), and
+    the walk folds K's dequant into every token's q rows and V's into the
+    accumulator — the same folds as the single-token fused kernel, which
+    are row-count-agnostic."""
     b, t, h, hd = q.shape
     kh = k_pages.shape[1]
     ps = k_pages.shape[2]
+    quantized = kscale is not None
+    assert quantized == (k_pages.dtype == jnp.int8), (
+        "int8 pools need scales and vice versa")
+    wh = 32 if quantized else 8
     n_rep = h // kh
     n_rep_p = -(-n_rep // 8) * 8
     rows = t * n_rep_p
@@ -483,8 +503,14 @@ def paged_decode_pallas_multi(
     if t_pad != t:
         knew = jnp.pad(knew, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
         vnew = jnp.pad(vnew, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
-    n_win = 1 if t == 1 else (t - 2) // 8 + 2
+    n_win = 1 if t == 1 else (t - 2) // wh + 2
 
+    scale_specs = []
+    if quantized:
+        scale_specs = [
+            pl.BlockSpec((b, kh, hd), lambda bi, *_: (0, 0, 0)),
+            pl.BlockSpec((b, kh, hd), lambda bi, *_: (0, 0, 0)),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b,),
@@ -492,6 +518,7 @@ def paged_decode_pallas_multi(
             pl.BlockSpec((1, kh, rows, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec((1, kh, t_pad, hd), lambda bi, *_: (bi, 0, 0, 0)),
             pl.BlockSpec((1, kh, t_pad, hd), lambda bi, *_: (bi, 0, 0, 0)),
+            *scale_specs,
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -506,28 +533,40 @@ def paged_decode_pallas_multi(
             pltpu.VMEM((kh, rows, hd), jnp.float32),
             pltpu.VMEM((kh, rows, 128), jnp.float32),
             pltpu.VMEM((kh, rows, 128), jnp.float32),
-            pltpu.VMEM((kh, n_win, 8, hd), k_pages.dtype),
-            pltpu.VMEM((kh, n_win, 8, hd), v_pages.dtype),
+            pltpu.VMEM((kh, n_win, wh, hd), k_pages.dtype),
+            pltpu.VMEM((kh, n_win, wh, hd), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SemaphoreType.DMA((kh * n_win, 2)),
         ],
     )
 
-    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
-               o_ref, k_out, v_out, k_scr, v_scr, acc_scr, m_scr, l_scr,
-               k8_scr, v8_scr, sem, wsem):
+    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, *rest):
+        if quantized:
+            (ksc_ref, vsc_ref, k_hbm, v_hbm, o_ref, k_out, v_out, k_scr,
+             v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
+            gks = lambda row, ki: ksc_ref[row, ki]
+            gvs = lambda row, ki: vsc_ref[row, ki]
+        else:
+            (k_hbm, v_hbm, o_ref, k_out, v_out, k_scr, v_scr, acc_scr,
+             m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
+            gks = gvs = None
         _write_new_tokens_all_heads(
             pt_ref, len_ref, knew_ref.at[0], vnew_ref.at[0], k_out, v_out,
             k8_scr, v8_scr, wsem, page_size=ps, kh=kh, n_tokens=t,
-            max_pos=max_pos,
+            max_pos=max_pos, wh=wh, get_kscale=gks, get_vscale=gvs,
         )
         _ragged_decode_all_heads(
             pt_ref, len_ref, q_ref.at[0], k_out, v_out, o_ref.at[0],
             k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
             page_size=ps, sm_scale=hd**-0.5, kh=kh,
             n_rep_p=n_rep_p, n_tokens=t, max_pos=max_pos,
+            get_kscale=gks, get_vscale=gvs,
         )
 
+    operands = [qg, knew, vnew]
+    if quantized:
+        operands += [kscale.astype(jnp.float32), vscale.astype(jnp.float32)]
+    pool_at = 2 + len(operands)  # k_pages index among ALL args
     out, k_pages, v_pages = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -536,10 +575,10 @@ def paged_decode_pallas_multi(
             jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
             jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
         ],
-        input_output_aliases={5: 1, 6: 2},
+        input_output_aliases={pool_at: 1, pool_at + 1: 2},
         interpret=interpret,
     )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      qg, knew, vnew, k_pages, v_pages)
+      *operands, k_pages, v_pages)
     out = out.reshape(b, kh, t, n_rep_p, hd)[:, :, :, :n_rep]
     return out.transpose(0, 2, 1, 3, 4).reshape(b, t, h, hd), k_pages, v_pages
 

@@ -379,7 +379,15 @@ class EngineHTTPServer:
                 """Best-effort disconnect probe for non-streaming waits: a
                 MSG_PEEK read returning b'' means the peer sent FIN.  The
                 request body was fully read before submit, so pending data
-                (→ still connected) is not expected but also not an error."""
+                (→ still connected) is not expected but also not an error.
+
+                Known tradeoff: a client that half-closes after POSTing
+                (shutdown(SHUT_WR)) but still reads peeks identically to a
+                gone client, so its generation is cancelled and it gets a
+                truncated finish_reason="cancelled" response.  Treating an
+                early client FIN as abort matches common HTTP server
+                practice (e.g. nginx's default); half-close POST clients
+                are rare and still receive a well-formed response."""
                 try:
                     self.connection.setblocking(False)
                     try:

@@ -10,11 +10,16 @@ import sys
 _FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
 
 
-def setup_logging(quiet: bool = False, level: int | None = None) -> None:
-    """Configure the ``lmrs`` logger tree.  quiet → WARNING (main.py --quiet)."""
+def setup_logging(quiet: bool = False, level: int | None = None,
+                  stream=None) -> None:
+    """Configure the ``lmrs`` logger tree.  quiet → WARNING (main.py
+    --quiet).  ``stream`` defaults to stdout (the reference logs to
+    stdout, main.py:32-40); artifact-emitting callers whose stdout is a
+    machine-read contract (bench.py's one-JSON-line) pass stderr."""
     root = logging.getLogger("lmrs")
     if not root.handlers:
-        handler = logging.StreamHandler(sys.stdout)
+        handler = logging.StreamHandler(stream if stream is not None
+                                        else sys.stdout)
         handler.setFormatter(logging.Formatter(_FORMAT))
         root.addHandler(handler)
     root.setLevel(level if level is not None else (logging.WARNING if quiet else logging.INFO))

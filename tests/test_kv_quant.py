@@ -194,10 +194,61 @@ def test_decode_logits_match_fake_quant_reference():
 def test_kv_quant_gates():
     with pytest.raises(ValueError, match="page_size"):
         make_engine("int8", page_size=24)
-    with pytest.raises(ValueError, match="speculative"):
-        make_engine("int8", speculate_k=4)
     with pytest.raises(ValueError, match="kv_quantize"):
         EngineConfig(kv_quantize="int4")
+
+
+def test_spec_int8_greedy_matches_plain_int8():
+    """Speculation composes with int8 KV (VERDICT r4 item 4: the
+    construction gate fell): greedy speculative decode on int8 pools must
+    emit token-for-token what plain int8 decode emits — speculation is a
+    scheduling optimization, and the draft rows are quantized with the
+    same frozen slot scales the plain path uses."""
+    reqs = [GenerationRequest(
+        prompt="the cat sat on the mat the cat sat " * 3,
+        request_id=i, max_new_tokens=16, temperature=0.0) for i in range(2)]
+    plain = make_engine("int8")
+    want = [r.text for r in plain.generate_batch(list(reqs))]
+    plain.shutdown()
+
+    spec = make_engine("int8", speculate_k=4)
+    got_res = spec.generate_batch(list(reqs))
+    m = spec.engine_metrics()
+    spec.shutdown()
+    assert all(r.error is None for r in got_res)
+    assert [r.text for r in got_res] == want
+    assert "spec_accepted_tokens" in m
+
+
+def test_spec_int8_through_multi_kernel_matches_plain(monkeypatch):
+    """The dequantizing RAGGED multi-token verify kernel (interpret mode)
+    must match plain int8 decode token-for-token: the RMW quantizes draft
+    rows with the slot's scales and the walk folds K/V dequant per head —
+    same math as the single-token fused kernel, T rows at a time."""
+    monkeypatch.setenv("LMRS_FORCE_KERNELS", "interpret")
+    mc = ModelConfig(vocab_size=512, dim=512, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=256, max_seq_len=256,
+                     dtype="float32")
+    reqs = [GenerationRequest(
+        prompt="the cat sat on the mat the cat sat " * 2,
+        request_id=i, max_new_tokens=12, temperature=0.0) for i in range(2)]
+
+    def make(k):
+        return JaxEngine(EngineConfig(
+            backend="jax", scheduler="continuous", max_tokens=12,
+            max_batch_slots=2, seed=0, decode_block=6, page_size=32,
+            kv_quantize="int8", speculate_k=k, retry_delay=0.0), mc)
+
+    plain = make(0)
+    assert plain._scheduler._use_ragged, "interpret mode should enable kernels"
+    want = [r.text for r in plain.generate_batch(list(reqs))]
+    plain.shutdown()
+
+    spec = make(4)
+    got_res = spec.generate_batch(list(reqs))
+    spec.shutdown()
+    assert all(r.error is None for r in got_res)
+    assert [r.text for r in got_res] == want
 
 
 def test_int8_fused_kernel_matches_xla(monkeypatch):
