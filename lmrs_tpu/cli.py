@@ -44,7 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input", "-i", required=True, help="input transcript JSON")
     p.add_argument("--output", "-o", help="write final summary to this file")
     p.add_argument("--backend", "--provider", dest="backend", default=None,
-                   help="engine backend: mock | jax (default: env/config)")
+                   help="engine backend: mock | jax | http (default: env/config)")
+    p.add_argument("--hosts", default=None,
+                   help="backend=http: comma-separated lmrs-serve addresses "
+                        "(host:port,...) the map/reduce waves fan over")
     p.add_argument("--model", default=None, help="model preset or checkpoint name")
     p.add_argument("--checkpoint", default=None,
                    help="Orbax checkpoint directory with the model weights "
@@ -95,6 +98,10 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, model=args.model)
     if args.max_concurrent_requests is not None:
         engine = dataclasses.replace(engine, max_concurrent_requests=args.max_concurrent_requests)
+    if args.hosts:
+        engine = dataclasses.replace(
+            engine,
+            hosts=tuple(h.strip() for h in args.hosts.split(",") if h.strip()))
     if args.checkpoint:
         engine = dataclasses.replace(engine, checkpoint_path=args.checkpoint)
     if args.quantize:
@@ -175,13 +182,18 @@ def main(argv: list[str] | None = None) -> int:
             f"wall: {format_duration(stats['processing_time'])}"
         )
         em = stats.get("engine_metrics") or {}
-        if em:
+        if "prefill_tokens_per_sec" in em:  # scheduler-shaped metrics
             print(
                 f"engine: prefill {em['prefill_tokens_per_sec']} tok/s  "
                 f"decode {em['decode_tokens_per_sec']} tok/s  "
                 f"occupancy {em['mean_decode_occupancy']}  "
                 f"kv-pages {em['peak_kv_page_utilization']}"
             )
+        elif "hosts" in em:  # router-shaped metrics (backend=http)
+            print(f"engine: {em['healthy_hosts']}/{em['hosts']} hosts healthy  "
+                  + "  ".join(
+                      f"{row['host']}: {row['served']} served"
+                      for row in em.get("per_host", [])))
 
     if args.output:
         try:

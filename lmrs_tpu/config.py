@@ -121,12 +121,19 @@ class EngineConfig:
 
     Mirrors the reference's ``LLMConfig`` env surface (llm_executor.py:31-52)
     but the "provider" is an in-tree backend, not an HTTP vendor:
-    ``backend`` ∈ {"mock", "jax"}.  ``max_concurrent_requests`` maps to the
-    continuous-batching decode slot count (admission control; SURVEY.md §2.2).
+    ``backend`` ∈ {"mock", "jax", "http"} — "http" fans over remote
+    lmrs-serve hosts (multi-host serving, serving/router.py).
+    ``max_concurrent_requests`` maps to the continuous-batching decode slot
+    count (admission control; SURVEY.md §2.2).
     """
 
     backend: str = field(default_factory=lambda: _env("LMRS_BACKEND", _env("DEFAULT_PROVIDER", "mock")))
     model: str = field(default_factory=lambda: _env("LMRS_MODEL", "tiny"))
+    # backend="http": remote lmrs-serve hosts the RouterEngine fans over
+    # (multi-host DP serving, serving/router.py); comma-separated in env
+    hosts: tuple[str, ...] = field(
+        default_factory=lambda: tuple(
+            h.strip() for h in _env("LMRS_HOSTS", "").split(",") if h.strip()))
     temperature: float = field(default_factory=lambda: _env("TEMPERATURE", 0.3, float))
     max_tokens: int = field(default_factory=lambda: _env("MAX_TOKENS", 1000, int))
     max_concurrent_requests: int = field(

@@ -182,4 +182,18 @@ def make_engine(
 
             return ReplicatedEngine(engine_cfg, model_cfg, mesh_cfg)
         return JaxEngine(engine_cfg, model_cfg, mesh_cfg)
+    if engine_cfg.backend == "http":
+        from lmrs_tpu.serving.router import RouterEngine
+
+        if not engine_cfg.hosts:
+            raise ValueError(
+                "backend='http' needs hosts (--hosts host:port,... or "
+                "LMRS_HOSTS): the addresses of running lmrs-serve processes")
+        # The router's timeout is a per-recv SOCKET timeout, and a
+        # non-streamed generation sends nothing until it completes — the
+        # reference-derived REQUEST_TIMEOUT default (60 s) would time out
+        # any long completion, error it, and mark healthy hosts dead.
+        # Floor it at the router's own worst-case-generation default.
+        return RouterEngine(list(engine_cfg.hosts),
+                            timeout_s=max(engine_cfg.request_timeout, 600.0))
     raise ValueError(f"unknown engine backend {engine_cfg.backend!r}")

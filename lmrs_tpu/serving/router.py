@@ -1,0 +1,343 @@
+"""Cross-process / multi-host serving: an Engine over remote lmrs-serve hosts.
+
+The multi-host serving deployment is one ``lmrs-serve`` process per TPU host
+(each engine owns its host's local devices; within a host, TP rides ICI) and
+this router in front, fanning one request queue over the fleet — the
+cross-process analog of ``engine/replicated.py``'s in-process DP replicas,
+and the TPU-native successor of the reference's concurrent HTTPS fan-out
+(`/root/reference/llm_executor.py:133-147` — there the fleet was OpenAI's;
+here it is ours).  DCN carries only requests and completions, never tensor
+traffic (SURVEY.md §5.8).
+
+Design choices:
+
+* **Engine protocol, not a new API** (engine/api.py): the executor, the
+  pipeline, and ``lmrs-serve`` itself compose with ``RouterEngine``
+  unchanged — a router can even front other routers.
+* **One thread per in-flight request**, stdlib ``http.client`` only: the
+  per-host server micro-batches concurrent arrivals into engine waves
+  (server.py ``_Batcher``) and admission-controls itself, so router-side
+  threading is pure dispatch — the reference's client-side semaphore
+  (llm_executor.py:133) has no router analog on purpose; backpressure
+  lives where the slots are.
+* **Cancel = hang up.**  ``cancel(rid)`` closes the in-flight socket; the
+  remote server's disconnect detection (SSE write failure or the
+  non-stream MSG_PEEK poll, server.py) aborts the request server-side and
+  frees its slot and pages.  The cancellation contract crosses process
+  boundaries with no extra wire protocol.
+* **Degrade-and-continue** (llm_executor.py:219-225): a request that fails
+  on one host retries once on the next healthy host, then surfaces as an
+  error result; a connection-level failure marks the host unhealthy and
+  the next wave routes around it (probed for recovery, like
+  ReplicatedEngine's health loop).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from urllib.parse import urlsplit
+
+from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
+                                 drain_with_callback)
+
+logger = logging.getLogger("lmrs.router")
+
+
+def _request_body(req: GenerationRequest) -> dict:
+    body: dict = {
+        "messages": ([{"role": "system", "content": req.system_prompt}]
+                     if req.system_prompt else [])
+        + [{"role": "user", "content": req.prompt}],
+        "max_tokens": req.max_new_tokens,
+        "temperature": req.temperature,
+        "top_p": req.top_p,
+    }
+    if req.stop:
+        body["stop"] = list(req.stop)
+    if req.top_k:
+        body["top_k"] = req.top_k
+    if req.seed is not None:
+        body["seed"] = req.seed
+    return body
+
+
+class _Host:
+    """One backend lmrs-serve process."""
+
+    def __init__(self, url: str):
+        u = urlsplit(url if "//" in url else f"http://{url}")
+        self.netloc = u.netloc or u.path  # tolerate bare host:port
+        self.url = f"http://{self.netloc}"
+        self.healthy = True
+        self.served = 0
+        self.failed = 0
+
+    def connect(self, timeout: float) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.netloc, timeout=timeout)
+
+
+class RouterEngine:
+    """Engine-protocol fan-out over N lmrs-serve backends (multi-host DP)."""
+
+    schedules_internally = True  # each backend admission-controls itself
+
+    def __init__(self, hosts: list[str], timeout_s: float = 600.0):
+        if not hosts:
+            raise ValueError("RouterEngine needs at least one backend host")
+        self.hosts = [_Host(h) for h in hosts]
+        # per-recv socket timeout: must exceed the worst-case SILENT wait —
+        # a non-streamed generation sends nothing until it completes
+        self.timeout_s = timeout_s
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(8, 4 * len(self.hosts)),
+            thread_name_prefix="lmrs-router")
+        # rid -> live connection, so cancel() can hang up mid-request; the
+        # lock guards the dict, not the sockets (closing a socket another
+        # thread is reading is the POINT — it raises there and the request
+        # finishes as cancelled)
+        self._inflight: dict[int, http.client.HTTPConnection] = {}
+        self._inflight_lock = threading.Lock()
+        # cancel ids are WAVE-scoped (created per _wave, dropped with it):
+        # a persistent set would let a stale cancel for a rid that never
+        # appears poison an identically-numbered request in a LATER wave,
+        # violating the unknown-ids-no-op contract.  A cancel landing
+        # between waves no-ops — same contract as an already-finished id.
+        self._wave_cancelled: set[int] | None = None
+        # round-robin base advances ACROSS waves: a wave-local index would
+        # pin every single-request wave (hierarchical reduce tails) onto
+        # hosts[0] while the rest of the fleet idles
+        self._rr_base = 0
+
+    # ------------------------------------------------------------------ API
+
+    def generate_batch(self, requests: list[GenerationRequest],
+                       on_result=None, on_tokens=None) -> list[GenerationResult]:
+        if on_result is not None:
+            return drain_with_callback(
+                lambda reqs: self._wave(reqs, on_tokens), requests, on_result)
+        return self._wave(requests, on_tokens)
+
+    def cancel(self, request_id: int) -> None:
+        """Abort a request by hanging up its backend connection — the
+        server's disconnect detection cancels it remotely.  Unknown ids
+        (including cancels landing between waves) no-op (engine
+        contract).  Non-streamed cancels lose any partly generated text
+        (the only copy was on the hung-up socket); streamed cancels keep
+        the deltas already received."""
+        wave = self._wave_cancelled
+        if wave is not None:
+            wave.add(request_id)
+        with self._inflight_lock:
+            conn = self._inflight.get(request_id)
+        if conn is not None:
+            # shutdown(), not close(): while the dispatch thread is blocked
+            # reading the response, socket.makefile's _io_refs defer a
+            # close() — no FIN would ever reach the server and the "hangup"
+            # would silently no-op.  shutdown() sends the FIN immediately
+            # and unblocks the local read.
+            import socket as _socket
+
+            try:
+                sock = getattr(conn, "sock", None)
+                if sock is not None:
+                    sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 - best-effort hangup
+                pass
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def engine_metrics(self) -> dict:
+        per = []
+        for h in self.hosts:
+            row = {"host": h.netloc, "healthy": h.healthy,
+                   "served": h.served, "failed": h.failed}
+            conn = None
+            try:
+                conn = h.connect(timeout=2.0)
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                row["metrics"] = json.loads(resp.read())
+            except Exception:  # noqa: BLE001 - metrics are best-effort
+                pass
+            finally:
+                if conn is not None:
+                    conn.close()
+            per.append(row)
+        return {"hosts": len(self.hosts),
+                "healthy_hosts": sum(h.healthy for h in self.hosts),
+                "per_host": per}
+
+    # ------------------------------------------------------------ internals
+
+    def _wave(self, requests: list[GenerationRequest],
+              on_tokens) -> list[GenerationResult]:
+        self._wave_cancelled = cancelled = set()
+        base = self._rr_base
+        self._rr_base += len(requests)
+        try:
+            futures = [
+                self._pool.submit(self._one, base + i, req, on_tokens,
+                                  cancelled)
+                for i, req in enumerate(requests)
+            ]
+            return [f.result() for f in futures]
+        finally:
+            self._wave_cancelled = None
+
+    def _targets(self, start: int) -> list[_Host]:
+        """Healthy hosts in round-robin order from ``start``; every host
+        when none is marked healthy (a transient fault must not brick the
+        fleet — same optimism as ReplicatedEngine)."""
+        n = len(self.hosts)
+        order = [self.hosts[(start + k) % n] for k in range(n)]
+        healthy = [h for h in order if h.healthy]
+        return healthy or order
+
+    def _one(self, i: int, req: GenerationRequest, on_tokens,
+             cancelled: set[int]) -> GenerationResult:
+        rid = req.request_id
+        last_err = "no healthy backend"
+        for attempt, host in enumerate(self._targets(i)[:2]):
+            if rid in cancelled:
+                return GenerationResult(request_id=rid,
+                                        finish_reason="cancelled")
+            streamed = [0]  # deltas already forwarded on THIS request
+            try:
+                res = self._post(host, req, on_tokens, streamed, cancelled)
+                host.served += 1
+                host.healthy = True
+                return res
+            except Exception as e:  # noqa: BLE001 - degrade per request
+                if rid in cancelled:
+                    # the hangup WE caused: report the abort, not an error
+                    return GenerationResult(request_id=rid,
+                                            finish_reason="cancelled")
+                host.failed += 1
+                host.healthy = False
+                last_err = f"{host.netloc}: {type(e).__name__}: {e}"
+                logger.warning("request %d failed on %s (attempt %d): %s",
+                               rid, host.netloc, attempt + 1, last_err)
+                if streamed[0]:
+                    # a retry would REPLAY the already-forwarded deltas
+                    # through on_tokens, breaking the Engine contract that
+                    # delta concatenation equals the final text — surface
+                    # the mid-stream failure instead
+                    break
+        return GenerationResult(request_id=rid, finish_reason="error",
+                                error=last_err)
+
+    def _post(self, host: _Host, req: GenerationRequest, on_tokens,
+              streamed: list[int], cancelled: set[int]) -> GenerationResult:
+        body = _request_body(req)
+        if on_tokens is not None:
+            body["stream"] = True
+            body["stream_options"] = {"include_usage": True}
+        conn = host.connect(self.timeout_s)
+        rid = req.request_id
+        with self._inflight_lock:
+            self._inflight[rid] = conn
+        try:
+            payload = json.dumps(body)
+            conn.request("POST", "/v1/chat/completions", body=payload,
+                         headers={"Content-Type": "application/json"})
+            # close the cancel() race on an unconnected conn: cancel adds
+            # its id BEFORE closing, and close() on a socketless
+            # HTTPConnection no-ops (request() would then auto-open a
+            # fresh socket and the hangup would vanish) — so re-check now
+            # that the socket exists, and hang up ourselves if it fired
+            # in the window
+            if rid in cancelled:
+                raise ConnectionAbortedError("cancelled during connect")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                # status BEFORE body parse: a proxy's HTML 502 must not be
+                # misclassified as a connection failure (which would mark
+                # the host unhealthy and burn the retry)
+                return GenerationResult(request_id=rid, finish_reason="error",
+                                        error=self._error_message(resp))
+            if on_tokens is not None:
+                return self._read_sse(resp, req, on_tokens, streamed,
+                                      cancelled)
+            data = json.loads(resp.read())
+            choice = data["choices"][0]
+            usage = data.get("usage") or {}
+            return GenerationResult(
+                request_id=rid,
+                text=choice["message"]["content"],
+                prompt_tokens=int(usage.get("prompt_tokens", 0)),
+                completion_tokens=int(usage.get("completion_tokens", 0)),
+                finish_reason=choice.get("finish_reason") or "stop",
+            )
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(rid, None)
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @staticmethod
+    def _error_message(resp) -> str:
+        try:
+            data = json.loads(resp.read())
+            return (data.get("error") or {}).get(
+                "message", f"HTTP {resp.status}")
+        except Exception:  # noqa: BLE001 - malformed error body
+            return f"HTTP {resp.status}"
+
+    def _read_sse(self, resp, req: GenerationRequest, on_tokens,
+                  streamed: list[int],
+                  cancelled: set[int]) -> GenerationResult:
+        """Consume a chat.completion.chunk SSE stream, forwarding content
+        deltas; the terminal chunk carries finish_reason and (via
+        stream_options.include_usage, which _post requests) exact usage.
+        A cancel-induced hangup mid-stream keeps the deltas already
+        received (the in-process engines' keep-partial-output contract,
+        scheduler.cancel docstring) instead of discarding them."""
+        rid = req.request_id
+        text_parts: list[str] = []
+        finish = "stop"
+        usage: dict = {}
+        try:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                data = line[5:].strip()
+                if data == "[DONE]":
+                    break
+                evt = json.loads(data)
+                if "error" in evt:
+                    return GenerationResult(
+                        request_id=rid, finish_reason="error",
+                        error=evt["error"].get("message", "?"))
+                choice = evt["choices"][0]
+                delta = choice.get("delta") or {}
+                piece = delta.get("content")
+                if piece:
+                    text_parts.append(piece)
+                    streamed[0] += 1
+                    on_tokens(rid, piece)
+                if choice.get("finish_reason"):
+                    finish = choice["finish_reason"]
+                if evt.get("usage"):
+                    usage = evt["usage"]
+        except OSError:
+            if rid not in cancelled:
+                raise
+            finish = "cancelled"
+        return GenerationResult(
+            request_id=rid, text="".join(text_parts),
+            prompt_tokens=int(usage.get("prompt_tokens", 0)),
+            completion_tokens=int(usage.get("completion_tokens",
+                                            len(text_parts))),
+            finish_reason=finish)

@@ -40,6 +40,18 @@ import pytest
 REFERENCE_EXAMPLE = Path("/root/reference/transcript-example.json")
 
 
+def free_port() -> int:
+    """OS-assigned local port (shared by the multi-process tests).  The
+    probe socket closes before the caller binds, so a collision is
+    possible (TOCTOU) — callers that can retry should (test_distributed's
+    pair fixture does)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def make_segments(n: int = 200, n_speakers: int = 2, seed: int = 0) -> list[dict]:
     """Deterministic synthetic diarized transcript (schema: README.md:162-175)."""
     rng = random.Random(seed)

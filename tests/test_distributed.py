@@ -15,7 +15,6 @@ step: the collective's VALUE is checked, not just liveness.
 
 from __future__ import annotations
 
-import socket
 import subprocess
 import sys
 from pathlib import Path
@@ -28,10 +27,7 @@ from tests._dist_worker import make_cfg, make_global_tokens
 WORKER = Path(__file__).parent / "_dist_worker.py"
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests.conftest import free_port as _free_port
 
 
 def _run_pair(d: Path) -> tuple[bool, list[str], list]:
