@@ -236,7 +236,16 @@ def run_bench() -> tuple[float, dict]:
         # doubles; docs/PERF.md round 3).  The LIBRARY defaults stay bf16 —
         # int8 weights/KV are quality tradeoffs a throughput bench need not
         # pay but a user must opt into.
-        engine=EngineConfig(backend="jax", max_tokens=128, max_batch_slots=24,
+        # tokenizer pinned to byte: the 8B preset carries the real 128k
+        # vocabulary (the LM head's true byte share), which would otherwise
+        # flip the engine's default-tokenizer heuristic off byte.
+        # LMRS_BENCH_SLOTS: page-pool headroom knob for the 8B preset
+        # (24 slots x 2048 x 64 KB/token int8 = 3.2 GB worst-case pool on
+        # top of ~8.6 GB weights; the driver default stays 24).
+        engine=EngineConfig(backend="jax", max_tokens=128,
+                            max_batch_slots=int(
+                                os.environ.get("LMRS_BENCH_SLOTS", "24")),
+                            tokenizer="byte",
                             retry_delay=0.0, seed=0, page_size=512,
                             num_pages=1, decode_block=128, prefill_chunk=4096,
                             quantize="int8", kv_quantize="int8"),

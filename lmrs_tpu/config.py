@@ -285,6 +285,19 @@ def model_preset(name: str) -> ModelConfig:
         "tiny-moe": dict(
             hidden_dim=512, n_experts=4, n_experts_per_token=2,
         ),
+        "bench-8b": dict(
+            # The BASELINE north-star model shape (Llama-3-8B: BASELINE.md
+            # headline row), full vocabulary included so the LM head
+            # streams its real 525 MB share of the decode bytes.  Window
+            # 2048 = the bench's measured-optimal serving window (the 8192
+            # training window is irrelevant to chunked map serving —
+            # docs/PERF.md round 4 rejected 4096).  Run with int8 weights
+            # + int8 KV: ~8.6 GB weights + ~3.2 GB worst-case page pool
+            # fits one 16 GB v5e chip.
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, hidden_dim=14336, max_seq_len=2048,
+            rope_theta=500000.0, tie_embeddings=False,
+        ),
         "quality-tiny": dict(
             # CLI end-to-end quality gate (tests/test_quality.py): a byte-
             # level model small enough to fine-tune inside the test suite on

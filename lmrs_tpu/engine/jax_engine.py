@@ -90,6 +90,7 @@ class JaxEngine:
             self._mesh = build_mesh(mesh_cfg, devices)
         key = jax.random.PRNGKey(engine_cfg.seed)
         t0 = time.time()
+        quantized = False
         if params is None:
             if engine_cfg.checkpoint_path:
                 from lmrs_tpu.models.loader import load_checkpoint
@@ -103,14 +104,21 @@ class JaxEngine:
                     "no checkpoint for %s: using random-init weights "
                     "(throughput-correct, content-free)", model_cfg.name,
                 )
-                params = init_params(model_cfg, key)
-        if engine_cfg.quantize:  # mode validated in EngineConfig.__post_init__
-            from lmrs_tpu.ops.quant import quantize_params, quantized_bytes
-
-            before = quantized_bytes(params)
-            params = quantize_params(params)
-            logger.info("int8 weight quantization: %.1f -> %.1f MiB",
-                        before / 2**20, quantized_bytes(params) / 2**20)
+                if engine_cfg.quantize:
+                    # quantized random init materializes + quantizes on the
+                    # HOST: the full-precision tree of an 8B-shape model
+                    # (16 GB bf16) cannot coexist with anything on a 16 GB
+                    # chip — only the int8 tree ships to the device
+                    cpu = jax.devices("cpu")[0]
+                    with jax.default_device(cpu):
+                        params = init_params(model_cfg, key)
+                        params = self._quantize_logged(params)
+                    quantized = True
+                else:
+                    params = init_params(model_cfg, key)
+        if engine_cfg.quantize and not quantized:
+            # checkpoint- or caller-provided params quantize where they live
+            params = self._quantize_logged(params)
         self.params = self._place(params)
         logger.info("model %s: %.1fM params ready in %.1fs", model_cfg.name,
                     param_count(self.params) / 1e6, time.time() - t0)
@@ -137,6 +145,15 @@ class JaxEngine:
         if self.cfg.tokenizer:
             return get_tokenizer(self.cfg.tokenizer)
         return ByteTokenizer() if self.model_cfg.vocab_size < 100000 else get_tokenizer("approx")
+
+    def _quantize_logged(self, params):
+        from lmrs_tpu.ops.quant import quantize_params, quantized_bytes
+
+        before = quantized_bytes(params)
+        params = quantize_params(params)
+        logger.info("int8 weight quantization: %.1f -> %.1f MiB",
+                    before / 2**20, quantized_bytes(params) / 2**20)
+        return params
 
     def _place(self, params):
         """Put params on device(s); with a >1-device mesh, use TP layout.

@@ -27,9 +27,11 @@ Design choices:
   boundaries with no extra wire protocol.
 * **Degrade-and-continue** (llm_executor.py:219-225): a request that fails
   on one host retries once on the next healthy host, then surfaces as an
-  error result; a connection-level failure marks the host unhealthy and
-  the next wave routes around it (probed for recovery, like
-  ReplicatedEngine's health loop).
+  error result.  Only a CONNECTION-phase failure marks the host unhealthy
+  (a slow or truncated response on an established connection is a
+  per-request fault, not a dead host); each wave launches a /healthz
+  probe at unhealthy hosts so a restarted worker re-admits — the same
+  route-around → probe → re-admit loop as ReplicatedEngine's.
 """
 
 from __future__ import annotations
@@ -65,6 +67,13 @@ def _request_body(req: GenerationRequest) -> dict:
     return body
 
 
+class _HostConnectError(ConnectionError):
+    """Connection-phase failure: the HOST is down/unreachable (marks it
+    unhealthy), as opposed to a per-request failure on an established
+    connection (slow completion, truncated stream) which must NOT evict
+    an otherwise-live host from the fleet."""
+
+
 class _Host:
     """One backend lmrs-serve process."""
 
@@ -78,6 +87,22 @@ class _Host:
 
     def connect(self, timeout: float) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(self.netloc, timeout=timeout)
+
+    def probe(self) -> bool:
+        """GET /healthz; re-admits an unhealthy host when it answers."""
+        conn = None
+        try:
+            conn = self.connect(timeout=2.0)
+            conn.request("GET", "/healthz")
+            ok = conn.getresponse().status == 200
+        except Exception:  # noqa: BLE001 - still down
+            ok = False
+        finally:
+            if conn is not None:
+                conn.close()
+        if ok:
+            self.healthy = True
+        return ok
 
 
 class RouterEngine:
@@ -95,11 +120,13 @@ class RouterEngine:
         self._pool = ThreadPoolExecutor(
             max_workers=max(8, 4 * len(self.hosts)),
             thread_name_prefix="lmrs-router")
-        # rid -> live connection, so cancel() can hang up mid-request; the
-        # lock guards the dict, not the sockets (closing a socket another
-        # thread is reading is the POINT — it raises there and the request
-        # finishes as cancelled)
-        self._inflight: dict[int, http.client.HTTPConnection] = {}
+        # rid -> live connection (pre-connect) or RAW SOCKET (post-connect,
+        # the hangup target — getresponse() DETACHES the socket from the
+        # HTTPConnection for Connection:close responses like the server's
+        # SSE, so conn.sock is None exactly when a hangup matters most);
+        # the lock guards the dict, not the sockets: shutting down a
+        # socket another thread is reading is the POINT
+        self._inflight: dict[int, object] = {}
         self._inflight_lock = threading.Lock()
         # cancel ids are WAVE-scoped (created per _wave, dropped with it):
         # a persistent set would let a stale cancel for a rid that never
@@ -132,25 +159,25 @@ class RouterEngine:
         if wave is not None:
             wave.add(request_id)
         with self._inflight_lock:
-            conn = self._inflight.get(request_id)
-        if conn is not None:
-            # shutdown(), not close(): while the dispatch thread is blocked
-            # reading the response, socket.makefile's _io_refs defer a
-            # close() — no FIN would ever reach the server and the "hangup"
-            # would silently no-op.  shutdown() sends the FIN immediately
-            # and unblocks the local read.
-            import socket as _socket
+            target = self._inflight.get(request_id)
+        if target is None:
+            return
+        # shutdown(), not close(): while the dispatch thread is blocked
+        # reading the response, socket.makefile's _io_refs defer a close()
+        # — no FIN would ever reach the server and the "hangup" would
+        # silently no-op.  shutdown() sends the FIN immediately and
+        # unblocks the local read.  Pre-connect the target is the
+        # HTTPConnection (no socket yet; _post's post-request re-check
+        # covers that window).
+        import socket as _socket
 
-            try:
-                sock = getattr(conn, "sock", None)
-                if sock is not None:
-                    sock.shutdown(_socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except Exception:  # noqa: BLE001 - best-effort hangup
-                pass
+        try:
+            if isinstance(target, _socket.socket):
+                target.shutdown(_socket.SHUT_RDWR)
+            else:
+                target.close()
+        except OSError:
+            pass
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -183,6 +210,12 @@ class RouterEngine:
         self._wave_cancelled = cancelled = set()
         base = self._rr_base
         self._rr_base += len(requests)
+        # recovery probes run CONCURRENTLY with the wave, on unhealthy
+        # hosts only — a restarted worker re-admits without waiting for
+        # total fleet failure (ReplicatedEngine's probe loop, ported)
+        for host in self.hosts:
+            if not host.healthy:
+                self._pool.submit(host.probe)
         try:
             futures = [
                 self._pool.submit(self._one, base + i, req, on_tokens,
@@ -222,7 +255,11 @@ class RouterEngine:
                     return GenerationResult(request_id=rid,
                                             finish_reason="cancelled")
                 host.failed += 1
-                host.healthy = False
+                if isinstance(e, _HostConnectError):
+                    # only a connect-phase failure condemns the host: a
+                    # slow completion's socket timeout or a truncated
+                    # response must not evict a live host from the fleet
+                    host.healthy = False
                 last_err = f"{host.netloc}: {type(e).__name__}: {e}"
                 logger.warning("request %d failed on %s (attempt %d): %s",
                                rid, host.netloc, attempt + 1, last_err)
@@ -246,6 +283,15 @@ class RouterEngine:
         with self._inflight_lock:
             self._inflight[rid] = conn
         try:
+            try:
+                conn.connect()  # explicit: connect failures mean HOST DOWN
+            except OSError as e:
+                raise _HostConnectError(str(e)) from e
+            with self._inflight_lock:
+                # re-pin to the RAW socket: getresponse() will detach it
+                # from the conn for Connection:close responses (SSE), and
+                # cancel() must still be able to hang up
+                self._inflight[rid] = conn.sock
             payload = json.dumps(body)
             conn.request("POST", "/v1/chat/completions", body=payload,
                          headers={"Content-Type": "application/json"})
@@ -307,6 +353,7 @@ class RouterEngine:
         text_parts: list[str] = []
         finish = "stop"
         usage: dict = {}
+        done_seen = False  # the [DONE] terminator actually arrived
         try:
             for raw in resp:
                 line = raw.decode("utf-8", "replace").strip()
@@ -314,6 +361,7 @@ class RouterEngine:
                     continue
                 data = line[5:].strip()
                 if data == "[DONE]":
+                    done_seen = True
                     break
                 evt = json.loads(data)
                 if "error" in evt:
@@ -335,6 +383,19 @@ class RouterEngine:
             if rid not in cancelled:
                 raise
             finish = "cancelled"
+            done_seen = True  # partial-output contract: keep the deltas
+        if not done_seen:
+            # The server's SSE body has NO length framing (the connection
+            # closes to end it, server.py _sse_headers), so a hangup or a
+            # worker crash mid-stream reads as a CLEAN EOF here — without
+            # this check a cancelled or truncated stream would be reported
+            # as a normal 'stop' completion.
+            if rid in cancelled:
+                finish = "cancelled"
+            else:
+                raise ConnectionResetError(
+                    "SSE stream ended before [DONE] "
+                    f"({len(text_parts)} deltas received)")
         return GenerationResult(
             request_id=rid, text="".join(text_parts),
             prompt_tokens=int(usage.get("prompt_tokens", 0)),

@@ -295,6 +295,9 @@ def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
                                          max_tokens_cap),
         temperature=float(body.get("temperature", 0.3)),
         top_p=float(body.get("top_p", 1.0)),
+        # OpenAI extension (vLLM et al. accept it too); the router
+        # forwards it so backend='http' samples like backend='jax'
+        top_k=int(body.get("top_k", 0)),
         stop=tuple(stop),
         seed=body.get("seed"),
     )
@@ -320,6 +323,7 @@ def _messages_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
                                          max_tokens_cap),
         temperature=float(body.get("temperature", 0.3)),
         top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),  # native Anthropic param
         stop=tuple(stop),
     )
 
@@ -460,9 +464,22 @@ class EngineHTTPServer:
 
             def _drain(self, job: _Job):
                 """Yield deltas until the dispatcher's sentinel; afterwards
-                ``job.result`` is guaranteed set."""
+                ``job.result`` is guaranteed set.  Polls for client
+                disconnect WHILE WAITING: SSE write failures only catch a
+                disconnect when deltas flow, but a stream can be silent
+                for long stretches (prefill phase; byte models emitting
+                invalid-UTF-8 partials that never flush) — without the
+                poll an abandoned silent stream decodes to max_tokens."""
                 while True:
-                    d = job.deltas.get()
+                    try:
+                        d = job.deltas.get(timeout=0.5)
+                    except queue.Empty:
+                        if not job.cancelled and self._client_gone():
+                            logger.debug(
+                                "silent stream client disconnected; "
+                                "cancelling")
+                            outer.batcher.cancel(job)
+                        continue
                     if d is None:
                         return
                     yield d

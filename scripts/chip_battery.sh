@@ -25,4 +25,9 @@ timeout 1200 python scripts/ab_spec.py 2>&1 | tee "$OUT/spec.log"
 echo "=== 5. int8 x flash-tile sanity (should reproduce r2: ~41.5% MFU tile 512) ==="
 timeout 1200 python scripts/ab_int8.py 2>&1 | tee "$OUT/int8.log"
 
+echo "=== 6. 8B north-star bench (BASELINE model shape, int8 W+KV, one chip) ==="
+# host-side random init of the 8B tree adds ~2-4 min before the first rep
+LMRS_BENCH_MODEL=bench-8b LMRS_BENCH_DEADLINE_S=3600 \
+  timeout 3900 python bench.py 2>&1 | tee "$OUT/bench8b.log"
+
 echo "battery complete -> $OUT"

@@ -217,6 +217,37 @@ def check_int8_kv_decode(interpret: bool) -> float:
     return _maxdiff(got, want)
 
 
+def check_int8_multi_verify(interpret: bool) -> float:
+    """Int8 pools through the dequantizing MULTI-token verify kernel
+    (speculation × int8 KV, r5: the r4 construction gate fell) vs the
+    int8 XLA multi path — same span-straddling shapes as the bf16 multi
+    check, 32-row RMW windows, frozen per-channel scales."""
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_multi_xla, paged_decode_pallas_multi)
+
+    b, t, h, kh, hd, ps, n_pages = 2, 5, 8, 4, 128, 128, 12
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.standard_normal((b, t, h, hd)), jnp.bfloat16)
+    k_new = jnp.asarray(rng.standard_normal((b, t, kh, hd)), jnp.bfloat16)
+    v_new = jnp.asarray(rng.standard_normal((b, t, kh, hd)), jnp.bfloat16)
+    kq = jnp.asarray(rng.integers(-127, 128, (n_pages, kh, ps, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (n_pages, kh, ps, hd)), jnp.int8)
+    tables = jnp.asarray(1 + np.arange(b * 3).reshape(b, 3), jnp.int32)
+    kv_lens = jnp.asarray([ps + 2, 131], jnp.int32)  # page + window straddles
+    ks = jnp.asarray(rng.uniform(0.01, 0.05, (b, kh, hd)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.05, (b, kh, hd)), jnp.float32)
+
+    want, k_ref, v_ref = paged_decode_multi_xla(
+        q, k_new, v_new, kq, vq, tables, kv_lens, kv_scales=(ks, vs))
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, k_new, v_new, kq, vq, tables, kv_lens, interpret=interpret,
+        kscale=ks, vscale=vs)
+    wdiff = int(jnp.sum(k_out[1:1 + b * 3] != k_ref[1:1 + b * 3])) \
+        + int(jnp.sum(v_out[1:1 + b * 3] != v_ref[1:1 + b * 3]))
+    assert wdiff == 0, f"{wdiff} pool bytes differ from the XLA scatter"
+    return _maxdiff(got, want)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--interpret", action="store_true",
@@ -251,6 +282,10 @@ def main() -> int:
         # scales in f32 — the gap is reference precision, not kernel error
         ("int8_kv_fused_decode_vs_xla",
          lambda: check_int8_kv_decode(args.interpret), 0.1),
+        # same 0.1 rationale as the fused int8 check: the XLA reference
+        # double-rounds through bf16, the kernel folds scales in f32
+        ("int8_multi_verify_vs_xla",
+         lambda: check_int8_multi_verify(args.interpret), 0.1),
     ]
     results = {}
     failed = []

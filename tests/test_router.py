@@ -163,6 +163,44 @@ def test_cancel_crosses_process_boundary(cluster):
         "worker never recorded the remote cancellation"
 
 
+def test_streamed_cancel_is_cancelled_not_stop(cluster):
+    """A cancel mid-SSE-stream must report finish_reason='cancelled' with
+    only the deltas received — the server's unframed SSE body reads as a
+    clean EOF on hangup, which must not masquerade as a normal 'stop'
+    completion.  (Random-init workers flush deltas only at completion —
+    invalid UTF-8 partials never form consistent prefixes — so the
+    mid-decode trigger is the worker metrics poll, same as the
+    non-streamed cancel test; the delta list is then typically empty.)"""
+    urls, _, router = cluster
+    deltas: list[str] = []
+    result = {}
+
+    def run() -> None:
+        result["res"] = router.generate_batch(
+            [GenerationRequest(prompt="stream cancel probe", request_id=5,
+                               temperature=0.0, max_new_tokens=400)],
+            on_tokens=lambda rid, piece: deltas.append(piece))[0]
+
+    tokens_before = {u: _host_metrics(u)["engine"]["decode_tokens"]
+                     for u in urls}
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.time() + 120
+    while time.time() < deadline and t.is_alive():
+        if any(_host_metrics(u)["engine"]["decode_tokens"]
+               > tokens_before[u] for u in urls):
+            break
+        time.sleep(0.05)
+    assert t.is_alive(), "victim finished before the cancel could land"
+    router.cancel(5)
+    t.join(timeout=120)
+    assert not t.is_alive(), "cancelled streamed request never returned"
+    res = result["res"]
+    assert res.finish_reason == "cancelled", res
+    assert res.text == "".join(deltas)
+    assert res.completion_tokens < 400
+
+
 def test_dead_host_degrades_not_fails(cluster):
     """Killing one worker mid-fleet must not fail the wave: requests
     reroute to the survivor and the dead host is marked unhealthy.
@@ -231,3 +269,36 @@ def test_pipeline_map_reduce_over_http_fleet(tmp_path):
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+def test_dead_host_recovers_via_probe(cluster):
+    """A host that comes back (worker restart on the same port) must be
+    re-admitted by the per-wave /healthz probe — an unhealthy mark is not
+    a life sentence.  Runs after test_dead_host_degrades_not_fails killed
+    worker 1; restarts it (mock backend: the router is engine-agnostic)."""
+    urls, procs, router = cluster
+    assert not router.hosts[1].healthy  # left dead by the previous test
+    port = urls[1].rsplit(":", 1)[1]
+    procs[1] = subprocess.Popen(
+        [sys.executable, "-m", "lmrs_tpu.serving.cli",
+         "--backend", "mock", "--port", port, "-q"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd="/root/repo",
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    _wait_healthy(urls[1], procs[1], deadline_s=60)
+    # each wave launches probes at unhealthy hosts; a couple of waves give
+    # the async probe time to land and the router starts routing there
+    deadline = time.time() + 30
+    while time.time() < deadline and not router.hosts[1].healthy:
+        router.generate_batch(
+            [GenerationRequest(prompt="probe tick", request_id=900,
+                               temperature=0.0, max_new_tokens=2)])
+        time.sleep(0.2)
+    assert router.hosts[1].healthy, "probe never re-admitted the host"
+    served_before = router.hosts[1].served
+    out = router.generate_batch(
+        [GenerationRequest(prompt=f"rejoin probe {i}", request_id=i,
+                           temperature=0.0, max_new_tokens=2)
+         for i in range(4)])
+    assert all(r.error is None for r in out)
+    assert router.hosts[1].served > served_before, \
+        "re-admitted host received no traffic"
