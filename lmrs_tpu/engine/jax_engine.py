@@ -105,14 +105,16 @@ class JaxEngine:
                     "(throughput-correct, content-free)", model_cfg.name,
                 )
                 if engine_cfg.quantize:
-                    # quantized random init materializes + quantizes on the
-                    # HOST: the full-precision tree of an 8B-shape model
-                    # (16 GB bf16) cannot coexist with anything on a 16 GB
-                    # chip — only the int8 tree ships to the device
-                    cpu = jax.devices("cpu")[0]
-                    with jax.default_device(cpu):
-                        params = init_params(model_cfg, key)
-                        params = self._quantize_logged(params)
+                    # quantized random init builds the int8 tree directly
+                    # on the HOST (numpy): the full-precision tree of an
+                    # 8B-shape model (16 GB bf16) cannot coexist with
+                    # anything on a 16 GB chip, and under the axon tunnel
+                    # no jax CPU backend exists to stage it on — only the
+                    # ~8.6 GB quantized tree ever ships to the device
+                    from lmrs_tpu.ops.quant import random_quantized_init
+
+                    params = random_quantized_init(model_cfg,
+                                                   engine_cfg.seed)
                     quantized = True
                 else:
                     params = init_params(model_cfg, key)

@@ -65,13 +65,24 @@ class ReplicatedEngine:
             from lmrs_tpu.models.loader import load_checkpoint
 
             shared = load_checkpoint(engine_cfg.checkpoint_path, model_cfg)
+        elif engine_cfg.quantize:
+            # quantized random init builds the int8 tree host-side (numpy)
+            # without ever materializing the full-precision tree — at 8B
+            # shape that tree would OOM the default device, and under the
+            # axon tunnel there is no jax CPU backend to stage it on (the
+            # same path JaxEngine takes for quantize + random init)
+            from lmrs_tpu.ops.quant import random_quantized_init
+
+            logger.warning("no checkpoint for %s: replicas share random-init "
+                           "weights", model_cfg.name)
+            shared = random_quantized_init(model_cfg, engine_cfg.seed)
         else:
             from lmrs_tpu.models.transformer import init_params
 
             logger.warning("no checkpoint for %s: replicas share random-init "
                            "weights", model_cfg.name)
             shared = init_params(model_cfg, jax.random.PRNGKey(engine_cfg.seed))
-        if engine_cfg.quantize:
+        if engine_cfg.quantize and engine_cfg.checkpoint_path:
             from lmrs_tpu.ops.quant import quantize_params
 
             shared = quantize_params(shared)

@@ -71,24 +71,59 @@ def _contract_axes(name: str, ndim: int) -> tuple[int, ...]:
     raise ValueError(f"no contraction rule for weight {name!r}")
 
 
-def quantize_params(params: Any) -> Any:
-    """Quantize the projection weights of a transformer param pytree.
-
-    Returns a new pytree where eligible leaves become {"q","s"} dicts;
-    structure is otherwise identical (scan/shard/jit all still work).
-    ``lm_head.weight`` is included; ``embed.weight`` is not.
-    """
+def _walk_quantizable(params: Any, qfn, plain) -> Any:
+    """Shared eligibility walk: eligible projection leaves map through
+    ``qfn(leaf, contract_axes)``, everything else through ``plain(leaf)``.
+    ``lm_head.weight`` is included; ``embed.weight`` is not."""
     def walk(tree: Any, path: tuple[str, ...]) -> Any:
         if isinstance(tree, dict) and not is_quantized(tree):
             return {k: walk(v, path + (k,)) for k, v in tree.items()}
         name = path[-1] if path else ""
         if name in _QUANT_NAMES:
-            return quantize_weight(tree, _contract_axes(name, tree.ndim))
+            return qfn(tree, _contract_axes(name, tree.ndim))
         if len(path) >= 2 and path[-2] == "lm_head":
-            return quantize_weight(tree, _contract_axes("lm_head", tree.ndim))
-        return tree
+            return qfn(tree, _contract_axes("lm_head", tree.ndim))
+        return plain(tree)
 
     return walk(params, ())
+
+
+def quantize_params(params: Any) -> Any:
+    """Quantize the projection weights of a transformer param pytree.
+
+    Returns a new pytree where eligible leaves become {"q","s"} dicts;
+    structure is otherwise identical (scan/shard/jit all still work)."""
+    return _walk_quantizable(params, quantize_weight, lambda x: x)
+
+
+def random_quantized_init(cfg, seed: int) -> Any:
+    """Random param tree in ALREADY-QUANTIZED form, built host-side with
+    numpy — throughput-identical to quantize(random-init) without ever
+    materializing the full-precision tree.  Needed for quantized
+    random-init at 8B shape (bench-8b): the 16 GB bf16 tree cannot
+    coexist with anything on a 16 GB chip, and under the axon tunnel no
+    jax CPU backend is registered to stage it on.  Structure comes from
+    ``jax.eval_shape`` over the real initializer, so it can never drift
+    from ``init_params``."""
+    import numpy as np
+
+    from lmrs_tpu.models.transformer import init_params
+
+    shapes = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+
+    def qfn(sd, axes):
+        s_shape = tuple(1 if a in axes else n
+                        for a, n in enumerate(sd.shape))
+        return {"q": rng.integers(-127, 128, sd.shape, dtype=np.int8),
+                "s": np.full(s_shape, 2e-4, np.float32)}
+
+    def plain(sd):
+        arr = rng.standard_normal(sd.shape, dtype=np.float32) * 0.02
+        return arr.astype(sd.dtype)
+
+    return _walk_quantizable(shapes, qfn, plain)
 
 
 def quantized_bytes(params: Any) -> int:
