@@ -1,0 +1,40 @@
+"""Serving-config latency percentiles on the real chip (PERF round 5):
+bench-1b int8 W+KV at decode_block=16 — the TTFT / per-block-gap numbers a
+streaming client sees, from the scheduler's always-on samples."""
+import json, sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+
+eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                             max_tokens=128, max_batch_slots=24, seed=0,
+                             page_size=512, num_pages=1, decode_block=16,
+                             prefill_chunk=4096, quantize="int8",
+                             kv_quantize="int8", retry_delay=0.0),
+                model_preset("bench-1b"))
+rng = np.random.default_rng(0)
+def mk(i, words):
+    body = " ".join(f"w{rng.integers(0, 999)}" for _ in range(words))
+    return GenerationRequest(prompt=body, request_id=i, temperature=0.3,
+                            max_new_tokens=128)
+# warmup compiles every shape the measured wave uses
+eng.generate_batch([mk(i, 300) for i in range(24)])
+sched = eng._scheduler
+sched.reset_latency_stats()
+m0 = dict(sched.metrics)
+t0 = time.time()
+out = eng.generate_batch([mk(100 + i, 300) for i in range(48)])
+wall = time.time() - t0
+rep = sched.metrics_report()
+print(json.dumps({
+    "config": "bench-1b int8 W+KV, decode_block=16, 24 slots, 48 reqs (~1.4k-token prompts)",
+    "wall_s": round(wall, 2),
+    "ttft_ms": rep["ttft_ms"],
+    "decode_block_gap_ms": rep["decode_block_gap_ms"],
+    "decode_dispatches": sched.metrics["decode_dispatches"] - m0["decode_dispatches"],
+    "occupancy": round((sched.metrics["occupancy_sum"] - m0["occupancy_sum"]) /
+                       max(sched.metrics["decode_dispatches"] - m0["decode_dispatches"], 1), 3),
+    "failed": sum(r.error is not None for r in out),
+}))
