@@ -14,6 +14,7 @@ import json
 import os
 import subprocess
 import sys
+import random
 import threading
 import time
 import urllib.request
@@ -47,6 +48,26 @@ def _host_metrics(url: str) -> dict:
         return json.loads(r.read())
 
 
+def _spawn_mock_worker(port: int) -> subprocess.Popen:
+    """One mock-backend lmrs-serve process (the shared worker-spawn used
+    by the fleet tests that don't need a real scheduler)."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "lmrs_tpu.serving.cli",
+         "--backend", "mock", "--port", str(port), "-q"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd="/root/repo",
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _teardown(procs) -> None:
+    for proc in procs:
+        proc.terminate()
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 @pytest.fixture(scope="module")
 def cluster():
     """Two lmrs-serve processes with REAL jax continuous schedulers
@@ -73,13 +94,7 @@ def cluster():
         yield urls, procs, router
     finally:
         router.shutdown()
-        for proc in procs:
-            proc.terminate()
-        for proc in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        _teardown(procs)
 
 
 def test_wave_fans_over_both_processes(cluster):
@@ -233,15 +248,7 @@ def test_pipeline_map_reduce_over_http_fleet(tmp_path):
 
     ports = [_free_port(), _free_port()]
     urls = [f"http://127.0.0.1:{p}" for p in ports]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "lmrs_tpu.serving.cli",
-             "--backend", "mock", "--port", str(p), "-q"],
-            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd="/root/repo",
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-        )
-        for p in ports
-    ]
+    procs = [_spawn_mock_worker(p) for p in ports]
     try:
         for url, proc in zip(urls, procs):
             _wait_healthy(url, proc, deadline_s=60)
@@ -262,13 +269,7 @@ def test_pipeline_map_reduce_over_http_fleet(tmp_path):
         served = [_host_metrics(u)["http_requests"] for u in urls]
         assert all(n > 0 for n in served), f"fleet imbalance: {served}"
     finally:
-        for proc in procs:
-            proc.terminate()
-        for proc in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        _teardown(procs)
 
 
 def test_dead_host_recovers_via_probe(cluster):
@@ -302,3 +303,63 @@ def test_dead_host_recovers_via_probe(cluster):
     assert all(r.error is None for r in out)
     assert router.hosts[1].served > served_before, \
         "re-admitted host received no traffic"
+
+
+@pytest.mark.parametrize("seed", [7, 41])
+def test_fuzzed_router_waves_with_cancels_and_kills(seed):
+    """Router invariants under churn (SURVEY §5.2 for the multi-host
+    tier): random waves with random mid-wave cancels and a mid-test
+    worker kill — every request must get exactly ONE result (cancelled,
+    completed, or error), ids and order preserved, and the router must
+    never raise.  Mock-backend workers: the fuzz targets the ROUTING
+    layer's state machine, not the engine (the scheduler has its own
+    fuzz suite)."""
+    rng = random.Random(seed)
+    ports = [_free_port(), _free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    procs = [_spawn_mock_worker(p) for p in ports]
+    router = RouterEngine(urls, timeout_s=60.0)
+    try:
+        for url, proc in zip(urls, procs):
+            _wait_healthy(url, proc, deadline_s=60)
+        rid = 0
+        kill_wave = rng.randrange(2, 5)
+        for wave in range(6):
+            n = rng.randrange(1, 9)
+            reqs = [GenerationRequest(prompt=f"fuzz {seed} {wave} {i}",
+                                      request_id=rid + i, temperature=0.0,
+                                      max_new_tokens=rng.randrange(1, 6))
+                    for i in range(n)]
+            rid += n
+            victims = [r.request_id for r in reqs if rng.random() < 0.3]
+            canceller = threading.Timer(
+                0.001 * rng.randrange(0, 20),
+                lambda v=victims: [router.cancel(x) for x in v])
+            canceller.start()
+            if wave == kill_wave:
+                procs[1].kill()  # mid-fleet failure
+            out = router.generate_batch(reqs)
+            canceller.join()
+            assert [r.request_id for r in out] == [r.request_id for r in reqs]
+            for r in out:
+                # mock waves are near-instant, so a cancel can land before,
+                # during, or after its victim — any single coherent outcome
+                # is legal, but exactly one result must exist per request
+                assert r.finish_reason in ("stop", "length", "cancelled",
+                                           "error"), r
+            if wave == kill_wave:
+                # restart so later waves can re-admit via the probe;
+                # wait() first: SIGKILL returns before the kernel closes
+                # the old listener, and a respawn would EADDRINUSE (same
+                # reason the dead-host test reaps before asserting)
+                procs[1].wait(timeout=10)
+                procs[1] = _spawn_mock_worker(ports[1])
+                _wait_healthy(urls[1], procs[1], deadline_s=60)
+        # the fleet ends functional: one clean wave, no errors
+        final = router.generate_batch(
+            [GenerationRequest(prompt="post-fuzz", request_id=9999,
+                               temperature=0.0, max_new_tokens=2)])
+        assert final[0].error is None
+    finally:
+        router.shutdown()
+        _teardown(procs)
