@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from lmrs_tpu.config import ModelConfig
 from lmrs_tpu.ops.attention import attention
 from lmrs_tpu.ops.norms import rms_norm
-from lmrs_tpu.ops.quant import deq
+from lmrs_tpu.ops.quant import qeinsum
 from lmrs_tpu.ops.rope import apply_rope, rope_table
 
 Params = dict[str, Any]
@@ -121,30 +121,25 @@ def ffn_block(lp: Params, cfg: ModelConfig, h: jnp.ndarray) -> tuple[jnp.ndarray
 
         return moe_mlp(lp["moe"], cfg, h)
     dt = h.dtype
-    gate = jnp.einsum("bsd,df->bsf", h, deq(lp["mlp"]["w_gate"], dt))
-    up = jnp.einsum("bsd,df->bsf", h, deq(lp["mlp"]["w_up"], dt))
+    gate = qeinsum("bsd,df->bsf", h, lp["mlp"]["w_gate"], dt)
+    up = qeinsum("bsd,df->bsf", h, lp["mlp"]["w_up"], dt)
     ff = gate_act(cfg, gate).astype(dt) * up
-    return jnp.einsum("bsf,fd->bsd", ff, deq(lp["mlp"]["w_down"], dt)), jnp.float32(0.0)
+    return qeinsum("bsf,fd->bsd", ff, lp["mlp"]["w_down"], dt), jnp.float32(0.0)
 
 
 def qkv_proj(lp: Params, cfg: ModelConfig, h: jnp.ndarray):
     """Project a normed [B,S,D] into (q [B,S,H,hd], k, v [B,S,K,hd])."""
-    hd = cfg.hd
     dt = h.dtype
-    q = jnp.einsum("bsd,dhk->bshk", h,
-                   deq(lp["attn"]["wq"], dt).reshape(cfg.dim, cfg.n_heads, hd))
-    k = jnp.einsum("bsd,dhk->bshk", h,
-                   deq(lp["attn"]["wk"], dt).reshape(cfg.dim, cfg.n_kv_heads, hd))
-    v = jnp.einsum("bsd,dhk->bshk", h,
-                   deq(lp["attn"]["wv"], dt).reshape(cfg.dim, cfg.n_kv_heads, hd))
+    q = qeinsum("bsd,dhk->bshk", h, lp["attn"]["wq"], dt)
+    k = qeinsum("bsd,dhk->bshk", h, lp["attn"]["wk"], dt)
+    v = qeinsum("bsd,dhk->bshk", h, lp["attn"]["wv"], dt)
     return q, k, v
 
 
 def out_proj(lp: Params, cfg: ModelConfig, attn_out: jnp.ndarray) -> jnp.ndarray:
     """[B,S,H,hd] attention output back to [B,S,D]."""
-    hd = cfg.hd
-    wo = deq(lp["attn"]["wo"], attn_out.dtype).reshape(cfg.n_heads, hd, cfg.dim)
-    return jnp.einsum("bshk,hkd->bsd", attn_out, wo)
+    return qeinsum("bshk,hkd->bsd", attn_out, lp["attn"]["wo"],
+                   attn_out.dtype)
 
 
 def decoder_layer(
@@ -192,7 +187,7 @@ def lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, deq(params["lm_head"]["weight"], x.dtype))
+        logits = qeinsum("bsd,dv->bsv", x, params["lm_head"]["weight"], x.dtype)
     logits = logits.astype(jnp.float32)
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
@@ -661,7 +656,7 @@ def forward_paged(
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["weight"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, deq(params["lm_head"]["weight"], x.dtype))
+        logits = qeinsum("bsd,dv->bsv", x, params["lm_head"]["weight"], x.dtype)
     logits = logits.astype(jnp.float32)
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from lmrs_tpu.config import ModelConfig
-from lmrs_tpu.ops.quant import deq
+from lmrs_tpu.ops.quant import qeinsum
 
 
 def expert_capacity(n_tokens: int, cfg: ModelConfig) -> int:
@@ -74,12 +74,12 @@ def moe_mlp(mp, cfg: ModelConfig, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndar
 
     # --- expert FFN: all-MXU einsums over [E,C,·] ---
     xin = jnp.einsum("nd,nec->ecd", xt, dispatch.astype(dt))
-    gate_h = jnp.einsum("ecd,edf->ecf", xin, deq(mp["w_gate"], dt))
-    up = jnp.einsum("ecd,edf->ecf", xin, deq(mp["w_up"], dt))
+    gate_h = qeinsum("ecd,edf->ecf", xin, mp["w_gate"], dt)
+    up = qeinsum("ecd,edf->ecf", xin, mp["w_up"], dt)
     from lmrs_tpu.models.transformer import gate_act
 
     ff = gate_act(cfg, gate_h).astype(dt) * up
-    y = jnp.einsum("ecf,efd->ecd", ff, deq(mp["w_down"], dt))
+    y = qeinsum("ecf,efd->ecd", ff, mp["w_down"], dt)
     out = jnp.einsum("nec,ecd->nd", combine.astype(dt), y)
 
     # --- Switch load-balance loss ---

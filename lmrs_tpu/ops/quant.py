@@ -43,10 +43,34 @@ def quantize_weight(w: jnp.ndarray, axes: tuple[int, ...]) -> dict[str, jnp.ndar
 def deq(x: Any, dtype) -> jnp.ndarray:
     """Dequantize a {"q","s"} leaf to ``dtype``; plain arrays pass through.
     The convert*scale is an elementwise producer of the consuming matmul —
-    XLA fuses it, so only int8 is read from HBM."""
+    XLA fuses it, so only int8 is read from HBM.  Matmul call sites should
+    prefer ``qeinsum``, which folds the scale into the matmul OUTPUT
+    instead of paying it per weight element."""
     if is_quantized(x):
         return (x["q"].astype(jnp.float32) * x["s"]).astype(dtype)
     return x
+
+
+def qeinsum(spec: str, x: jnp.ndarray, leaf: Any, dtype) -> jnp.ndarray:
+    """``einsum(spec, x, W)`` where W may be a quantized {"q","s"} leaf.
+
+    Quantized weights contract as raw int8 values (converted to ``dtype``
+    — lossless, |q| <= 127 fits bf16's 8-bit mantissa exactly) and the
+    scale multiplies the OUTPUT: scales are per-output-channel by
+    construction (``quantize_weight`` shares them only along the consuming
+    matmul's contracting axes, which are 1-sized in ``s``), so
+    ``einsum(x, q*s) == einsum(x, q) * s`` with ``s`` broadcasting
+    right-aligned onto the result.  This moves the dequant multiply from
+    one-per-WEIGHT-element — VPU work proportional to weight bytes, which
+    measurably throttles the int8 weight stream below HBM rate at 8B
+    shapes (docs/PERF.md round 5) — to one-per-OUTPUT-element (~D× fewer
+    at decode), and drops a rounding step: the old path rounded q*s to
+    bf16 per element before the MXU, this one feeds exact integers.
+    """
+    if is_quantized(leaf):
+        y = jnp.einsum(spec, x, leaf["q"].astype(dtype))
+        return (y * leaf["s"]).astype(dtype)
+    return jnp.einsum(spec, x, leaf)
 
 
 # Weight names eligible for quantization: the large projection matrices.

@@ -1,0 +1,92 @@
+"""Per-row fixed-cost probe for the ragged decode kernel, in isolation.
+
+Times ONE attention layer's kernel (no model around it) at bench-1b's
+attention shape across a batch sweep, for two arms:
+
+* walk     — ``paged_decode_pallas`` (page walk only, no RMW)
+* fused    — ``paged_decode_pallas_fused`` (walk + RMW + cross-row pipeline)
+
+Kernel calls are chained inside one jitted ``fori_loop`` (output feeds
+the next q, pools ride the carry — the decode-block scan's shape), and
+the per-kernel time is the DIFFERENCE between a long and a short chain
+divided by the iteration delta: the tunnel's ~100 ms fetch RTT and the
+dispatch cost cancel exactly instead of polluting the fit (the naive
+per-call timing here is ~97% RTT).
+Run: python scripts/decode_rowcost.py
+"""
+import time
+
+import _pathfix  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lmrs_tpu.ops.paged_attention import (
+    paged_decode_pallas,
+    paged_decode_pallas_fused,
+)
+
+KH, NREP, HD, PS = 8, 2, 128, 512   # bench-1b attention shape
+LIVE = 64
+LO, HI = 64, 2048
+REPS = 5
+
+
+def make_chain(arm, iters, kn, vn, pt, kl):
+    @jax.jit
+    def chain(q, kp, vp):
+        def body(_, carry):
+            q, kp, vp = carry
+            if arm == "walk":
+                out = paged_decode_pallas(q, kp, vp, pt, kl)
+            else:
+                out, kp, vp = paged_decode_pallas_fused(
+                    q, kn, vn, kp, vp, pt, kl)
+            return (out.astype(q.dtype), kp, vp)
+
+        return jax.lax.fori_loop(0, iters, body, (q, kp, vp))
+
+    return chain
+
+
+def main():
+    rng = np.random.default_rng(0)
+    results = {}
+    for B in (8, 16, 24, 32):
+        P = B + 1
+        q = jnp.asarray(rng.standard_normal((B, KH * NREP, HD)), jnp.bfloat16)
+        kn = jnp.asarray(rng.standard_normal((B, KH, HD)), jnp.bfloat16)
+        vn = jnp.asarray(rng.standard_normal((B, KH, HD)), jnp.bfloat16)
+        kp = jnp.asarray(rng.standard_normal((P, KH, PS, HD)), jnp.bfloat16)
+        vp = jnp.asarray(rng.standard_normal((P, KH, PS, HD)), jnp.bfloat16)
+        pt = jnp.asarray(
+            (1 + np.arange(B))[:, None], jnp.int32)  # one live page per row
+        kl = jnp.full((B,), LIVE, jnp.int32)
+
+        for arm in ("walk", "fused"):
+            walls = {}
+            for iters in (LO, HI):
+                fn = make_chain(arm, iters, kn, vn, pt, kl)
+                out = fn(q, kp, vp)
+                np.asarray(jax.device_get(out[0]))  # compile + settle
+                best = float("inf")
+                for _ in range(REPS):
+                    t0 = time.time()
+                    out = fn(q, kp, vp)
+                    np.asarray(jax.device_get(out[0]))
+                    best = min(best, time.time() - t0)
+                walls[iters] = best
+            us = (walls[HI] - walls[LO]) / (HI - LO) * 1e6
+            results.setdefault(arm, []).append((B, us))
+            print(f"B={B:3d} {arm:6s} {us:8.2f} us/kernel", flush=True)
+
+    for arm, rows in results.items():
+        bs = np.array([r[0] for r in rows], float)
+        us = np.array([r[1] for r in rows], float)
+        A = np.vstack([bs, np.ones_like(bs)]).T
+        slope, icpt = np.linalg.lstsq(A, us, rcond=None)[0]
+        print(f"{arm:6s}: {slope:6.3f} us/row + {icpt:6.1f} us launch")
+
+
+if __name__ == "__main__":
+    main()

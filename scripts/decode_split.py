@@ -2,7 +2,10 @@
 bench-1b scale: per-step time vs live tokens separates the weight-stream
 cost (intercept) from the KV-walk cost (slope).
 Run: python scripts/decode_split.py
+Env hooks: LMRS_SPLIT_MODEL (preset, default bench-1b),
+LMRS_SPLIT_QUANT=int8 (int8 weights+KV, e.g. the bench-8b arm).
 """
+import os
 import time
 
 
@@ -14,16 +17,18 @@ import numpy as np
 from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
-from lmrs_tpu.utils.perf_model import decode_step_bytes, kv_bytes_per_token, weight_bytes
+from lmrs_tpu.utils.perf_model import decode_step_bytes, weight_bytes
 
 
 def main():
     setup_logging(quiet=True)
-    model = model_preset("bench-1b")
+    model = model_preset(os.environ.get("LMRS_SPLIT_MODEL", "bench-1b"))
+    quant = os.environ.get("LMRS_SPLIT_QUANT", "")
     eng = JaxEngine(EngineConfig(
         backend="jax", max_tokens=128, max_batch_slots=24,
         retry_delay=0.0, seed=0, page_size=512, num_pages=1,
-        decode_block=128, prefill_chunk=4096), model)
+        decode_block=128, prefill_chunk=4096, tokenizer="byte",
+        quantize=quant or None, kv_quantize=quant or None), model)
     sched = eng._scheduler
     rng = np.random.default_rng(0)
     B, S = sched.B, model.max_seq_len
@@ -55,7 +60,8 @@ def main():
         wall = time.time() - t0 - rtt
         sched.cache.k, sched.cache.v = k, v
         per_step = wall / (3 * sched.decode_block)
-        gb = decode_step_bytes(model, B * live) / 1e9
+        gb = decode_step_bytes(model, B * live, quantized=bool(quant),
+                               kv_quantized=bool(quant)) / 1e9
         results.append((live, per_step, gb))
         print(f"live={live:5d}  {per_step*1e3:7.3f} ms/step  "
               f"{gb:5.2f} GB/step  {gb/per_step:6.0f} GB/s", flush=True)
@@ -64,9 +70,13 @@ def main():
     ms = np.array([r[1] for r in results], float) * 1e3
     A = np.vstack([lv, np.ones_like(lv)]).T
     slope, intercept = np.linalg.lstsq(A, ms, rcond=None)[0]
-    kvgb = B * kv_bytes_per_token(model) / 1e9
-    print(f"fit: intercept {intercept:.2f} ms (weights {weight_bytes(model)/1e9:.2f} GB "
-          f"-> floor {weight_bytes(model)/819e9*1e3:.2f} ms), "
+    wgb = weight_bytes(model, quantized=bool(quant))
+    # per-token KV bytes via the perf model's own halving rule (one source
+    # of truth with the GB/step column above)
+    kvgb = B * (decode_step_bytes(model, 1, quantized=bool(quant),
+                                  kv_quantized=bool(quant)) - wgb) / 1e9
+    print(f"fit: intercept {intercept:.2f} ms (weights {wgb/1e9:.2f} GB "
+          f"-> floor {wgb/819e9*1e3:.2f} ms), "
           f"slope {slope*1e3:.3f} us/live-token "
           f"(KV floor {kvgb/819*1e6:.3f} us/token)")
     for s_ in seqs:

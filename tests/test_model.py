@@ -131,6 +131,28 @@ def test_sampling_top_p_restricts_support():
         assert ids[0] == 0
 
 
+def test_sampling_temp_only_matches_filtered_formulation():
+    # temperature>0 with top_k=0/top_p=1 takes the sort-free fast branch
+    # (the lax.cond added in round 5); it must draw the SAME token as the
+    # filter_logits formulation — with every mask disabled the filtered
+    # logits ARE the scaled logits, so the same key over the same
+    # distribution is the equivalence the fast path's docstring claims.
+    from lmrs_tpu.ops.sampling import filter_logits
+
+    logits = jnp.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (3, 64)) * 3.0)
+    temps = jnp.array([0.3, 1.7, 0.0])
+    tk = jnp.zeros(3, jnp.int32)
+    tp = jnp.ones(3)
+    for i in range(5):
+        key = jax.random.PRNGKey(i)
+        fast = sample_logits(logits, key, temps, tk, tp)
+        masked = filter_logits(logits, temps, tk, tp)
+        slow = jax.random.categorical(key, masked, axis=-1)
+        want = jnp.where(temps > 0, slow, jnp.argmax(logits, -1))
+        assert fast.tolist() == want.tolist()
+
+
 def test_model_presets_exist():
     for name in ["tiny", "llama3-8b", "llama3-70b", "gemma-2b", "gemma-7b"]:
         cfg = model_preset(name)
