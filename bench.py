@@ -217,7 +217,8 @@ def run_bench() -> tuple[float, dict]:
     # egress) — throughput-identical to a trained model of this shape.
     # LMRS_BENCH_MODEL: A/B hook (e.g. "tiny" for a CPU smoke run of the
     # bench harness itself; the driver always runs the default on the chip)
-    model = model_preset(os.environ.get("LMRS_BENCH_MODEL", "bench-1b"))
+    model_name = os.environ.get("LMRS_BENCH_MODEL", "bench-1b")
+    model = model_preset(model_name)
     cfg = PipelineConfig(
         # 1400-token chunks: chunk body (1250) + context header (150) + the
         # ~470-byte map template stay under the scheduler's truncation
@@ -242,11 +243,18 @@ def run_bench() -> tuple[float, dict]:
         # LMRS_BENCH_SLOTS: page-pool headroom knob for the 8B preset
         # (24 slots x 2048 x 64 KB/token int8 = 3.2 GB worst-case pool on
         # top of ~8.6 GB weights; the driver default stays 24).
+        # page_size: 512 was the r4 sweep's optimum for bf16-page DMAs;
+        # int8 KV halves page bytes, and at the 8B shape the r5 split
+        # measured 1024 −7% per step at the bench's ~1.8k-token live mix
+        # (the DMA-issue-per-byte argument, docs/PERF.md round 5).  Short-
+        # context serving configs should stay at 512 (page-quantized reads
+        # dominate there); this is the bench preset's live range talking.
         engine=EngineConfig(backend="jax", max_tokens=128,
                             max_batch_slots=int(
                                 os.environ.get("LMRS_BENCH_SLOTS", "24")),
                             tokenizer="byte",
-                            retry_delay=0.0, seed=0, page_size=512,
+                            retry_delay=0.0, seed=0,
+                            page_size=1024 if model_name == "bench-8b" else 512,
                             num_pages=1, decode_block=128, prefill_chunk=4096,
                             quantize="int8", kv_quantize="int8"),
         model=model,

@@ -3,7 +3,8 @@ bench-1b scale: per-step time vs live tokens separates the weight-stream
 cost (intercept) from the KV-walk cost (slope).
 Run: python scripts/decode_split.py
 Env hooks: LMRS_SPLIT_MODEL (preset, default bench-1b),
-LMRS_SPLIT_QUANT=int8 (int8 weights+KV, e.g. the bench-8b arm).
+LMRS_SPLIT_QUANT=int8 (int8 weights+KV, e.g. the bench-8b arm),
+LMRS_SPLIT_PS (page_size, default 512).
 """
 import os
 import time
@@ -26,7 +27,8 @@ def main():
     quant = os.environ.get("LMRS_SPLIT_QUANT", "")
     eng = JaxEngine(EngineConfig(
         backend="jax", max_tokens=128, max_batch_slots=24,
-        retry_delay=0.0, seed=0, page_size=512, num_pages=1,
+        retry_delay=0.0, seed=0,
+        page_size=int(os.environ.get("LMRS_SPLIT_PS", "512")), num_pages=1,
         decode_block=128, prefill_chunk=4096, tokenizer="byte",
         quantize=quant or None, kv_quantize=quant or None), model)
     sched = eng._scheduler
