@@ -333,6 +333,17 @@ def _scheduler_window(sched, before: dict) -> dict:
         "decode_dispatches": d_disp,
         "stalls": m["stalls"] - before["stalls"],
         "preemptions": m["preemptions"] - before["preemptions"],
+        # device-wait vs host-bookkeeping split of the SCHEDULER wall over
+        # the timed reps (map + reduce both run through the scheduler —
+        # these are engine-wide, not map-only): the host share is time the
+        # device sits idle between a block's fetch and the next dispatch
+        # (the r5 overlap lever's attribution number)
+        "sched_blocked_s": round(
+            m["blocked_seconds"] - before["blocked_seconds"], 2),
+        "sched_host_s": round(
+            max((m["run_seconds"] - before["run_seconds"])
+                - (m["blocked_seconds"] - before["blocked_seconds"]), 0.0),
+            2),
         "phase_split_tokens": {
             "prefill": m["prefill_tokens"] - before["prefill_tokens"],
             "decode": m["decode_tokens"] - before["decode_tokens"],

@@ -261,7 +261,22 @@ class ContinuousScheduler:
             "stalls": 0,  # dispatches a slot sat out waiting for pages
             "peak_active_slots": 0,  # max simultaneously-occupied slots
             "cancelled": 0,  # requests aborted via cancel()
+            # time inside blocking device fetches (run() path only): the
+            # device is busy (or draining the tunnel) while the host waits
+            # here, so run_seconds - blocked_seconds is the host-side share
+            # — bookkeeping the device sits idle for (r5: ~17% of 8B map
+            # wall; the attribution number for any overlap lever)
+            "blocked_seconds": 0.0,
         }
+
+    def _timed_get(self, x):
+        """``jax.device_get`` with the blocking wait charged to the
+        ``blocked_seconds`` metric (device-busy attribution; see the
+        metric's init comment)."""
+        t0 = time.time()
+        out = jax.device_get(x)
+        self.metrics["blocked_seconds"] += time.time() - t0
+        return out
 
     def metrics_report(self) -> dict:
         """Derived engine metrics, cumulative over every run() on this
@@ -282,6 +297,9 @@ class ContinuousScheduler:
             "peak_kv_page_utilization": round(
                 m["peak_pages_in_use"] / (self.cache.num_pages - 1), 3),
             "scheduler_seconds": round(m["run_seconds"], 3),
+            "blocked_seconds": round(m["blocked_seconds"], 3),
+            "host_seconds": round(
+                max(m["run_seconds"] - m["blocked_seconds"], 0.0), 3),
             "preemptions": m["preemptions"],
             "stalls": m["stalls"],
             "cancelled": m["cancelled"],
@@ -506,7 +524,7 @@ class ContinuousScheduler:
                     # speculation seeds a host-built history row per admission —
                     # it needs tok0 values now, so it keeps the synchronous
                     # fetch (also selectable via LMRS_DEFER_TOK0=0 for A/B runs)
-                    fetched = jax.device_get([t for t, _ in pending])
+                    fetched = self._timed_get([t for t, _ in pending])
                     for (b, p, row) in deferred:
                         st = slots[b]
                         tok0 = int(fetched[p][row])
@@ -531,7 +549,7 @@ class ContinuousScheduler:
                         # no dispatch will carry these first tokens: fetch them
                         # now — a stalled slot's tok0 is real output and must
                         # not be dropped (preempted slots resample theirs)
-                        fetched = jax.device_get([t for t, _ in pending])
+                        fetched = self._timed_get([t for t, _ in pending])
                         for (b, p, row) in deferred:
                             if slots[b] is None:
                                 continue
@@ -1437,7 +1455,7 @@ class ContinuousScheduler:
             out = self._get_decode_fn(w)(*args)
         self._ran_ok.add(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
-        toks, n_valid, *tok0s = jax.device_get(  # one transfer
+        toks, n_valid, *tok0s = self._timed_get(  # one transfer
             (toks, n_valid, *[t for t, _ in pending]))
         toks, n_valid = np.asarray(toks), np.asarray(n_valid)
         if bc < B:  # scatter compact results back to full-width slot arrays
@@ -1542,7 +1560,7 @@ class ContinuousScheduler:
             out = self._get_spec_decode_fn(w)(*args)
         self._ran_ok.add(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out
-        toks, counts = jax.device_get((toks, counts))  # one transfer
+        toks, counts = self._timed_get((toks, counts))  # one transfer
         emitted: list[list[int]] = []
         for b in range(self.B):
             row: list[int] = []

@@ -129,6 +129,12 @@ def test_engine_metrics_report(cont_engine):
     assert 0.0 < em["mean_decode_occupancy"] <= 1.0
     assert 0.0 < em["peak_kv_page_utilization"] <= 1.0
     assert em["scheduler_seconds"] > 0
+    # device-wait attribution: every run() fetch is charged via _timed_get,
+    # so a run that generated tokens must show blocked time, and the split
+    # must stay within the scheduler wall (host share clamped >= 0)
+    assert em["blocked_seconds"] > 0
+    assert em["host_seconds"] >= 0
+    assert em["blocked_seconds"] <= em["scheduler_seconds"] + 1e-6
 
 
 def test_latency_percentiles_in_metrics(cont_engine):
