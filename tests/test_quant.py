@@ -10,6 +10,7 @@ from lmrs_tpu.models.transformer import forward, init_params
 from lmrs_tpu.ops.quant import (
     deq,
     is_quantized,
+    qeinsum,
     quantize_params,
     quantize_weight,
     quantized_bytes,
@@ -128,3 +129,30 @@ def test_engine_rejects_unknown_quantize_mode():
 
     with pytest.raises(ValueError, match="unknown quantize mode"):
         make_engine(EngineConfig(backend="jax", model="tiny", quantize="fp4"))
+
+
+def test_qeinsum_matches_dequantize_then_einsum():
+    """The round-5 scale-folding algebra: for every quantized weight
+    family, ``qeinsum(spec, x, leaf)`` must match the r4 formulation
+    ``einsum(spec, x, deq(leaf))`` to bf16 rounding (scales are
+    per-output-channel, so they commute out of the contraction; the
+    qeinsum path has strictly one FEWER rounding step, so agreement is
+    bounded by the deq path's own bf16 weight rounding)."""
+    rng = np.random.default_rng(3)
+    dt = jnp.bfloat16
+    cases = [
+        # (spec, x shape, w shape, contract axes)  — mirrors _contract_axes
+        ("bsd,df->bsf", (2, 3, 16), (16, 24), (0,)),        # dense FFN
+        ("bsd,dhk->bshk", (2, 3, 16), (16, 4, 8), (0,)),    # wq/wk/wv
+        ("bshk,hkd->bsd", (2, 3, 4, 8), (4, 8, 16), (0, 1)),  # wo
+        ("ecd,edf->ecf", (3, 5, 16), (3, 16, 24), (1,)),    # MoE expert FFN
+        ("bsd,dv->bsv", (2, 3, 16), (16, 32), (0,)),        # lm_head
+    ]
+    for spec, xs, ws, axes in cases:
+        x = jnp.asarray(rng.standard_normal(xs), dt)
+        w = jnp.asarray(rng.standard_normal(ws) * 0.3, jnp.float32)
+        leaf = quantize_weight(w, axes)
+        want = jnp.einsum(spec, x, deq(leaf, dt)).astype(jnp.float32)
+        got = qeinsum(spec, x, leaf, dt).astype(jnp.float32)
+        scale = max(float(jnp.max(jnp.abs(want))), 1e-6)
+        assert float(jnp.max(jnp.abs(got - want))) / scale < 0.02, spec
