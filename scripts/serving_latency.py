@@ -1,10 +1,13 @@
 """Serving-config latency percentiles on the real chip (PERF round 5):
 bench-1b int8 W+KV at decode_block=16 — the TTFT / per-block-gap numbers a
-streaming client sees, from the scheduler's always-on samples."""
-import json, sys, time
+streaming client sees, from the scheduler's always-on samples.
+LMRS_SERVE_MODEL overrides the preset (e.g. bench-8b)."""
+import json, os, sys, time
 sys.path.insert(0, "/root/repo")
 import numpy as np
 from lmrs_tpu.config import EngineConfig, model_preset
+
+MODEL = os.environ.get("LMRS_SERVE_MODEL", "bench-1b")
 from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.jax_engine import JaxEngine
 
@@ -13,7 +16,7 @@ eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
                              page_size=512, num_pages=1, decode_block=16,
                              prefill_chunk=4096, quantize="int8",
                              kv_quantize="int8", retry_delay=0.0),
-                model_preset("bench-1b"))
+                model_preset(MODEL))
 rng = np.random.default_rng(0)
 def mk(i, words):
     body = " ".join(f"w{rng.integers(0, 999)}" for _ in range(words))
@@ -29,7 +32,8 @@ out = eng.generate_batch([mk(100 + i, 300) for i in range(48)])
 wall = time.time() - t0
 rep = sched.metrics_report()
 print(json.dumps({
-    "config": "bench-1b int8 W+KV, decode_block=16, 24 slots, 48 reqs (~1.4k-token prompts)",
+    "config": MODEL
+              + " int8 W+KV, decode_block=16, 24 slots, 48 reqs (~1.4k-token prompts)",
     "wall_s": round(wall, 2),
     "ttft_ms": rep["ttft_ms"],
     "decode_block_gap_ms": rep["decode_block_gap_ms"],
