@@ -30,4 +30,14 @@ echo "=== 6. 8B north-star bench (BASELINE model shape, int8 W+KV, one chip) ===
 LMRS_BENCH_MODEL=bench-8b LMRS_BENCH_DEADLINE_S=3600 \
   timeout 3900 python bench.py 2>&1 | tee "$OUT/bench8b.log"
 
+echo "=== 7. serving-config latency percentiles (1B + 8B) ==="
+# stdout (the one JSON line) to .json, log noise to .log — a merged tee
+# would prepend JAX warnings and break downstream json.load
+timeout 1800 python scripts/serving_latency.py \
+  > "$OUT/serving_latency.json" 2> "$OUT/serving_latency.log"
+cat "$OUT/serving_latency.json"
+LMRS_SERVE_MODEL=bench-8b timeout 1800 python scripts/serving_latency.py \
+  > "$OUT/serving_latency_8b.json" 2> "$OUT/serving_latency_8b.log"
+cat "$OUT/serving_latency_8b.json"
+
 echo "battery complete -> $OUT"
