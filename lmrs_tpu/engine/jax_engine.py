@@ -46,6 +46,21 @@ from lmrs_tpu.ops.sampling import sample_logits
 logger = logging.getLogger("lmrs.jax_engine")
 
 
+def _bf16_tree_gb(cfg: ModelConfig) -> float:
+    """Config-level estimate of the full-precision param tree's size —
+    the device-init feasibility test for quantized random weights.
+    ``matmul_params`` counts only ACTIVATED experts (its per-token-work
+    purpose); init materializes ALL of them, so the resident-MoE
+    remainder is added back."""
+    from lmrs_tpu.utils.perf_model import matmul_params
+
+    n = matmul_params(cfg) + cfg.vocab_size * cfg.dim
+    if cfg.n_experts:
+        n += (cfg.n_layers * 3 * cfg.dim * cfg.hidden_dim
+              * (cfg.n_experts - cfg.n_experts_per_token))
+    return n * 2 / 1e9
+
+
 def _bucket(n: int, lo: int = 64) -> int:
     b = lo
     while b < n:
@@ -104,13 +119,21 @@ class JaxEngine:
                     "no checkpoint for %s: using random-init weights "
                     "(throughput-correct, content-free)", model_cfg.name,
                 )
-                if engine_cfg.quantize:
+                big = _bf16_tree_gb(model_cfg) > 6.0
+                if engine_cfg.quantize and big:
                     # quantized random init builds the int8 tree directly
                     # on the HOST (numpy): the full-precision tree of an
                     # 8B-shape model (16 GB bf16) cannot coexist with
                     # anything on a 16 GB chip, and under the axon tunnel
                     # no jax CPU backend exists to stage it on — only the
-                    # ~8.6 GB quantized tree ever ships to the device
+                    # ~8.6 GB quantized tree ever ships to the device.
+                    # ONLY for models too big to init in bf16 (the r5
+                    # criterion): the host RNG draws DIFFERENT weights
+                    # than init_params, which silently changed the 1B
+                    # bench's generated-token workload (reduce 4.4→5.9 s,
+                    # bisected to this switch) — small models keep the
+                    # device init so random-weight workloads stay
+                    # comparable across rounds
                     from lmrs_tpu.ops.quant import random_quantized_init
 
                     params = random_quantized_init(model_cfg,

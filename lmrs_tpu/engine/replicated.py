@@ -29,6 +29,7 @@ import jax
 
 from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
 from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
+from lmrs_tpu.engine.jax_engine import _bf16_tree_gb
 
 logger = logging.getLogger("lmrs.replicated")
 
@@ -65,12 +66,14 @@ class ReplicatedEngine:
             from lmrs_tpu.models.loader import load_checkpoint
 
             shared = load_checkpoint(engine_cfg.checkpoint_path, model_cfg)
-        elif engine_cfg.quantize:
+        elif engine_cfg.quantize and _bf16_tree_gb(model_cfg) > 6.0:
             # quantized random init builds the int8 tree host-side (numpy)
             # without ever materializing the full-precision tree — at 8B
             # shape that tree would OOM the default device, and under the
-            # axon tunnel there is no jax CPU backend to stage it on (the
-            # same path JaxEngine takes for quantize + random init)
+            # axon tunnel there is no jax CPU backend to stage it on.
+            # SAME size gate as JaxEngine: small quantized models keep the
+            # device init so the random-weight workload matches the
+            # single-engine path exactly (replica-vs-single comparability)
             from lmrs_tpu.ops.quant import random_quantized_init
 
             logger.warning("no checkpoint for %s: replicas share random-init "
@@ -82,6 +85,10 @@ class ReplicatedEngine:
             logger.warning("no checkpoint for %s: replicas share random-init "
                            "weights", model_cfg.name)
             shared = init_params(model_cfg, jax.random.PRNGKey(engine_cfg.seed))
+            if engine_cfg.quantize:
+                from lmrs_tpu.ops.quant import quantize_params
+
+                shared = quantize_params(shared)
         if engine_cfg.quantize and engine_cfg.checkpoint_path:
             from lmrs_tpu.ops.quant import quantize_params
 
