@@ -350,6 +350,23 @@ def _scheduler_window(sched, before: dict) -> dict:
         },
         "ttft_ms": report["ttft_ms"],
         "decode_block_gap_ms": report["decode_block_gap_ms"],
+        # shared-prefix KV cache over the timed reps: hit rate across
+        # admissions and the prompt tokens whose prefill was skipped
+        # entirely (the map preamble re-use win; engine/prefix_cache.py)
+        "prefix_cache": _prefix_window(m, before),
+    }
+
+
+def _prefix_window(m: dict, before: dict) -> dict:
+    queries = m["prefix_queries"] - before["prefix_queries"]
+    hits = m["prefix_hits"] - before["prefix_hits"]
+    saved = m["prefix_tokens_reused"] - before["prefix_tokens_reused"]
+    return {
+        "hit_rate": round(hits / queries, 3) if queries else 0.0,
+        "hits": hits,
+        "queries": queries,
+        "tokens_reused": saved,
+        "prefill_tokens_saved": saved,
     }
 
 

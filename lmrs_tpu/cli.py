@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculate-k", type=int, default=None,
                    help="prompt-lookup speculative decoding draft length "
                         "(0 = off; output distribution is unchanged)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable shared-prefix KV reuse (the map/reduce "
+                        "preamble normally prefills once and is shared "
+                        "read-only across requests; greedy output is "
+                        "identical either way)")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
@@ -110,6 +115,8 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
         engine = dataclasses.replace(engine, kv_quantize=args.kv_quantize)
     if args.speculate_k is not None:
         engine = dataclasses.replace(engine, speculate_k=args.speculate_k)
+    if args.no_prefix_cache:
+        engine = dataclasses.replace(engine, prefix_cache=False)
     if args.tokenizer and args.tokenizer != "approx":
         # ONE token authority (SURVEY §7.4 item 4): an explicit --tokenizer
         # names the serving tokenizer too, not just the chunker's counter

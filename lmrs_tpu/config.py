@@ -170,6 +170,20 @@ class EngineConfig:
     # fixed at prefill.  Gates packed + ring prefill off (per-slot scales
     # can't cover a packed row's many prompts / sp-sharded writes).
     kv_quantize: str | None = None  # None | "int8"
+    # Shared-prefix KV cache (engine/prefix_cache.py): completed prompts
+    # donate their full-page KV prefix to a radix tree; a new request whose
+    # prompt shares that prefix clones the pages (ref-counted, read-only)
+    # and starts prefill at the first uncached token.  Default ON — the
+    # map/reduce stages repeat the same preamble per chunk; LMRS_PREFIX_CACHE=0
+    # or prefix_cache=False is the kill switch.  Auto-disabled with
+    # kv_quantize (per-slot scales cannot cover donor-quantized pages) and
+    # under sp>1 meshes (cache hits enter the windowed-continuation prefill,
+    # which does not ride the ring).
+    prefix_cache: bool = True
+    # cap on pages the prefix cache retains (0 = no explicit cap: retained
+    # pages stay bounded by the pool, drained on demand by the OutOfPages
+    # back-pressure eviction)
+    prefix_cache_max_pages: int = 0
     # engine-side tokenizer spec ("" = model default: byte for random-init
     # vocabs, the checkpoint's tokenizer for real ones).  Accepts the same
     # forms as data.tokenizer.get_tokenizer: "byte", a *.model SentencePiece

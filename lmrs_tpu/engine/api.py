@@ -30,6 +30,16 @@ class GenerationRequest:
     top_k: int = 0
     stop: tuple[str, ...] = ()
     seed: int | None = None
+    # Prefix-cache hint (engine/prefix_cache.py): how many LEADING CHARACTERS
+    # of ``prompt`` are expected to be shared with other requests (the map /
+    # reduce preamble before per-chunk content).  None = no hint, cache the
+    # whole full-page prompt prefix; 0 = the prompt body shares nothing (a
+    # shared system prompt, encoded ahead of the prompt, is still cached);
+    # negative = never cache this request's prefix.  Approximate by design —
+    # the cap is rounded up to a KV page at token level, so an
+    # off-by-a-few-chars hint costs nothing.  Engines without a prefix cache
+    # ignore it.
+    cache_prefix: int | None = None
 
 
 @dataclass

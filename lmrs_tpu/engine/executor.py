@@ -29,7 +29,7 @@ from typing import Sequence
 from lmrs_tpu.config import EngineConfig
 from lmrs_tpu.data.chunker import Chunk
 from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
-from lmrs_tpu.prompts import safe_format
+from lmrs_tpu.prompts import safe_format, shared_prefix_chars
 
 logger = logging.getLogger("lmrs.executor")
 
@@ -133,6 +133,10 @@ class MapExecutor:
             max_new_tokens=self.config.max_tokens,
             temperature=self.config.temperature,
             seed=self.config.seed,
+            # prefix-cache hint: everything before the per-chunk transcript
+            # substitution is the map preamble every chunk shares
+            cache_prefix=shared_prefix_chars(
+                prompt_template, "transcript", summary_type=summary_type),
         )
 
     # ----------------------------------------------------- request plumbing

@@ -31,11 +31,19 @@ class OutOfPages(RuntimeError):
 
 
 class PageAllocator:
-    """Free-list page allocator (python reference implementation).
+    """Ref-counted free-list page allocator (python reference implementation).
 
     Page 0 is RESERVED as the null page and never handed out: inactive batch
     rows carry all-zero page tables, and their masked-out dummy writes must
     land somewhere no live sequence owns (the vLLM null-block trick).
+
+    Pages carry a reference count so the prefix cache can share one
+    physical page read-only across live sequences (engine/prefix_cache.py):
+    ``alloc`` hands out pages at refcount 1, ``incref`` adds a holder, and
+    ``free`` is a decref — the page returns to the free list only when the
+    last holder releases it.  Freeing a page that is already free raises
+    ``ValueError`` instead of silently corrupting the pool (a double-freed
+    page on the free list would be handed to two sequences at once).
     """
 
     RESERVED = 1  # page 0
@@ -45,6 +53,7 @@ class PageAllocator:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, self.RESERVED - 1, -1))
+        self._refs = [0] * num_pages  # refcount per page (0 == on free list)
 
     @property
     def free_count(self) -> int:
@@ -54,13 +63,46 @@ class PageAllocator:
         if n > len(self._free):
             raise OutOfPages(f"need {n} pages, {len(self._free)} free")
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
-    def free(self, pages: list[int]) -> None:
+    def _check(self, pages: list[int], op: str) -> None:
+        """Validate a free/incref batch BEFORE any mutation (the native
+        allocator's contract): range-check every id, and require each
+        page's refcount to cover its multiplicity in the call — so a
+        rejected call leaves the pool untouched."""
+        need: dict[int, int] = {}
         for p in pages:
             if not self.RESERVED <= p < self.num_pages:
                 raise ValueError(f"bad page id {p}")
-            self._free.append(p)
+            need[p] = need.get(p, 0) + 1
+        for p, n in need.items():
+            if self._refs[p] < n:
+                raise ValueError(
+                    f"{op} of page {p} with refcount {self._refs[p]} "
+                    f"(x{n} in call): double-free / unowned page")
+
+    def incref(self, pages: list[int]) -> None:
+        """Add one reference per listed page (prefix-cache sharing).  Only
+        live (refcount > 0) pages may gain holders."""
+        self._check(pages, "incref")
+        for p in pages:
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        if not 0 <= page < self.num_pages:
+            raise ValueError(f"bad page id {page}")
+        return self._refs[page]
+
+    def free(self, pages: list[int]) -> None:
+        """Release one reference per listed page; pages reaching refcount 0
+        return to the free list.  Raises on double-free (see class doc)."""
+        self._check(pages, "free")
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
 
 
 def make_page_allocator(num_pages: int):
@@ -141,6 +183,12 @@ class PagedKVCache:
             self.k = jnp.zeros(shape, dt)
             self.v = jnp.zeros(shape, dt)
         self.allocator = allocator or make_page_allocator(num_pages)
+        # Page-pressure reclaim hook (engine/prefix_cache.py): when set, an
+        # allocation that would exceed the free list first asks the hook to
+        # release reclaimable pages (LRU cache eviction).  Keeps the
+        # admission/growth deadlock argument intact: cached pages are never
+        # pinned — under pressure they drain back into the pool on demand.
+        self.reclaim_cb = None
         logger.info(
             "paged KV cache: %d pages x %d tokens (%.1f MiB)",
             num_pages, page_size,
@@ -161,11 +209,18 @@ class PagedKVCache:
     def can_admit(self, n_tokens: int) -> bool:
         return self.pages_needed(n_tokens) <= self.allocator.free_count
 
+    def alloc_pages(self, n: int) -> list[int]:
+        """``allocator.alloc`` with the reclaim hook applied: under pressure,
+        ask the prefix cache to evict before declaring OutOfPages."""
+        if n > self.allocator.free_count and self.reclaim_cb is not None:
+            self.reclaim_cb(n - self.allocator.free_count)
+        return self.allocator.alloc(n)
+
     def open_sequence(self, n_tokens: int) -> SequencePages:
         """Allocate pages for a sequence expected to reach n_tokens (capped
         at max_pages_per_slot — callers clamp write positions accordingly)."""
         n = min(self.pages_needed(n_tokens), self.max_pages_per_slot)
-        return SequencePages(pages=self.allocator.alloc(n))
+        return SequencePages(pages=self.alloc_pages(n))
 
     def grow(self, seq: SequencePages, n_tokens: int) -> None:
         """Ensure capacity for n_tokens, allocating more pages as needed."""
@@ -173,7 +228,7 @@ class PagedKVCache:
         if need > 0:
             if len(seq.pages) + need > self.max_pages_per_slot:
                 raise OutOfPages("sequence exceeds max_pages_per_slot")
-            seq.pages.extend(self.allocator.alloc(need))
+            seq.pages.extend(self.alloc_pages(need))
 
     def close_sequence(self, seq: SequencePages) -> None:
         self.allocator.free(seq.pages)

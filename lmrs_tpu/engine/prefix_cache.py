@@ -1,0 +1,291 @@
+"""Shared-prefix KV cache: radix-tree page reuse over the paged pool.
+
+Every map request carries the same system-prompt + map-instruction preamble
+(the reference fans identical prompt headers out per chunk; the reduce tree
+repeats the reduce prompt per node), yet without this subsystem the
+scheduler re-prefills that preamble from scratch for every one of the
+hundreds of chunks in a long transcript.  This module lets a new request
+*start* its prefill at the first uncached token: O(chunks x prefix_len)
+prefill work becomes O(prefix_len) (SGLang RadixAttention / vLLM automatic
+prefix caching, adapted to this engine's page-granular pool).
+
+Design
+------
+* Host-side radix tree keyed on TOKEN IDS at page granularity: an edge
+  labels one or more full pages' worth of tokens and owns the matching KV
+  page ids in the existing pool (kv_cache.PagedKVCache).  Only whole pages
+  are ever cached or matched — a page is the pool's unit of sharing, and
+  partial-page reuse would need copy-on-write the decode path doesn't have.
+* Pages are REF-COUNTED in the allocator (PageAllocator.incref/free): the
+  cache holds one reference on every page it retains, and every live
+  sequence cloning a cached prefix holds its own.  A cached page is thus
+  shared read-only — sequences write only at positions past their matched
+  prefix, which the page-granularity cap below guarantees live in private
+  pages.
+* ``match`` caps the usable prefix at the largest page multiple <= len-1:
+  at least the final prompt token is always recomputed, because sampling
+  the first output token needs that token's logits (which pages do not
+  store), and its KV write must never land in a shared page.  A full-prefix
+  hit therefore degenerates to a one-chunk tail prefill straight into
+  decode — the tail is at most one page + the unpaged remainder.
+* Insertion happens when a sequence's PREFILL completes (scheduler calls
+  ``insert`` with the prompt ids + page table): all prompt pages are fully
+  written by the already-issued dispatch chain, and adopting them early
+  lets later admissions in the same run hit.  The tree adopts only pages
+  it does not already cover (first writer wins; content-identical
+  duplicates from concurrently-admitted sequences are simply freed when
+  their sequence closes).
+* Eviction is LRU over REFCOUNT-ZERO nodes — leaves no live sequence
+  shares (allocator refcount 1 == the cache's own reference) — triggered
+  by an explicit ``max_pages`` budget and by pool back-pressure
+  (PagedKVCache.reclaim_cb -> ``evict``), so caching never deadlocks
+  admission: under pressure cached pages drain back to the free list
+  before the scheduler resorts to preemption or stalls.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("lmrs.prefix_cache")
+
+
+class _Node:
+    """One radix-tree edge: ``tokens`` (length a multiple of page_size;
+    empty at the root) and the KV pages holding them, one per page_size
+    tokens.  ``tick`` is the LRU stamp, bumped on every match/insert walk
+    through the node."""
+
+    __slots__ = ("tokens", "pages", "children", "parent", "tick")
+
+    def __init__(self, tokens: tuple, pages: list[int], parent: "_Node | None"):
+        self.tokens = tokens
+        self.pages = pages
+        self.children: dict[tuple, _Node] = {}  # first-page token block -> child
+        self.parent = parent
+        self.tick = 0
+
+
+class PrefixCache:
+    """Radix tree mapping token-id prefixes to ref-counted KV pages.
+
+    The cache owns one allocator reference per retained page; ``match``
+    hands the caller pages with an EXTRA reference (the caller releases
+    them through its normal ``close_sequence`` free).  All methods are
+    host-side and O(prefix length); the scheduler calls them between
+    dispatches.
+    """
+
+    def __init__(self, allocator, page_size: int, max_pages: int = 0):
+        self.allocator = allocator
+        self.page_size = page_size
+        # 0 = no explicit budget: retained pages are bounded by the pool
+        # itself (back-pressure eviction via evict())
+        self.max_pages = max_pages
+        self.root = _Node((), [], None)
+        self.cached_pages = 0
+        self._tick = 0
+        # structural counters only — hit/query/tokens-reused accounting
+        # lives in the SCHEDULER (one source of truth, counted once per
+        # admission; a raw match() here may be rolled back by admission
+        # back-pressure and must not inflate a hit rate)
+        self.evicted_pages = 0
+        self.inserted_pages = 0
+
+    # ------------------------------------------------------------- matching
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.tick = self._tick
+
+    def match(self, ids: list[int]) -> tuple[list[int], int]:
+        """Longest cached prefix of ``ids`` at page granularity.
+
+        Returns ``(pages, n_tokens)`` with one extra allocator reference
+        taken on every returned page (the caller owns it; releasing goes
+        through the caller's normal page free).  ``n_tokens`` is capped at
+        the largest page multiple <= len(ids) - 1 so the final prompt token
+        is always recomputed (see module doc).
+        """
+        ps = self.page_size
+        usable = ((len(ids) - 1) // ps) * ps
+        pages: list[int] = []
+        matched = 0
+        node = self.root
+        self._touch(node)
+        while matched < usable:
+            child = node.children.get(tuple(ids[matched: matched + ps]))
+            if child is None:
+                break
+            take = 0
+            for off in range(0, len(child.tokens), ps):
+                if (matched + off + ps > usable
+                        or tuple(ids[matched + off: matched + off + ps])
+                        != child.tokens[off: off + ps]):
+                    break
+                take += ps
+            if take == 0:
+                break
+            if take < len(child.tokens):
+                # partial edge use: split at the page boundary so the used
+                # prefix becomes its own node (per-node LRU/eviction stays
+                # whole-node simple) and stop — the remainder diverges.
+                child = self._split(child, take)
+            pages += child.pages
+            matched += take
+            node = child
+            self._touch(node)
+        if matched:
+            self.allocator.incref(pages)
+        return pages, matched
+
+    def _split(self, node: _Node, k: int) -> _Node:
+        """Split ``node``'s edge after ``k`` tokens (a page multiple):
+        the prefix becomes a new parent node; ``node`` keeps the suffix.
+        Returns the new prefix node."""
+        ps = self.page_size
+        upper = _Node(node.tokens[:k], node.pages[: k // ps], node.parent)
+        upper.tick = node.tick
+        parent = node.parent
+        parent.children[node.tokens[:ps]] = upper
+        node.tokens = node.tokens[k:]
+        node.pages = node.pages[k // ps:]
+        node.parent = upper
+        upper.children[node.tokens[:ps]] = node
+        return upper
+
+    # ------------------------------------------------------------ insertion
+
+    def insert(self, ids: list[int], pages: list[int],
+               max_tokens: int | None = None) -> int:
+        """Adopt the full-page prefix of ``ids`` (KV in ``pages``, the
+        sequence's page table) into the tree; returns the number of pages
+        adopted.  Pages the tree already covers are skipped (the caller's
+        duplicates are released by its own close).  ``max_tokens``, when
+        given, caps adoption to ceil-to-page of that many leading tokens —
+        the request-level ``cache_prefix`` hint, which keeps per-request
+        unique suffixes (chunk bodies) from bloating the tree.
+
+        Adopted pages gain one allocator reference (the cache's); the
+        caller keeps its own reference and releases it as usual.
+        """
+        ps = self.page_size
+        limit = (len(ids) // ps) * ps
+        if max_tokens is not None:
+            limit = min(limit, -(-max_tokens // ps) * ps)
+        if limit <= 0:
+            return 0
+        node = self.root
+        self._touch(node)
+        matched = 0
+        while matched < limit:
+            child = node.children.get(tuple(ids[matched: matched + ps]))
+            if child is None:
+                break
+            take = 0
+            for off in range(0, len(child.tokens), ps):
+                if (matched + off + ps > limit
+                        or tuple(ids[matched + off: matched + off + ps])
+                        != child.tokens[off: off + ps]):
+                    break
+                take += ps
+            if take == 0:
+                break
+            if take < len(child.tokens):
+                child = self._split(child, take)
+            matched += take
+            node = child
+            self._touch(node)
+            if take < ps:  # pragma: no cover - defensive
+                break
+        adopt = (limit - matched) // ps
+        if adopt <= 0:
+            return 0
+        if self.max_pages:
+            over = self.cached_pages + adopt - self.max_pages
+            if over > 0:
+                # pin the walk path: evicting the node we are about to
+                # attach under would orphan the new leaf (and leak its
+                # page accounting)
+                pin = set()
+                cur = node
+                while cur is not None:
+                    pin.add(id(cur))
+                    cur = cur.parent
+                self._evict_lru(over, keep=pin)
+            # still over budget (live sequences pin nodes): trim adoption
+            adopt = min(adopt, max(self.max_pages - self.cached_pages, 0))
+            if adopt <= 0:
+                return 0
+        new_tokens = tuple(ids[matched: matched + adopt * ps])
+        new_pages = list(pages[matched // ps: matched // ps + adopt])
+        self.allocator.incref(new_pages)
+        leaf = _Node(new_tokens, new_pages, node)
+        node.children[new_tokens[:ps]] = leaf
+        self._touch(leaf)
+        self.cached_pages += adopt
+        self.inserted_pages += adopt
+        return adopt
+
+    # ------------------------------------------------------------- eviction
+
+    def _evictable(self, node: _Node) -> bool:
+        """A leaf no live sequence shares: every page's only reference is
+        the cache's own."""
+        return (not node.children
+                and all(self.allocator.refcount(p) == 1 for p in node.pages))
+
+    def evict(self, n_pages: int) -> int:
+        """Free at least ``n_pages`` pages of refcount-zero cache (LRU node
+        order), or as many as exist.  Returns pages freed.  Wired into the
+        pool's OutOfPages back-pressure path (PagedKVCache.reclaim_cb), so
+        a full cache can never starve admission or decode growth."""
+        return self._evict_lru(n_pages)
+
+    def _evict_lru(self, n_pages: int, keep: set | None = None) -> int:
+        freed = 0
+        while freed < n_pages:
+            victim = None
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if (node is self.root or (keep and id(node) in keep)
+                        or not self._evictable(node)):
+                    continue
+                if victim is None or node.tick < victim.tick:
+                    victim = node
+            if victim is None:
+                break
+            freed += self._drop(victim)
+        if freed:
+            logger.debug("evicted %d cached pages (%d retained)",
+                         freed, self.cached_pages)
+        return freed
+
+    def _drop(self, node: _Node) -> int:
+        """Remove a leaf: release the cache's page references (pages return
+        to the free list — nothing else holds them) and unlink."""
+        self.allocator.free(node.pages)
+        n = len(node.pages)
+        del node.parent.children[node.tokens[: self.page_size]]
+        self.cached_pages -= n
+        self.evicted_pages += n
+        node.parent = None
+        return n
+
+    def clear(self) -> int:
+        """Drop every node no live sequence shares (kill switch / tests)."""
+        return self._evict_lru(self.cached_pages or 0) if self.cached_pages else 0
+
+    # -------------------------------------------------------------- reports
+
+    def stats(self) -> dict:
+        """Structural counters (page footprint) for metrics_report()/bench
+        detail.  Hit/query/tokens-reused accounting is the scheduler's
+        (see __init__)."""
+        return {
+            "cached_pages": self.cached_pages,
+            "inserted_pages": self.inserted_pages,
+            "evicted_pages": self.evicted_pages,
+        }

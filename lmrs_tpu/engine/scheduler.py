@@ -39,6 +39,7 @@ from lmrs_tpu.config import EngineConfig, ModelConfig
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  apply_stop_sequences)
 from lmrs_tpu.engine.kv_cache import OutOfPages, PagedKVCache, SequencePages
+from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.models.transformer import forward_paged
 from lmrs_tpu.ops.sampling import sample_logits
 
@@ -219,6 +220,31 @@ class ContinuousScheduler:
             logger.info("sp=%d mesh: chunked prefill disabled in favor of "
                         "one-dispatch ring prefill", self._sp)
             self.prefill_chunk = self.max_len
+        # Shared-prefix KV cache (engine/prefix_cache.py): completed prompt
+        # prefixes stay in the pool as ref-counted pages keyed by a radix
+        # tree; admission clones the matched page-table prefix and enters
+        # the chunked-prefill path at the match boundary.  LMRS_PREFIX_CACHE=0
+        # is the A/B kill switch (same convention as LMRS_PACK_PREFILL).
+        pc_on = (engine_cfg.prefix_cache
+                 and os.environ.get("LMRS_PREFIX_CACHE", "1") != "0")
+        if pc_on and self._kv_quant:
+            # int8 KV scales are per-SLOT, frozen at prefill: a hit slot
+            # would dequantize donor-quantized pages with its own scales
+            logger.info("prefix cache disabled: incompatible with int8 KV "
+                        "(per-slot scales cannot cover donor pages)")
+            pc_on = False
+        if pc_on and self._use_ring:
+            # cache hits enter the windowed-continuation prefill, which
+            # cannot ride the ring (window K/V is pool-side, not
+            # sequence-sharded)
+            logger.info("prefix cache disabled under sp>1 mesh")
+            pc_on = False
+        self._prefix_cache: PrefixCache | None = None
+        if pc_on:
+            self._prefix_cache = PrefixCache(
+                self.cache.allocator, ps,
+                max_pages=engine_cfg.prefix_cache_max_pages)
+            self.cache.reclaim_cb = self._prefix_cache.evict
         # LMRS_TRACE_DISPATCH=1: record a host timestamp per decode
         # dispatch (decode-latency benchmarking — the gap between decode
         # dispatches is the per-block token latency active slots see)
@@ -267,6 +293,13 @@ class ContinuousScheduler:
             # — bookkeeping the device sits idle for (r5: ~17% of 8B map
             # wall; the attribution number for any overlap lever)
             "blocked_seconds": 0.0,
+            # prefix-cache counters (present even when the cache is off, so
+            # bench windowing can always delta them): admissions that
+            # queried the radix tree, admissions that matched, and prompt
+            # tokens whose prefill was skipped via cached pages
+            "prefix_queries": 0,
+            "prefix_hits": 0,
+            "prefix_tokens_reused": 0,
         }
 
     def _timed_get(self, x):
@@ -308,6 +341,26 @@ class ContinuousScheduler:
             "decode_block_gap_ms": _latency_pct(self._block_gaps),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
+            **({"prefix_cache": self._prefix_cache_report()}
+               if self._prefix_cache is not None else {}),
+        }
+
+    def _prefix_cache_report(self) -> dict:
+        """Prefix-cache block of metrics_report(): hit rate over admissions,
+        tokens reused from cached pages (== prefill tokens saved — exactly
+        the prompt tokens the scheduler never dispatched), and the cache's
+        current/ cumulative page footprint."""
+        m = self.metrics
+        s = self._prefix_cache.stats()
+        return {
+            "hit_rate": round(m["prefix_hits"] / m["prefix_queries"], 3)
+            if m["prefix_queries"] else 0.0,
+            "hits": m["prefix_hits"],
+            "queries": m["prefix_queries"],
+            "tokens_reused": m["prefix_tokens_reused"],
+            "prefill_tokens_saved": m["prefix_tokens_reused"],
+            "cached_pages": s["cached_pages"],
+            "evicted_pages": s["evicted_pages"],
         }
 
     def reset_latency_stats(self) -> None:
@@ -441,6 +494,15 @@ class ContinuousScheduler:
                 if slots[b] is not None or not queue:
                     continue
                 req, ids, max_new, n_prompt, prior, t0 = queue[0]
+                # Prefix-cache probe: clone the longest cached page prefix
+                # (ref-counted, read-only) and start prefill at the match
+                # boundary.  match() always leaves >= 1 prompt token to
+                # prefill (the sampled-first-token chunk), so a "full" hit
+                # is a one-chunk tail prefill straight into decode.
+                cached_pages: list[int] = []
+                cached_tokens = 0
+                if self._prefix_cache is not None:
+                    cached_pages, cached_tokens = self._prefix_cache.match(ids)
                 # Admission reserves PROMPT pages only; decode capacity is
                 # grown per block (_ensure_decode_capacity), with youngest-
                 # slot preemption under pressure.  No fail-fast branch here:
@@ -450,13 +512,34 @@ class ContinuousScheduler:
                 # complete alone in the pool — oversized prompts were
                 # truncated at submit and oversized decodes trim at max_len
                 # (ADVICE r2: the former "can NEVER complete" branch was
-                # unreachable under these invariants).
+                # unreachable under these invariants).  Cached pages only
+                # tighten this: match covers at most len(ids)-1 tokens, so
+                # need >= 1 fresh page always remains to allocate.
                 need = min(self.cache.pages_needed(len(ids)),
-                           self.cache.max_pages_per_slot)
+                           self.cache.max_pages_per_slot) - len(cached_pages)
                 if need > self.cache.allocator.free_count:
-                    break  # back-pressure: wait for pages to free up
+                    if self._prefix_cache is not None:
+                        # LRU-evict refcount-zero cache before declaring
+                        # back-pressure: retained pages must never starve
+                        # admission (the matched pages themselves are
+                        # pinned by the extra match reference)
+                        self._prefix_cache.evict(
+                            need - self.cache.allocator.free_count)
+                    if need > self.cache.allocator.free_count:
+                        if cached_pages:  # release the match references
+                            self.cache.allocator.free(cached_pages)
+                        break  # back-pressure: wait for pages to free up
                 queue.popleft()
-                seq = self.cache.open_sequence(len(ids))
+                seq = SequencePages(
+                    pages=cached_pages + self.cache.alloc_pages(need))
+                # counted at ADMISSION, not per probe: a back-pressured
+                # request re-probes every scheduler tick until pages free
+                # up, and retry ticks must not dilute the hit rate
+                if self._prefix_cache is not None:
+                    self.metrics["prefix_queries"] += 1
+                    if cached_tokens:
+                        self.metrics["prefix_hits"] += 1
+                        self.metrics["prefix_tokens_reused"] += cached_tokens
                 # a continuation keeps its ORIGINAL t_start: device_seconds
                 # then spans the whole request, and the slot stays "old" for
                 # youngest-victim selection (a refreshed t_start would make
@@ -465,6 +548,10 @@ class ContinuousScheduler:
                                 seq=seq,
                                 t_start=t0 if t0 is not None else time.time(),
                                 n_prompt=n_prompt, prior=list(prior))
+                # a cache hit enters the existing chunked-prefill machinery
+                # at the match boundary: the first chunk dispatches as a
+                # windowed continuation attending the cloned pages
+                st.prefill_pos = cached_tokens
                 slots[b] = st  # phase="prefill"; device work happens in the loop
                 # a decode dispatch can run while this slot is still
                 # mid-prefill (chunked prefill): its row must carry length
@@ -519,6 +606,11 @@ class ContinuousScheduler:
                         st.kv_len = len(st.prompt_ids)
                         kv_lens[b] = st.kv_len
                         active[b] = True
+                        # donate the prompt's full-page prefix to the prefix
+                        # cache NOW (not at finish): the dispatch writing
+                        # these pages is already issued, and later
+                        # admissions in the same run can hit immediately
+                        self._cache_insert(st)
                         deferred.append((b, p, row))
                 if pending and (self.spec_k or not self.defer_tok0):
                     # speculation seeds a host-built history row per admission —
@@ -781,6 +873,11 @@ class ContinuousScheduler:
 
         cfg_m = self.model_cfg
         spec = chip_spec()
+        # drop retained prefix-cache pages: the decode probe sizes itself to
+        # the FREE pool, and a warm cache would silently shrink the roofline
+        # point (the cache rebuilds on the next real run)
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
         # median trivial dependent fetch = host<->device round trip
         x = jnp.zeros((8,), jnp.float32)
         np.asarray(jax.device_get(x + 1))  # warm the tiny program
@@ -950,8 +1047,39 @@ class ContinuousScheduler:
                 best, best_t = b, st.t_start
         return best
 
+    def _cache_insert(self, st: _SlotState) -> None:
+        """Donate a fully-prefilled slot's prompt-page prefix to the prefix
+        cache.  The ``cache_prefix`` request hint (leading PROMPT chars
+        expected to be shared) caps adoption so per-chunk unique bodies
+        don't bloat the tree.  A hint of 0 means the prompt body shares
+        nothing — the shared system preamble (always encoded FIRST by
+        _encode) is still donated; only when there is no system prompt
+        either is there nothing to cache."""
+        if self._prefix_cache is None:
+            return
+        cap = None
+        hint = st.req.cache_prefix
+        if hint is not None:
+            if hint < 0:
+                return
+            # token-level cap: bos + encoded system preamble + shared prompt
+            # head.  Approximate at the char boundary by design (the cap
+            # rounds up to a page inside insert) — see GenerationRequest.
+            text = ((st.req.system_prompt + "\n\n")
+                    if st.req.system_prompt else "") + st.req.prompt[:hint]
+            if not text:
+                return  # hint 0 and no system prompt: nothing shared
+            cap = 1 + len(self.tokenizer.encode(text))
+        self._prefix_cache.insert(st.prompt_ids, st.seq.pages, max_tokens=cap)
+
     def _preempt(self, b, slots, queue, kv_lens, last_tok, active) -> None:
         st = slots[b]
+        # keep the victim's prompt prefix cached: its continuation (and any
+        # same-preamble neighbor) re-matches instead of re-prefilling; the
+        # pages stay evictable, so this never blocks the reclaim that the
+        # preemption itself is after
+        if st.phase == "decode":
+            self._cache_insert(st)
         self.cache.close_sequence(st.seq)
         # continuation: generated tokens fold into the prefill ids, original
         # prompt length and prior output ride along for accounting/finish.

@@ -34,6 +34,7 @@ from lmrs_tpu.prompts import (
     DEFAULT_FINAL_REDUCE_PROMPT,
     DEFAULT_REDUCE_PROMPT,
     safe_format,
+    shared_prefix_chars,
 )
 
 logger = logging.getLogger("lmrs.reduce")
@@ -123,6 +124,11 @@ class ResultAggregator:
             max_new_tokens=self.executor.config.max_tokens,
             temperature=self.config.temperature,  # reference hardcodes 0.2
             seed=self.executor.config.seed,
+            # prefix-cache hint: the reduce preamble repeats per tree node;
+            # summaries/metadata/count all vary per request, so the shared
+            # prefix ends at whichever placeholder the template puts first
+            cache_prefix=shared_prefix_chars(
+                template, "summaries", "metadata", "num_summaries"),
         )
 
     def _reduce_wave(

@@ -91,7 +91,9 @@ def _try_load() -> ctypes.CDLL | None:
     try:
         lib = ctypes.CDLL(str(_LIB))
         lib.lmrs_abi_version.restype = ctypes.c_int32
-        if lib.lmrs_abi_version() != 1:
+        # v2: ref-counted allocator (incref/refcount entry points; free is a
+        # decref that errors on double-free)
+        if lib.lmrs_abi_version() != 2:
             logger.warning("native ABI mismatch; ignoring %s", _LIB)
             return None
         lib.lmrs_clean_text.restype = ctypes.c_int64
@@ -119,6 +121,11 @@ def _try_load() -> ctypes.CDLL | None:
         lib.lmrs_palloc_free.restype = ctypes.c_int32
         lib.lmrs_palloc_free.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.lmrs_palloc_incref.restype = ctypes.c_int32
+        lib.lmrs_palloc_incref.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.lmrs_palloc_refcount.restype = ctypes.c_int32
+        lib.lmrs_palloc_refcount.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         return lib
     except (OSError, AttributeError) as e:
         # missing file, missing symbol (stale .so from an older source
@@ -232,7 +239,9 @@ class NativePageAllocator:
     """C++ free-list page allocator; drop-in for kv_cache.PageAllocator.
 
     Same contract: page 0 reserved, pages handed out lowest-id-first from a
-    LIFO free list, ``OutOfPages`` (raised by the caller shim) on exhaustion.
+    LIFO free list, ``OutOfPages`` (raised by the caller shim) on exhaustion,
+    per-page refcounts (``incref``/``refcount``; ``free`` decrefs and raises
+    ``ValueError`` on a double-free).
     """
 
     RESERVED = 1
@@ -268,8 +277,26 @@ class NativePageAllocator:
         rc = self._lib.lmrs_palloc_free(
             self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             len(pages))
+        if rc == -3:
+            raise ValueError(f"double-free / unowned page in {pages}")
         if rc != 0:
             raise ValueError(f"bad page id in {pages}")
+
+    def incref(self, pages: list[int]) -> None:
+        arr = np.asarray(pages, np.int32)
+        rc = self._lib.lmrs_palloc_incref(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(pages))
+        if rc == -3:
+            raise ValueError(f"incref of refcount-0 page in {pages}")
+        if rc != 0:
+            raise ValueError(f"bad page id in {pages}")
+
+    def refcount(self, page: int) -> int:
+        rc = int(self._lib.lmrs_palloc_refcount(self._h, page))
+        if rc < 0:
+            raise ValueError(f"bad page id {page}")
+        return rc
 
     def __del__(self):  # noqa: D105
         h = getattr(self, "_h", None)

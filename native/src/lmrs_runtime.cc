@@ -241,17 +241,37 @@ int64_t count_approx_impl(const unsigned char* s, size_t n) {
 // Mirrors engine/kv_cache.PageAllocator: LIFO free list initialized
 // [num_pages-1 .. 1] (so pages are handed out 1, 2, 3, ... and freed pages
 // are reused most-recently-freed-first).  Page 0 is reserved (null page).
+// Pages are ref-counted (prefix-cache sharing): alloc hands out refcount 1,
+// incref adds a holder, free is a decref that returns the page to the free
+// list only at zero — and errors on a page already free (double-free would
+// hand one page to two sequences).
 struct PageAlloc {
   int32_t num_pages;
   std::vector<int32_t> free_list;
+  std::vector<int32_t> refs;  // per-page refcount; 0 == on the free list
   std::mutex mu;
 };
+
+// Validate a free/incref batch before ANY mutation: every id in range and
+// every page's refcount covering its multiplicity in the call.  Returns 0,
+// -2 on a bad id, -3 on a double-free / unowned page.
+int32_t check_pages(const PageAlloc* a, const int32_t* pages, int32_t n) {
+  for (int32_t i = 0; i < n; ++i) {
+    if (pages[i] < 1 || pages[i] >= a->num_pages) return -2;
+  }
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t mult = 0;
+    for (int32_t j = 0; j < n; ++j) mult += (pages[j] == pages[i]);
+    if (a->refs[pages[i]] < mult) return -3;
+  }
+  return 0;
+}
 
 }  // namespace
 
 // =================================================================== C ABI
 
-LMRS_API int32_t lmrs_abi_version(void) { return 1; }
+LMRS_API int32_t lmrs_abi_version(void) { return 2; }
 
 // ---- text ----
 
@@ -314,6 +334,7 @@ LMRS_API void* lmrs_palloc_create(int32_t num_pages) {
   a->num_pages = num_pages;
   a->free_list.reserve(num_pages - 1);
   for (int32_t p = num_pages - 1; p >= 1; --p) a->free_list.push_back(p);
+  a->refs.assign(num_pages, 0);
   return a;
 }
 
@@ -327,8 +348,8 @@ LMRS_API int32_t lmrs_palloc_free_count(void* h) {
   return int32_t(a->free_list.size());
 }
 
-// Pop n pages into out.  Returns 0, or -1 if fewer than n pages are free
-// (OutOfPages back-pressure; nothing is allocated).
+// Pop n pages into out at refcount 1.  Returns 0, or -1 if fewer than n
+// pages are free (OutOfPages back-pressure; nothing is allocated).
 LMRS_API int32_t lmrs_palloc_alloc(void* h, int32_t n, int32_t* out) {
   auto* a = static_cast<PageAlloc*>(h);
   std::lock_guard<std::mutex> lk(a->mu);
@@ -336,18 +357,41 @@ LMRS_API int32_t lmrs_palloc_alloc(void* h, int32_t n, int32_t* out) {
   for (int32_t i = 0; i < n; ++i) {
     out[i] = a->free_list.back();
     a->free_list.pop_back();
+    a->refs[out[i]] = 1;
   }
   return 0;
 }
 
-// Return pages to the pool.  Returns 0, or -2 on an out-of-range page id
-// (ids validated before any mutation).
+// Release one reference per page; pages reaching refcount 0 return to the
+// pool.  Returns 0, -2 on an out-of-range page id, -3 on a double-free /
+// unowned page (ids validated before any mutation).
 LMRS_API int32_t lmrs_palloc_free(void* h, const int32_t* pages, int32_t n) {
   auto* a = static_cast<PageAlloc*>(h);
   std::lock_guard<std::mutex> lk(a->mu);
+  int32_t rc = check_pages(a, pages, n);
+  if (rc != 0) return rc;
   for (int32_t i = 0; i < n; ++i) {
-    if (pages[i] < 1 || pages[i] >= a->num_pages) return -2;
+    if (--a->refs[pages[i]] == 0) a->free_list.push_back(pages[i]);
   }
-  for (int32_t i = 0; i < n; ++i) a->free_list.push_back(pages[i]);
   return 0;
+}
+
+// Add one reference per page (prefix-cache sharing); only live pages may
+// gain holders.  Returns 0, -2 on a bad id, -3 on a refcount-0 page.
+LMRS_API int32_t lmrs_palloc_incref(void* h, const int32_t* pages,
+                                    int32_t n) {
+  auto* a = static_cast<PageAlloc*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  int32_t rc = check_pages(a, pages, n);
+  if (rc != 0) return rc;
+  for (int32_t i = 0; i < n; ++i) ++a->refs[pages[i]];
+  return 0;
+}
+
+// Current refcount of one page (>= 0), or -2 on an out-of-range id.
+LMRS_API int32_t lmrs_palloc_refcount(void* h, int32_t page) {
+  auto* a = static_cast<PageAlloc*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (page < 0 || page >= a->num_pages) return -2;
+  return a->refs[page];
 }

@@ -30,6 +30,13 @@ def tiny_model():
                        dtype="float32")
 
 
+def _expect_free(sched) -> int:
+    """Free pages once every sequence has closed: the usable pool minus
+    pages the prefix cache legitimately retains (each at refcount 1)."""
+    cached = sched._prefix_cache.cached_pages if sched._prefix_cache else 0
+    return sched.cache.num_pages - 1 - cached
+
+
 def test_cancel_mid_decode_frees_slot_and_pages():
     """Cancelling a decoding request must end it at the next block boundary
     (completion well under budget), free its KV pages back to the pool, and
@@ -57,8 +64,9 @@ def test_cancel_mid_decode_frees_slot_and_pages():
     assert res.completion_tokens < 64
     assert res.completion_tokens >= 1  # pre-cancel tokens are real output
     assert sched.metrics["cancelled"] == 1
-    # the slot's pages went back to the pool when the sweep ran
-    assert sched.cache.allocator.free_count == usable
+    # the slot's pages went back to the pool when the sweep ran (minus the
+    # prompt prefix the cache retains)
+    assert sched.cache.allocator.free_count == _expect_free(sched)
     eng.shutdown()
 
 
@@ -268,7 +276,6 @@ def test_fuzzed_cancellation_keeps_pool_consistent(seed):
                      decode_block=rng.choice((2, 4))),
         tiny_model())
     sched = eng._scheduler
-    usable = sched.cache.num_pages - 1
     n = rng.randint(3, 7)
     reqs = [GenerationRequest(prompt=f"fuzz cancel {i} " * rng.randint(1, 6),
                               request_id=i, temperature=0.8,
@@ -296,8 +303,9 @@ def test_fuzzed_cancellation_keeps_pool_consistent(seed):
     # the abort path actually ran (verified: every seed lands >= 1 cancel
     # — without this the test could silently stop testing cancellation)
     assert sched.metrics["cancelled"] >= 1
-    # every page went back to the pool, cancelled or not
-    assert sched.cache.allocator.free_count == usable
+    # every page went back to the pool, cancelled or not (the prefix cache
+    # keeps donated prompt prefixes at refcount 1)
+    assert sched.cache.allocator.free_count == _expect_free(sched)
     eng.shutdown()
 
 
@@ -311,7 +319,6 @@ def test_server_disconnect_cancels_real_scheduler():
                                  max_tokens=192, max_batch_slots=2, seed=0,
                                  decode_block=2), tiny_model())
     sched = eng._scheduler
-    usable = sched.cache.num_pages - 1
     srv = EngineHTTPServer(eng, port=0, batch_window_s=0.01)
     srv.start_background()
     try:
@@ -330,9 +337,9 @@ def test_server_disconnect_cancels_real_scheduler():
         # the run loop ends (no other work) and the pages are back
         deadline = time.time() + 60
         while (time.time() < deadline
-               and sched.cache.allocator.free_count != usable):
+               and sched.cache.allocator.free_count != _expect_free(sched)):
             time.sleep(0.1)
-        assert sched.cache.allocator.free_count == usable
+        assert sched.cache.allocator.free_count == _expect_free(sched)
     finally:
         srv.shutdown()
         eng.shutdown()

@@ -100,6 +100,115 @@ def test_fuzzed_continuous_scheduler_is_deterministic(seed):
     assert runs[0] == runs[1], (scenario, metrics)
 
 
+def _prefix_requests(rng: random.Random, n: int) -> list[GenerationRequest]:
+    """Prefix-sharing adversarial mix: requests draw one of three shared
+    preambles (or none), diverge at random depths, and carry varied
+    budgets — shared / partial / disjoint prefixes all collide in the
+    radix tree at page boundaries."""
+    preambles = [
+        "shared preamble alpha " * rng.randint(1, 4),
+        "shared preamble beta " * rng.randint(1, 4),
+        "",
+    ]
+    reqs = []
+    for i in range(n):
+        pre = rng.choice(preambles)
+        # partial sharing: sometimes truncate the preamble mid-page
+        if pre and rng.random() < 0.4:
+            pre = pre[: rng.randrange(1, len(pre))]
+        body = " ".join(rng.choice(WORDS) for _ in range(rng.choice((2, 10, 40))))
+        hint = len(pre) if (pre and rng.random() < 0.5) else None
+        reqs.append(GenerationRequest(
+            prompt=pre + body, request_id=i, temperature=0.0,
+            max_new_tokens=rng.choice((1, 4, 12)), cache_prefix=hint))
+    return reqs
+
+
+def _check_pool_invariants(sched):
+    """Post-run pool accounting: every page is either free (refcount 0) or
+    retained by the prefix cache (refcount exactly 1 — no live sequences
+    remain), the cache's page count agrees with the allocator, and no page
+    is both free and referenced."""
+    alloc = sched.cache.allocator
+    cache = sched._prefix_cache
+    cached = cache.cached_pages if cache else 0
+    usable = sched.cache.num_pages - 1
+    assert alloc.free_count == usable - cached, (alloc.free_count, cached)
+    refs = [alloc.refcount(p) for p in range(1, sched.cache.num_pages)]
+    assert sum(1 for r in refs if r > 0) == cached
+    assert all(r in (0, 1) for r in refs), refs  # no leaked holders
+    if cache:
+        # every page the tree holds is live in the allocator
+        stack = [cache.root]
+        tree_pages = []
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            tree_pages.extend(node.pages)
+        assert len(tree_pages) == len(set(tree_pages)) == cached
+        assert all(alloc.refcount(p) == 1 for p in tree_pages)
+
+
+@pytest.mark.parametrize("seed", [5, 17, 41])
+def test_fuzzed_prefix_sharing_mixes(seed):
+    """Randomized shared/partial/disjoint prefix mixes under page pressure:
+    determinism across identical runs, the request contract, and pool
+    accounting invariants (refcounts sum, no page both free and referenced)
+    — with eviction exercised via small pools."""
+    rng = random.Random(seed)
+    mc = _model()
+    scenario = dict(
+        max_batch_slots=rng.choice((2, 3)),
+        page_size=16,
+        # small budgets force growth, preemption AND cache eviction under
+        # pressure; 1 = worst-case pool (cache grows until close)
+        num_pages=rng.choice((1, 20, 40)),
+        decode_block=rng.choice((2, 6)),
+        prefill_chunk=rng.choice((64, 4096)),
+        prefix_cache_max_pages=rng.choice((0, 8)),
+    )
+    reqs = _prefix_requests(rng, rng.randint(4, 10))
+
+    runs = []
+    for _ in range(2):
+        eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                     max_tokens=16, seed=0, **scenario), mc)
+        out = eng.generate_batch(reqs)
+        _check_contract(reqs, out)
+        sched = eng._scheduler
+        assert sched._prefix_cache is not None
+        _check_pool_invariants(sched)
+        m = sched.metrics
+        assert m["prefix_queries"] >= len(reqs)
+        assert m["prefix_tokens_reused"] >= 0
+        runs.append([(r.text, r.finish_reason, r.completion_tokens)
+                     for r in out])
+        eng.shutdown()
+    assert runs[0] == runs[1], scenario
+
+
+def test_fuzzed_prefix_cache_on_off_parity():
+    """Greedy outputs must be token-identical with the prefix cache on and
+    off across a randomized shared-prefix mix (the cache may only change
+    WHERE KV lives, never its values)."""
+    rng = random.Random(77)
+    mc = _model()
+    reqs = _prefix_requests(rng, 8)
+    texts = {}
+    for on in (True, False):
+        eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                     max_tokens=16, seed=0, max_batch_slots=2,
+                                     page_size=16, decode_block=4,
+                                     prefix_cache=on), mc)
+        out = eng.generate_batch(reqs)
+        _check_contract(reqs, out)
+        if on:
+            assert eng._scheduler.metrics["prefix_hits"] > 0
+        texts[on] = [r.text for r in out]
+        eng.shutdown()
+    assert texts[True] == texts[False]
+
+
 def test_fuzzed_slot_reuse_with_interpret_kernels(monkeypatch):
     """Slot recycling + varied lengths through the REAL kernel path
     (interpret): the exact conditions of the r1 stale-length SMEM bug —

@@ -38,6 +38,46 @@ def test_allocator_rejects_bad_free():
         a.free([0])  # reserved null page may never be freed
 
 
+def test_allocator_rejects_double_free():
+    """Freeing a page already on the free list must raise, not corrupt the
+    pool (a double-freed page would be handed to two sequences at once)."""
+    a = PageAllocator(8)
+    pages = a.alloc(3)
+    a.free(pages)
+    with pytest.raises(ValueError):
+        a.free([pages[0]])
+    # a rejected batch must leave the pool untouched (validate-then-mutate)
+    live = a.alloc(1)
+    with pytest.raises(ValueError):
+        a.free(live + [pages[1]])  # second id is free -> whole call rejected
+    assert a.refcount(live[0]) == 1  # the live page kept its reference
+    a.free(live)
+    assert a.free_count == 7
+    # freeing the same id twice IN ONE CALL needs refcount >= 2
+    p = a.alloc(1)
+    with pytest.raises(ValueError):
+        a.free([p[0], p[0]])
+    a.incref(p)
+    a.free([p[0], p[0]])  # ref 2 -> 0: legal
+    assert a.free_count == 7
+
+
+def test_allocator_refcount_sharing():
+    """incref'd pages return to the free list only at refcount zero, and
+    refcount-0 pages can never gain holders."""
+    a = PageAllocator(8)
+    pages = a.alloc(2)
+    a.incref(pages)
+    assert [a.refcount(p) for p in pages] == [2, 2]
+    a.free(pages)  # one holder left
+    assert a.free_count == 5
+    a.free(pages)  # last holder: pages return
+    assert a.free_count == 7
+    assert all(a.refcount(p) == 0 for p in pages)
+    with pytest.raises(ValueError):
+        a.incref([pages[0]])  # free page cannot gain a holder
+
+
 def test_cache_admission_math():
     cfg = ModelConfig(vocab_size=64, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
                       hidden_dim=64, max_seq_len=256, dtype="float32")
@@ -88,9 +128,12 @@ def test_page_recycling_does_not_corrupt():
     eng.generate_batch(churn)
     after = eng.generate_batch([probe])[0].text
     assert before == after
-    # all pages returned
+    # all pages returned except those the prefix cache retains (each held at
+    # exactly one reference — the cache's own)
     sched = eng._scheduler
-    assert sched.cache.allocator.free_count == sched.cache.num_pages - 1  # -1: null page
+    cached = sched._prefix_cache.cached_pages if sched._prefix_cache else 0
+    assert (sched.cache.allocator.free_count
+            == sched.cache.num_pages - 1 - cached)  # -1: null page
 
 
 def test_backpressure_small_pool():

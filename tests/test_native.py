@@ -140,14 +140,17 @@ def test_count_batch_tokenizer_integration(lib_ok):
 
 
 def test_allocator_parity_sequence(lib_ok):
-    """Drive both allocators through an identical random op sequence."""
+    """Drive both allocators through an identical random op sequence —
+    alloc, free, incref (a second holder delays the page's return) — and
+    assert identical pages, free counts, and refcounts throughout."""
     py = PageAllocator(64)
     cc = native.NativePageAllocator(64)
     rng = random.Random(2)
     held_py: list[list[int]] = []
     held_cc: list[list[int]] = []
-    for _ in range(300):
-        if rng.random() < 0.6 or not held_py:
+    for _ in range(400):
+        r = rng.random()
+        if r < 0.5 or not held_py:
             n = rng.randrange(1, 8)
             if n > py.free_count:
                 with pytest.raises(OutOfPages):
@@ -159,11 +162,49 @@ def test_allocator_parity_sequence(lib_ok):
             assert a == b
             held_py.append(a)
             held_cc.append(b)
+        elif r < 0.7:
+            # an extra holder on a random held batch: the matching free
+            # below then decrefs without returning the pages
+            i = rng.randrange(len(held_py))
+            py.incref(held_py[i])
+            cc.incref(held_cc[i])
+            py.free(held_py[i])
+            cc.free(held_cc[i])
         else:
             i = rng.randrange(len(held_py))
             py.free(held_py.pop(i))
             cc.free(held_cc.pop(i))
         assert py.free_count == cc.free_count
+        for p in range(64):
+            assert py.refcount(p) == cc.refcount(p), p
+
+
+def test_allocator_double_free_parity(lib_ok):
+    """Both allocators must reject a double-free identically — and leave
+    the pool untouched when a batch contains one bad id."""
+    py = PageAllocator(16)
+    cc = native.NativePageAllocator(16)
+    pa, ca = py.alloc(3), cc.alloc(3)
+    assert pa == ca
+    py.free(pa)
+    cc.free(ca)
+    for alloc_ in (py, cc):
+        with pytest.raises(ValueError):
+            alloc_.free([pa[0]])
+    live_py, live_cc = py.alloc(1), cc.alloc(1)
+    # batch with one live + one free id: rejected atomically on both sides
+    with pytest.raises(ValueError):
+        py.free(live_py + [pa[1]])
+    with pytest.raises(ValueError):
+        cc.free(live_cc + [ca[1]])
+    assert py.refcount(live_py[0]) == cc.refcount(live_cc[0]) == 1
+    assert py.free_count == cc.free_count
+    # incref of a free page is equally rejected (pa[1] stayed free: the
+    # rejected batch above must not have touched it)
+    with pytest.raises(ValueError):
+        py.incref([pa[1]])
+    with pytest.raises(ValueError):
+        cc.incref([ca[1]])
 
 
 def test_allocator_reserved_page(lib_ok):

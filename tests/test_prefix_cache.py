@@ -1,0 +1,332 @@
+"""Shared-prefix KV cache tests: radix tree semantics, allocator refcount
+interplay, LRU eviction, and scheduler integration (the acceptance bar: a
+shared map preamble across many chunks halves prefill work while greedy
+outputs stay token-identical to a cache-off run)."""
+
+from __future__ import annotations
+
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.engine.kv_cache import PageAllocator
+from lmrs_tpu.engine.prefix_cache import PrefixCache
+
+PS = 4  # page size for the pure-host tree tests
+
+
+def _cache(num_pages: int = 64, max_pages: int = 0):
+    a = PageAllocator(num_pages)
+    return a, PrefixCache(a, PS, max_pages=max_pages)
+
+
+def _seq(a: PageAllocator, ids: list[int]) -> list[int]:
+    return a.alloc(-(-len(ids) // PS))
+
+
+# ------------------------------------------------------------- radix tree
+
+
+def test_insert_and_match_page_granular():
+    a, c = _cache()
+    ids = list(range(100, 114))  # 14 tokens: 3 full pages + remainder
+    pages = _seq(a, ids)
+    assert c.insert(ids, pages) == 3  # only full pages adopted
+    # matching the same ids caps at the largest page multiple <= len-1
+    got, n = c.match(ids)
+    assert n == 12 and got == pages[:3]
+    assert [a.refcount(p) for p in pages[:3]] == [3, 3, 3]  # cache+seq+match
+    a.free(got)  # the match reference
+    a.free(pages)  # the sequence closes; cached pages stay live
+    assert all(a.refcount(p) == 1 for p in pages[:3])
+    assert a.refcount(pages[3]) == 0  # the partial page went back
+
+
+def test_match_always_leaves_a_tail_to_prefill():
+    """A full-prefix hit must leave >= 1 token uncached: the first output
+    token is sampled from the last prompt token's logits, and its KV write
+    must land in a private page."""
+    a, c = _cache()
+    ids = list(range(50, 58))  # exactly 2 pages
+    pages = _seq(a, ids)
+    c.insert(ids, pages)
+    got, n = c.match(ids)  # same 8 tokens: usable = ((8-1)//4)*4 = 4
+    assert n == 4 and got == pages[:1]
+    a.free(got)
+    a.free(pages)
+
+
+def test_edge_split_at_page_boundary():
+    a, c = _cache()
+    ids1 = list(range(0, 12))  # 3 pages
+    p1 = _seq(a, ids1 + [0])  # 4th page holds a remainder token
+    c.insert(ids1 + [0], p1)
+    # second sequence shares the first 2 pages, diverges at page 3
+    ids2 = ids1[:8] + [99] * 5
+    got, n = c.match(ids2)
+    assert n == 8 and got == p1[:2]  # the 3-page edge split at the boundary
+    a.free(got)
+    p2 = _seq(a, ids2)
+    assert c.insert(ids2, p2) == 1  # adopts only its divergent 3rd page
+    assert c.cached_pages == 4
+    # both full prefixes still match exactly: ids1 its 3 original pages,
+    # ids2 the 2 shared pages plus its own adopted divergent page
+    m1, n1 = c.match(ids1 + [0])
+    m2, n2 = c.match(ids2)
+    assert (n1, m1) == (12, p1[:3]) and (n2, m2) == (12, p1[:2] + [p2[2]])
+    a.free(m1)
+    a.free(m2)
+    a.free(p1)
+    a.free(p2)
+
+
+def test_disjoint_prefixes_do_not_match():
+    a, c = _cache()
+    ids1, ids2 = [1] * 9, [2] * 9
+    p1 = _seq(a, ids1)
+    c.insert(ids1, p1)
+    got, n = c.match(ids2)
+    assert (got, n) == ([], 0)
+    a.free(p1)
+
+
+def test_lru_eviction_order():
+    a, c = _cache()
+    seqs = []
+    for base in (10, 20, 30):  # three disjoint 2-page prefixes
+        ids = [base + i for i in range(9)]
+        pages = _seq(a, ids)
+        c.insert(ids, pages)
+        a.free(pages)  # sequences close: all nodes refcount-zero
+        seqs.append((ids, pages[:2]))
+    assert c.cached_pages == 6
+    # touch the OLDEST entry so it becomes most-recently-used
+    got, _ = c.match(seqs[0][0])
+    a.free(got)
+    # evicting 2 pages must drop the LRU node: seqs[1], not seqs[0]
+    assert c.evict(2) == 2
+    m0, n0 = c.match(seqs[0][0])
+    m1, n1 = c.match(seqs[1][0])
+    m2, n2 = c.match(seqs[2][0])
+    assert n0 == 8 and n1 == 0 and n2 == 8
+    a.free(m0)
+    a.free(m2)
+
+
+def test_shared_nodes_are_not_evictable():
+    """A node a live sequence shares (allocator refcount > 1) must survive
+    eviction; refcount-zero nodes drain."""
+    a, c = _cache()
+    ids = list(range(200, 209))
+    pages = _seq(a, ids)
+    c.insert(ids, pages)
+    # the sequence is still live (holds its own reference): nothing evictable
+    assert c.evict(10) == 0
+    a.free(pages)  # sequence closes
+    assert c.evict(10) == 2
+    assert c.cached_pages == 0
+    assert a.free_count == 63
+
+
+def test_max_pages_budget_evicts_then_trims():
+    a, c = _cache(max_pages=2)
+    ids1 = [1] * 9
+    p1 = _seq(a, ids1)
+    c.insert(ids1, p1)
+    a.free(p1)
+    assert c.cached_pages == 2
+    ids2 = [2] * 13  # wants 3 pages: over budget -> evict LRU, trim to 2
+    p2 = _seq(a, ids2)
+    c.insert(ids2, p2)
+    a.free(p2)
+    assert c.cached_pages <= 2
+    got, n = c.match(ids2)
+    assert n == 8  # the trimmed 2-page prefix is cached
+    a.free(got)
+
+
+def test_insert_hint_caps_adoption():
+    a, c = _cache()
+    ids = list(range(0, 16))
+    pages = _seq(a, ids)
+    # hint 5 tokens -> ceil to page = 2 pages adopted, not 4
+    assert c.insert(ids, pages, max_tokens=5) == 2
+    assert c.cached_pages == 2
+    a.free(pages)
+
+
+def test_pool_accounting_invariant():
+    """No page may be both free and cache-referenced; free + live + cached
+    always covers the pool exactly."""
+    a, c = _cache(num_pages=32)
+    live = []
+    for base in (0, 40, 80):
+        ids = [base + i for i in range(11)]
+        pages = _seq(a, ids)
+        c.insert(ids, pages)
+        live.append(pages)
+    got, n = c.match([0, 1, 2, 3] + [7] * 5)  # partial hit on the first
+    assert n == 4
+    a.free(got)
+    held = {p for pages in live for p in pages}
+    assert all(a.refcount(p) >= 1 for p in held)
+    # usable pool = free + pages held by sequences and/or the cache
+    distinct_held = len(held)
+    assert a.free_count + distinct_held == 31
+    for pages in live:
+        a.free(pages)
+    c.evict(10_000)
+    assert a.free_count == 31
+    assert all(a.refcount(p) == 0 for p in held)
+
+
+# ---------------------------------------------------- scheduler integration
+
+
+def tiny_model():
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+PREAMBLE = ("You are summarizing one section of a much longer transcript. "
+            "Keep every fact, decision, name, and number. ")
+
+
+def _map_requests(n: int, hint: bool = True) -> list[GenerationRequest]:
+    """A demo-style map workload: shared system+map preamble, distinct
+    per-chunk bodies."""
+    return [GenerationRequest(
+        prompt=PREAMBLE + f"Chunk {i}: the team discussed milestone {i}.",
+        request_id=i, temperature=0.0, max_new_tokens=8,
+        system_prompt="Respond with the summary content only.",
+        cache_prefix=len(PREAMBLE) if hint else None)
+        for i in range(n)]
+
+
+def _engine(**kw):
+    cfg = dict(backend="jax", scheduler="continuous", max_tokens=8,
+               max_batch_slots=2, seed=0, page_size=16, decode_block=4)
+    cfg.update(kw)
+    return JaxEngine(EngineConfig(**cfg), tiny_model())
+
+
+def test_map_preamble_halves_prefill_and_keeps_outputs():
+    """The acceptance bar: >= 8 chunks sharing the system+map preamble,
+    prefill_tokens drops >= 50% cache-on vs cache-off, greedy outputs
+    token-identical in both modes."""
+    reqs = _map_requests(10)
+    on = _engine()
+    got = [r.text for r in on.generate_batch(reqs)]
+    m_on = dict(on._scheduler.metrics)
+    report = on.engine_metrics()
+    on.shutdown()
+
+    off = _engine(prefix_cache=False)
+    assert off._scheduler._prefix_cache is None
+    want = [r.text for r in off.generate_batch(reqs)]
+    m_off = dict(off._scheduler.metrics)
+    off.shutdown()
+
+    assert got == want, "prefix cache changed greedy outputs"
+    assert m_on["prefill_tokens"] <= 0.5 * m_off["prefill_tokens"], (
+        m_on["prefill_tokens"], m_off["prefill_tokens"])
+    # the first admission wave (2 slots) misses, the rest hit
+    assert m_on["prefix_hits"] >= 8
+    assert m_on["prefix_tokens_reused"] > 0
+    pc = report["prefix_cache"]
+    assert pc["hit_rate"] >= 0.8
+    assert pc["prefill_tokens_saved"] == m_on["prefix_tokens_reused"]
+    assert pc["tokens_reused"] == pc["prefill_tokens_saved"]
+
+
+def test_identical_prompt_rerun_hits_cache():
+    """A repeated identical prompt (full-prefix hit) re-prefills only the
+    capped tail and produces identical text across engine runs."""
+    eng = _engine()
+    req = GenerationRequest(prompt="canonical probe " * 8, temperature=0.0,
+                            max_new_tokens=8)
+    first = eng.generate_batch([req])[0].text
+    m0 = eng._scheduler.metrics["prefill_tokens"]
+    second = eng.generate_batch([req])[0].text
+    m1 = eng._scheduler.metrics["prefill_tokens"]
+    eng.shutdown()
+    assert first == second
+    # the second run prefilled only the uncached tail (< one page + budget)
+    assert m1 - m0 < m0
+
+
+def test_cache_off_via_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("LMRS_PREFIX_CACHE", "0")
+    eng = _engine()
+    assert eng._scheduler._prefix_cache is None
+    out = eng.generate_batch(_map_requests(3))
+    assert all(r.error is None for r in out)
+    assert eng._scheduler.metrics["prefix_queries"] == 0
+    eng.shutdown()
+
+
+def test_kv_quantize_gates_cache_off():
+    eng = _engine(kv_quantize="int8", page_size=32)
+    assert eng._scheduler._prefix_cache is None
+    eng.shutdown()
+
+
+def test_eviction_under_page_pressure_no_deadlock():
+    """A pool near the floor with the cache retaining pages: admissions and
+    decode growth must drain the cache (back-pressure eviction) instead of
+    deadlocking, and every request completes with outputs identical to a
+    roomy-pool run."""
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=96,
+                     dtype="float32")
+    reqs = _map_requests(6)
+
+    def run(num_pages):
+        eng = JaxEngine(EngineConfig(
+            backend="jax", scheduler="continuous", max_tokens=16,
+            max_batch_slots=3, seed=0, page_size=16, num_pages=num_pages,
+            decode_block=4), mc)
+        out = eng.generate_batch(reqs)
+        sched = eng._scheduler
+        stats = sched._prefix_cache.stats()
+        free = sched.cache.allocator.free_count
+        total = sched.cache.num_pages
+        eng.shutdown()
+        return out, stats, free, total
+
+    roomy, _, _, _ = run(1)  # worst-case pool: no pressure
+    tight, stats, free, total = run(8)  # floor-sized budget: heavy pressure
+    assert all(r.error is None for r in tight)
+    assert [r.text for r in tight] == [r.text for r in roomy]
+    assert stats["evicted_pages"] > 0, stats  # pressure drained the cache
+    # invariant: free + cache-retained covers the whole usable pool
+    assert free == total - 1 - stats["cached_pages"]
+
+
+def test_map_executor_sets_cache_prefix_hint():
+    from lmrs_tpu.data.chunker import Chunk
+    from lmrs_tpu.engine.executor import MapExecutor
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.prompts import DEFAULT_MAP_PROMPT
+
+    ex = MapExecutor(MockEngine())
+    chunk = Chunk(chunk_index=0, total_chunks=1)
+    chunk.text_with_context = "body text"
+    req = ex.build_map_request(chunk, DEFAULT_MAP_PROMPT)
+    assert req.cache_prefix == DEFAULT_MAP_PROMPT.replace(
+        "{summary_type}", "summary").index("{transcript}")
+
+
+def test_reduce_aggregator_sets_cache_prefix_hint():
+    from lmrs_tpu.engine.executor import MapExecutor
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.prompts import DEFAULT_REDUCE_PROMPT
+    from lmrs_tpu.reduce.aggregator import ResultAggregator
+
+    agg = ResultAggregator(MapExecutor(MockEngine()))
+    req = agg._build_request(["s1", "s2"], DEFAULT_REDUCE_PROMPT, None)
+    assert req.cache_prefix is not None
+    # the default reduce template varies at {num_summaries} on line 1
+    assert req.cache_prefix == DEFAULT_REDUCE_PROMPT.index("{num_summaries}")
