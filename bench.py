@@ -199,7 +199,7 @@ def _param_count_m(params) -> float:
     return param_count(params) / 1e6
 
 
-def run_bench() -> tuple[float, dict]:
+def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     from lmrs_tpu.config import (
         ChunkConfig, EngineConfig, PipelineConfig, ReduceConfig, model_preset,
     )
@@ -209,6 +209,10 @@ def run_bench() -> tuple[float, dict]:
     # logs -> stderr: this process's stdout is the one-JSON-line artifact
     # the driver parses; a WARNING on stdout would corrupt it
     setup_logging(quiet=True, stream=sys.stderr)
+    if trace_out:
+        from lmrs_tpu.obs import enable_tracing
+
+        enable_tracing()
     transcript = load_transcript()
 
     # ~1.03B-param GQA decoder (config.model_preset "bench-1b"): big enough
@@ -371,6 +375,18 @@ def _prefix_window(m: dict, before: dict) -> dict:
 
 
 def main() -> int:
+    import argparse
+
+    # minimal flag surface (the driver runs bench.py bare; --trace-out /
+    # LMRS_TRACE_OUT opt into lifecycle tracing, --no-trace is the
+    # overhead-A/B control) — unknown args are ignored, not fatal
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--trace-out",
+                    default=os.environ.get("LMRS_TRACE_OUT") or None)
+    ap.add_argument("--no-trace", action="store_true")
+    args, _ = ap.parse_known_args()
+    trace_out = None if args.no_trace else args.trace_out
+
     deadline = float(os.environ.get("LMRS_BENCH_DEADLINE_S", "1800"))
     start_watchdog(deadline)
 
@@ -379,7 +395,7 @@ def main() -> int:
         emit(0.0, {"error": f"backend unavailable after retries: {probe_log}"})
         return 0
     try:
-        value, detail = run_bench()
+        value, detail = run_bench(trace_out)
         detail["backend_probe"] = probe_log
         emit(value, detail)
     except Exception as e:  # noqa: BLE001 - artifact > traceback
@@ -388,6 +404,16 @@ def main() -> int:
         # same salvage as the watchdog: a transient device error after
         # completed reps must not zero out measured data
         emit_salvage(f"{type(e).__name__}: {e}"[:400])
+    finally:
+        # trace salvage mirrors the rep salvage above: whatever the ring
+        # buffer captured before a failure is still a diagnosable artifact
+        if trace_out:
+            from lmrs_tpu.obs import export_current
+
+            n, err = export_current(trace_out)
+            print(f"wrote {n} trace events to {trace_out}" if err is None
+                  else f"could not write trace {trace_out}: {err}",
+                  file=sys.stderr)
     return 0
 
 

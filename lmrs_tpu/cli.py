@@ -90,6 +90,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "preamble normally prefills once and is shared "
                         "read-only across requests; greedy output is "
                         "identical either way)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="record per-request lifecycle spans and write a "
+                        "Chrome-trace JSON loadable in Perfetto "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="force tracing off even if --trace-out is given "
+                        "(overhead A/B control)")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
@@ -140,9 +147,25 @@ def config_from_args(args: argparse.Namespace) -> PipelineConfig:
     )
 
 
+def _export_trace(trace_out: str) -> None:
+    from lmrs_tpu.obs import export_current
+
+    n, err = export_current(trace_out)
+    if err is None:
+        logger.info("wrote %d trace events to %s (open in "
+                    "https://ui.perfetto.dev)", n, trace_out)
+    else:  # degraded, not fatal (same as --output)
+        logger.error("could not write trace %s: %s", trace_out, err)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(quiet=args.quiet)
+    trace_out = None if args.no_trace else args.trace_out
+    if trace_out:
+        from lmrs_tpu.obs import enable_tracing
+
+        enable_tracing()
     # an explicit JAX_PLATFORMS=cpu must beat any sitecustomize that
     # force-registers an accelerator (utils/platform.py) — without this a
     # wedged tunnel hangs even pure-CPU runs
@@ -158,18 +181,24 @@ def main(argv: list[str] | None = None) -> int:
 
     summarizer = TranscriptSummarizer(config_from_args(args), profile=args.profile)
     try:
-        stats = summarizer.summarize(
-            transcript,
-            prompt_file=args.prompt_file,
-            system_prompt_file=args.system_prompt_file,
-            aggregator_prompt_file=args.aggregator_prompt_file,
-            summary_type=args.summary_type,
-            save_chunks=args.save_chunks,
-            resume_from=args.resume_from,
-        )
-    except ValueError as e:
-        logger.error("pipeline configuration error: %s", e)
-        return 1
+        try:
+            stats = summarizer.summarize(
+                transcript,
+                prompt_file=args.prompt_file,
+                system_prompt_file=args.system_prompt_file,
+                aggregator_prompt_file=args.aggregator_prompt_file,
+                summary_type=args.summary_type,
+                save_chunks=args.save_chunks,
+                resume_from=args.resume_from,
+            )
+        except ValueError as e:
+            logger.error("pipeline configuration error: %s", e)
+            return 1
+    finally:
+        # export whatever the ring buffer holds even when the pipeline
+        # fails — a failed run is exactly when the trace matters most
+        if trace_out:
+            _export_trace(trace_out)
     summarizer.shutdown()
 
     summary = stats["summary"]

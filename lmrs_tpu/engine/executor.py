@@ -29,6 +29,7 @@ from typing import Sequence
 from lmrs_tpu.config import EngineConfig
 from lmrs_tpu.data.chunker import Chunk
 from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+from lmrs_tpu.obs import PID_PIPELINE, get_tracer
 from lmrs_tpu.prompts import safe_format, shared_prefix_chars
 
 logger = logging.getLogger("lmrs.executor")
@@ -103,6 +104,11 @@ class MapExecutor:
                 chunk.summary = res.text
             chunk.tokens_used = res.total_tokens
             chunk.device_seconds = res.device_seconds
+        tr = get_tracer()
+        if tr:
+            tr.complete("map_stage", t0, time.time(), pid=PID_PIPELINE,
+                        args={"chunks": len(flat), "groups": len(groups),
+                              "failed": failed})
         logger.info(
             "map stage: %d chunks (%d groups) in %.2fs (%d failed)",
             len(flat), len(groups), time.time() - t0, failed,

@@ -205,6 +205,13 @@ class JaxEngine:
     def engine_metrics(self) -> dict:
         return self._scheduler.metrics_report() if self._scheduler else {}
 
+    def metrics_registry(self):
+        """Optional Engine hook (same getattr convention as ``cancel``):
+        the typed registry behind engine_metrics(), or None for the static
+        scheduler — serving/server.py renders Prometheus exposition from
+        it."""
+        return self._scheduler.registry if self._scheduler else None
+
     # -------------------------------------------------------------- generate
 
     def generate_batch(self, requests: list[GenerationRequest],

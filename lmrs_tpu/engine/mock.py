@@ -18,6 +18,7 @@ import time
 from lmrs_tpu.data.tokenizer import ApproxTokenizer
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  apply_stop_sequences)
+from lmrs_tpu.obs import get_tracer, req_tid
 
 _TS_RE = re.compile(r"\[(?:\d+:)?\d{2}:\d{2}\]")
 
@@ -49,7 +50,16 @@ class MockEngine:
         # batcher's rids are global)
 
         def one(req: GenerationRequest) -> GenerationResult:
+            tr = get_tracer()
+            t0 = time.time()
             res = self._one(req)
+            if tr:  # minimal lifecycle: the mock has no queue or slots
+                tid = req_tid(req.request_id)
+                tr.complete("generate", t0, time.time(), tid=tid,
+                            args={"completion_tokens": res.completion_tokens})
+                tr.instant("cancel" if res.finish_reason == "cancelled"
+                           else "finish", tid=tid,
+                           args={"reason": res.finish_reason})
             if on_tokens is not None and res.text:
                 # no incremental decode in the mock: one delta per result
                 on_tokens(res.request_id, res.text)

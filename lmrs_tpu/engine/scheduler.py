@@ -41,6 +41,8 @@ from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
 from lmrs_tpu.engine.kv_cache import OutOfPages, PagedKVCache, SequencePages
 from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.models.transformer import forward_paged
+from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, MetricsRegistry,
+                          get_tracer, req_tid)
 from lmrs_tpu.ops.sampling import sample_logits
 
 logger = logging.getLogger("lmrs.scheduler")
@@ -51,25 +53,6 @@ def _pow2_bucket(n: int, lo: int) -> int:
     while b < n:
         b *= 2
     return b
-
-
-def _latency_pct(samples: list[float]) -> dict | None:
-    """p50/p90/p99 (ms) over latency samples; None when nothing measured
-    (metrics consumers then omit the block instead of reporting zeros)."""
-    if not samples:
-        return None
-    p50, p90, p99 = np.percentile(np.asarray(samples), [50, 90, 99])
-    return {"p50": round(float(p50) * 1e3, 1),
-            "p90": round(float(p90) * 1e3, 1),
-            "p99": round(float(p99) * 1e3, 1),
-            "n": len(samples)}
-
-
-def _append_bounded(samples: list[float], value: float,
-                    cap: int = 200_000) -> None:
-    samples.append(value)
-    if len(samples) > cap:  # drop the oldest half; percentiles stay recent
-        del samples[: cap // 2]
 
 
 # NOTE: quarter-step sequence buckets (p*1.25/1.5/1.75 between powers of
@@ -94,6 +77,10 @@ class _SlotState:
     # prompt.  ``prefill_pos`` = prompt tokens already written to KV.
     phase: str = "prefill"
     prefill_pos: int = 0
+    # tracing anchors (obs/trace.py): admission and prefill-complete times
+    # for this SLOT LIFE — a preemption continuation opens fresh spans
+    t_admit: float = 0.0
+    t_decode_start: float = 0.0
     # preemption bookkeeping: a preempted slot re-enters the queue with its
     # generated-so-far tokens folded into ``prompt_ids`` (the continuation
     # re-prefills them); ``n_prompt`` keeps the ORIGINAL prompt length for
@@ -245,21 +232,6 @@ class ContinuousScheduler:
                 self.cache.allocator, ps,
                 max_pages=engine_cfg.prefix_cache_max_pages)
             self.cache.reclaim_cb = self._prefix_cache.evict
-        # LMRS_TRACE_DISPATCH=1: record a host timestamp per decode
-        # dispatch (decode-latency benchmarking — the gap between decode
-        # dispatches is the per-block token latency active slots see)
-        self._trace_dispatch: list[float] | None = (
-            [] if os.environ.get("LMRS_TRACE_DISPATCH") == "1" else None)
-        # Always-on serving-latency samples (VERDICT r4 item 5: a latency
-        # regression must not ship silently because the numbers lived only
-        # in a one-off script).  _ttft: submit->first-token seconds per
-        # fresh request; _block_gaps: seconds between consecutive decode
-        # dispatches within a run — the cadence at which a streaming
-        # client receives delta batches.  Bounded (oldest half dropped)
-        # so a long-lived serving process cannot grow without limit;
-        # percentiles surface in metrics_report()/the bench detail.
-        self._ttft: list[float] = []
-        self._block_gaps: list[float] = []
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         # Request abort (VERDICT r3 item 4): ids land here from any thread
         # (set.add is atomic under the GIL — the HTTP server cancels from a
@@ -278,29 +250,110 @@ class ContinuousScheduler:
         self._spec_buf = None  # device token-history buffer (speculation)
         self._on_tokens = None  # per-block streaming callback (run()-scoped)
         self._streamed: dict[int, str] = {}
-        # engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog)
-        self.metrics = {
-            "prefill_tokens": 0, "decode_tokens": 0, "decode_dispatches": 0,
-            "occupancy_sum": 0.0, "peak_pages_in_use": 0, "run_seconds": 0.0,
-            "spec_accepted_tokens": 0,  # draft tokens accepted (speculation)
-            "preemptions": 0,  # slots evicted to the queue under page pressure
-            "stalls": 0,  # dispatches a slot sat out waiting for pages
-            "peak_active_slots": 0,  # max simultaneously-occupied slots
-            "cancelled": 0,  # requests aborted via cancel()
-            # time inside blocking device fetches (run() path only): the
-            # device is busy (or draining the tunnel) while the host waits
-            # here, so run_seconds - blocked_seconds is the host-side share
-            # — bookkeeping the device sits idle for (r5: ~17% of 8B map
-            # wall; the attribution number for any overlap lever)
-            "blocked_seconds": 0.0,
-            # prefix-cache counters (present even when the cache is off, so
-            # bench windowing can always delta them): admissions that
-            # queried the radix tree, admissions that matched, and prompt
-            # tokens whose prefill was skipped via cached pages
-            "prefix_queries": 0,
-            "prefix_hits": 0,
-            "prefix_tokens_reused": 0,
+        # Engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog),
+        # migrated from the former raw dict onto a typed registry
+        # (obs/metrics.py): counters/gauges keep the old dict's exact key
+        # semantics via the ``metrics`` snapshot property, histograms
+        # replace the former unbounded-ish _ttft/_block_gaps sample lists
+        # (same bounded reservoir, plus fixed buckets for Prometheus).
+        self.registry = MetricsRegistry()
+        c, g, h = (self.registry.counter, self.registry.gauge,
+                   self.registry.histogram)
+        self._c_prefill_tokens = c("lmrs_prefill_tokens_total",
+                                   "prompt tokens prefilled", "tokens")
+        self._c_decode_tokens = c("lmrs_decode_tokens_total",
+                                  "tokens generated by decode blocks",
+                                  "tokens")
+        self._c_decode_dispatches = c("lmrs_decode_dispatches_total",
+                                      "decode-block dispatches issued")
+        self._c_run_seconds = c("lmrs_run_seconds_total",
+                                "scheduler wall-clock inside run()",
+                                "seconds")
+        # time inside blocking device fetches (run() path only): the device
+        # is busy (or draining the tunnel) while the host waits here, so
+        # run_seconds - blocked_seconds is the host-side share — bookkeeping
+        # the device sits idle for (r5: ~17% of 8B map wall; the
+        # attribution number for any overlap lever)
+        self._c_blocked_seconds = c("lmrs_blocked_seconds_total",
+                                    "host time blocked in device fetches",
+                                    "seconds")
+        self._c_spec_accepted = c("lmrs_spec_accepted_tokens_total",
+                                  "draft tokens accepted (speculation)",
+                                  "tokens")
+        self._c_preemptions = c("lmrs_preemptions_total",
+                                "slots evicted to the queue under page "
+                                "pressure")
+        self._c_stalls = c("lmrs_stalls_total",
+                           "dispatches a slot sat out waiting for pages")
+        self._c_cancelled = c("lmrs_cancelled_total",
+                              "requests aborted via cancel()")
+        # prefix-cache counters (present even when the cache is off, so
+        # bench windowing can always delta them): admissions that queried
+        # the radix tree, admissions that matched, and prompt tokens whose
+        # prefill was skipped via cached pages
+        self._c_prefix_queries = c("lmrs_prefix_queries_total",
+                                   "admissions that queried the prefix tree")
+        self._c_prefix_hits = c("lmrs_prefix_hits_total",
+                                "admissions that matched a cached prefix")
+        self._c_prefix_tokens = c("lmrs_prefix_tokens_reused_total",
+                                  "prompt tokens served from cached pages",
+                                  "tokens")
+        self._g_peak_pages = g("lmrs_peak_pages_in_use",
+                               "max KV pages simultaneously allocated",
+                               "pages")
+        self._g_peak_slots = g("lmrs_peak_active_slots",
+                               "max simultaneously-occupied batch slots")
+        # TTFT: scheduler-enqueue -> first host-visible token per fresh
+        # request; block gap: seconds between consecutive decode dispatches
+        # within a run — the cadence a streaming client receives delta
+        # batches at (VERDICT r4 item 5: always on, never script-only)
+        self._h_ttft = h("lmrs_ttft_seconds",
+                         help="time to first token (engine-side)",
+                         unit="seconds")
+        self._h_block_gap = h("lmrs_decode_block_gap_seconds",
+                              help="gap between consecutive decode "
+                                   "dispatches", unit="seconds")
+        self._h_queue_wait = h("lmrs_queue_wait_seconds",
+                               help="enqueue -> slot admission wait",
+                               unit="seconds")
+        self._h_prefill_batch = h("lmrs_prefill_batch_tokens",
+                                  buckets=POW2_TOKEN_BUCKETS,
+                                  help="real prompt tokens per prefill "
+                                       "dispatch", unit="tokens")
+        self._h_occupancy = h("lmrs_decode_occupancy_ratio",
+                              buckets=RATIO_BUCKETS,
+                              help="fraction of batch slots live per "
+                                   "decode dispatch")
+        self._tr = get_tracer()  # refreshed at each run()
+
+    @property
+    def metrics(self) -> dict:
+        """Raw cumulative metric values under the pre-registry key names —
+        the read-only snapshot tests and bench windowing delta (the former
+        mutable dict's exact keys and value types)."""
+        return {
+            "prefill_tokens": int(self._c_prefill_tokens.value),
+            "decode_tokens": int(self._c_decode_tokens.value),
+            "decode_dispatches": int(self._c_decode_dispatches.value),
+            "occupancy_sum": self._h_occupancy.sum,
+            "peak_pages_in_use": int(self._g_peak_pages.value),
+            "run_seconds": self._c_run_seconds.value,
+            "spec_accepted_tokens": int(self._c_spec_accepted.value),
+            "preemptions": int(self._c_preemptions.value),
+            "stalls": int(self._c_stalls.value),
+            "peak_active_slots": int(self._g_peak_slots.value),
+            "cancelled": int(self._c_cancelled.value),
+            "blocked_seconds": self._c_blocked_seconds.value,
+            "prefix_queries": int(self._c_prefix_queries.value),
+            "prefix_hits": int(self._c_prefix_hits.value),
+            "prefix_tokens_reused": int(self._c_prefix_tokens.value),
         }
+
+    def metrics_registry(self) -> MetricsRegistry:
+        """Engine-protocol optional hook: the registry behind
+        ``metrics_report()``, for Prometheus exposition (serving/server.py
+        content-negotiates ``GET /metrics`` over it)."""
+        return self.registry
 
     def _timed_get(self, x):
         """``jax.device_get`` with the blocking wait charged to the
@@ -308,7 +361,9 @@ class ContinuousScheduler:
         metric's init comment)."""
         t0 = time.time()
         out = jax.device_get(x)
-        self.metrics["blocked_seconds"] += time.time() - t0
+        # clamped: counters refuse to decrease, and a backwards clock step
+        # (NTP correction mid-fetch) must cost a sample, not the whole run
+        self._c_blocked_seconds.inc(max(0.0, time.time() - t0))
         return out
 
     def metrics_report(self) -> dict:
@@ -337,8 +392,9 @@ class ContinuousScheduler:
             "stalls": m["stalls"],
             "cancelled": m["cancelled"],
             "peak_active_slots": m["peak_active_slots"],
-            "ttft_ms": _latency_pct(self._ttft),
-            "decode_block_gap_ms": _latency_pct(self._block_gaps),
+            "ttft_ms": self._h_ttft.percentile_report(),
+            "decode_block_gap_ms": self._h_block_gap.percentile_report(),
+            "queue_wait_ms": self._h_queue_wait.percentile_report(),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
             **({"prefix_cache": self._prefix_cache_report()}
@@ -364,11 +420,13 @@ class ContinuousScheduler:
         }
 
     def reset_latency_stats(self) -> None:
-        """Drop accumulated TTFT / block-gap samples.  Benchmarks call
-        this after warmup so compile-time dispatch gaps (orders of
-        magnitude above steady state) don't pollute the percentiles."""
-        self._ttft.clear()
-        self._block_gaps.clear()
+        """Drop accumulated TTFT / block-gap / queue-wait observations.
+        Benchmarks call this after warmup so compile-time dispatch gaps
+        (orders of magnitude above steady state) don't pollute the
+        percentiles — or the Prometheus buckets."""
+        self._h_ttft.reset()
+        self._h_block_gap.reset()
+        self._h_queue_wait.reset()
 
     def _pick_kernel(self) -> bool:
         from lmrs_tpu.utils.platform import on_tpu
@@ -444,6 +502,9 @@ class ContinuousScheduler:
         tracked per request id, not per slot).
         """
         t_run = time.time()
+        # per-run tracer capture: the CLI/bench enable tracing before the
+        # engine runs; a None tracer keeps every site a single branch
+        tr = self._tr = get_tracer()
         # NOTE: the cancel set is deliberately NOT cleared here.  A client
         # disconnect can race the run boundary (cancel lands after
         # generate_batch is invoked but before run() begins executing); a
@@ -471,12 +532,20 @@ class ContinuousScheduler:
                 queue.append((req, ids, max_new, len(ids), [], None))
                 all_requests.append(req)
                 t_enq[req.request_id] = time.time()
+                if tr:
+                    tr.instant("enqueue", ts=t_enq[req.request_id],
+                               tid=req_tid(req.request_id),
+                               args={"prompt_tokens": len(ids)})
 
         fresh: deque[int] = deque()  # completed rids awaiting delivery
         for req in requests:
             ids, max_new = self._encode(req)
             queue.append((req, ids, max_new, len(ids), [], None))
             t_enq[req.request_id] = time.time()
+            if tr:
+                tr.instant("enqueue", ts=t_enq[req.request_id],
+                           tid=req_tid(req.request_id),
+                           args={"prompt_tokens": len(ids)})
 
         slots: list[_SlotState | None] = [None] * self.B
         last_tok = np.zeros((self.B,), np.int32)
@@ -536,18 +605,38 @@ class ContinuousScheduler:
                 # request re-probes every scheduler tick until pages free
                 # up, and retry ticks must not dilute the hit rate
                 if self._prefix_cache is not None:
-                    self.metrics["prefix_queries"] += 1
+                    self._c_prefix_queries.inc()
                     if cached_tokens:
-                        self.metrics["prefix_hits"] += 1
-                        self.metrics["prefix_tokens_reused"] += cached_tokens
+                        self._c_prefix_hits.inc()
+                        self._c_prefix_tokens.inc(cached_tokens)
                 # a continuation keeps its ORIGINAL t_start: device_seconds
                 # then spans the whole request, and the slot stays "old" for
                 # youngest-victim selection (a refreshed t_start would make
                 # the same request the perpetual preemption victim)
+                now = time.time()
                 st = _SlotState(req=req, prompt_ids=ids, max_new=max_new,
                                 seq=seq,
-                                t_start=t0 if t0 is not None else time.time(),
+                                t_start=t0 if t0 is not None else now,
                                 n_prompt=n_prompt, prior=list(prior))
+                st.t_admit = now
+                rid = req.request_id
+                # queue wait = enqueue -> FIRST admission.  Continuation
+                # detection is ``t0`` (the carried original t_start), NOT
+                # ``prior``: a slot preempted before its deferred first
+                # token re-queues with prior=[] but t0 set, and must not
+                # re-sample an enqueue->re-admission wait
+                t_q = t_enq.get(rid)
+                if t_q is not None and t0 is None:
+                    self._h_queue_wait.observe(now - t_q)
+                    if tr:
+                        tr.complete("queue_wait", t_q, now, tid=req_tid(rid))
+                if tr:
+                    tr.instant("admit", ts=now, tid=req_tid(rid),
+                               args={"slot": b,
+                                     "continuation": t0 is not None})
+                    if cached_tokens:
+                        tr.instant("prefix_match", ts=now, tid=req_tid(rid),
+                                   args={"tokens_reused": cached_tokens})
                 # a cache hit enters the existing chunked-prefill machinery
                 # at the match boundary: the first chunk dispatches as a
                 # windowed continuation attending the cloned pages
@@ -566,10 +655,8 @@ class ContinuousScheduler:
                 # usable pages only: the reserved null page is neither
                 # allocatable nor counted, so utilization can reach 0 and 1
                 in_use = usable_pages - self.cache.allocator.free_count
-                self.metrics["peak_pages_in_use"] = max(
-                    self.metrics["peak_pages_in_use"], in_use)
-                self.metrics["peak_active_slots"] = max(
-                    self.metrics["peak_active_slots"],
+                self._g_peak_pages.track_max(in_use)
+                self._g_peak_slots.track_max(
                     sum(s is not None for s in slots))
 
         try:
@@ -603,6 +690,12 @@ class ContinuousScheduler:
                     for b, row in rows:
                         st = slots[b]
                         st.phase = "decode"
+                        st.t_decode_start = time.time()
+                        if tr:
+                            tr.complete(
+                                "prefill", st.t_admit, st.t_decode_start,
+                                tid=req_tid(st.req.request_id),
+                                args={"prompt_tokens": len(st.prompt_ids)})
                         st.kv_len = len(st.prompt_ids)
                         kv_lens[b] = st.kv_len
                         active[b] = True
@@ -655,14 +748,13 @@ class ContinuousScheduler:
                         if slots[b] is not None:
                             active[b] = True
                     continue
-                self.metrics["occupancy_sum"] += float(np.mean(active))
-                self.metrics["decode_dispatches"] += 1
+                n_live = int(np.sum(active))
+                self._h_occupancy.observe(n_live / self.B)
+                self._c_decode_dispatches.inc()
                 now = time.time()
                 if last_block_t is not None:
-                    _append_bounded(self._block_gaps, now - last_block_t)
+                    self._h_block_gap.observe(now - last_block_t)
                 last_block_t = now
-                if self._trace_dispatch is not None:
-                    self._trace_dispatch.append(now)
                 if self.spec_k:
                     emitted = self._spec_decode_block(
                         slots, last_tok, kv_lens, active, temps, top_k, top_p)
@@ -686,6 +778,7 @@ class ContinuousScheduler:
                                                kv_lens, last_tok)
                     emitted = [toks[b, : int(n_valid[b])].tolist()
                                for b in range(self.B)]
+                block_tokens = 0
                 for b in range(self.B):
                     st = slots[b]
                     if st is None or not active[b]:
@@ -695,9 +788,21 @@ class ContinuousScheduler:
                     st.kv_len += len(new)
                     kv_lens[b] = st.kv_len
                     last_tok[b] = st.generated[-1] if st.generated else 0
-                    self.metrics["decode_tokens"] += len(new)
+                    self._c_decode_tokens.inc(len(new))
+                    block_tokens += len(new)
+                    if tr and new:
+                        tr.instant("decode_block", ts=now,
+                                   tid=req_tid(st.req.request_id),
+                                   args={"tokens": len(new)})
                     self._maybe_finish(b, slots, results, active, fresh,
                                        kv_lens, last_tok)
+                if tr:
+                    # scheduler-track span: dispatch issue through host-side
+                    # result processing; start timestamps are the former
+                    # LMRS_TRACE_DISPATCH list (Tracer.timestamps)
+                    tr.complete("decode_block", now, time.time(),
+                                args={"active": n_live,
+                                      "tokens": block_tokens})
                 for b in stalled:  # stalled rows rejoin the next dispatch
                     if slots[b] is not None:
                         active[b] = True
@@ -711,7 +816,9 @@ class ContinuousScheduler:
             # the next run, which is harmless because the HTTP batcher's
             # wave rids are globally unique — a stale id can never match a
             # future request.
-            self.metrics["run_seconds"] += time.time() - t_run
+            # clamped (same reason as _timed_get) — doubly important here:
+            # this runs in a finally, where a raise would mask the real error
+            self._c_run_seconds.inc(max(0.0, time.time() - t_run))
             self._on_tokens = None
             self._streamed = {}
             self._cancelled.clear()
@@ -747,7 +854,11 @@ class ContinuousScheduler:
                 )
                 fresh.append(req.request_id)
                 hit.add(req.request_id)
-                self.metrics["cancelled"] += 1
+                self._c_cancelled.inc()
+                if self._tr:  # cancelled while still queued: no spans open
+                    self._tr.instant("cancel",
+                                     tid=req_tid(req.request_id),
+                                     args={"state": "queued"})
         for b in range(self.B):
             st = slots[b]
             if st is None or st.req.request_id not in pending:
@@ -756,7 +867,7 @@ class ContinuousScheduler:
             self._finish_slot(b, slots, results, active, fresh, kv_lens,
                               last_tok, gen, text, stop_hit, "cancelled")
             hit.add(st.req.request_id)
-            self.metrics["cancelled"] += 1
+            self._c_cancelled.inc()
             logger.debug("cancelled request %d (slot %d)",
                          st.req.request_id, b)
         self._cancelled -= hit
@@ -781,7 +892,11 @@ class ContinuousScheduler:
         real first token was already recorded in an earlier slot life."""
         t0 = t_enq.pop(st.req.request_id, None)
         if t0 is not None and not st.prior:
-            _append_bounded(self._ttft, time.time() - t0)
+            now = time.time()
+            self._h_ttft.observe(now - t0)
+            if self._tr:
+                self._tr.instant("first_token", ts=now,
+                                 tid=req_tid(st.req.request_id))
 
     def _trim_tokens(self, gen: list[int], max_new: int, stop):
         gen = gen[:max_new]
@@ -799,6 +914,7 @@ class ContinuousScheduler:
         freed-row invariant applied).  Shared by _maybe_finish and the
         cancel sweep so finish semantics can never diverge."""
         st = slots[b]
+        now = time.time()
         results[st.req.request_id] = GenerationResult(
             request_id=st.req.request_id,
             text=text,
@@ -806,8 +922,18 @@ class ContinuousScheduler:
             completion_tokens=len(gen),
             finish_reason=finish_reason,
             stop_sequence=stop_hit,
-            device_seconds=time.time() - st.t_start,
+            device_seconds=now - st.t_start,
         )
+        if self._tr:
+            tid = req_tid(st.req.request_id)
+            if st.t_decode_start:  # close the decode span of this slot life
+                self._tr.complete("decode", st.t_decode_start, now, tid=tid,
+                                  args={"completion_tokens": len(gen)})
+            self._tr.instant(
+                "cancel" if finish_reason == "cancelled" else "finish",
+                ts=now, tid=tid,
+                args={"reason": finish_reason,
+                      "completion_tokens": len(gen)})
         if fresh is not None:
             fresh.append(st.req.request_id)
         self.cache.close_sequence(st.seq)
@@ -1028,7 +1154,7 @@ class ContinuousScheduler:
                     if victim is None:
                         stalled.append(b)
                         active[b] = False
-                        self.metrics["stalls"] += 1
+                        self._c_stalls.inc()
                         break
                     self._preempt(victim, slots, queue, kv_lens, last_tok,
                                   active)
@@ -1098,7 +1224,17 @@ class ContinuousScheduler:
         active[b] = False
         kv_lens[b] = 0  # same invariant as admission/_maybe_finish: a freed
         last_tok[b] = 0  # row must never carry a stale length into a kernel
-        self.metrics["preemptions"] += 1
+        self._c_preemptions.inc()
+        if self._tr:
+            now = time.time()
+            tid = req_tid(st.req.request_id)
+            if st.t_decode_start:  # close this slot life's decode span
+                self._tr.complete("decode", st.t_decode_start, now, tid=tid,
+                                  args={"preempted": True})
+            self._tr.instant("preempt", ts=now, tid=tid,
+                             args={"slot": b,
+                                   "generated_so_far": len(st.prior)
+                                   + len(st.generated)})
         logger.debug("preempted slot %d (request %d) under page pressure",
                      b, st.req.request_id)
 
@@ -1242,7 +1378,15 @@ class ContinuousScheduler:
                 tps[row] = min(max(st.req.top_p, 0.0), 1.0)
                 srows[row] = b
                 st.prefill_pos = pos + len(chunk)
-                self.metrics["prefill_tokens"] += len(chunk)
+                self._c_prefill_tokens.inc(len(chunk))
+            batch_tokens = sum(len(c) for _, _, c, _, _ in items)
+            self._h_prefill_batch.observe(batch_tokens)
+            if self._tr:
+                self._tr.instant("prefill_dispatch",
+                                 args={"rows": len(items),
+                                       "tokens": batch_tokens,
+                                       "bucket": s_bucket,
+                                       "fresh": bool(fresh)})
             self._key, sub = jax.random.split(self._key)
             args = (
                 self.params, self.cache.k, self.cache.v,
@@ -1340,8 +1484,13 @@ class ContinuousScheduler:
             tps[si] = min(max(st.req.top_p, 0.0), 1.0)
             srows[si] = b
             st.prefill_pos = n
-            self.metrics["prefill_tokens"] += n
+            self._c_prefill_tokens.inc(n)
             off += n
+        self._h_prefill_batch.observe(s_real)
+        if self._tr:
+            self._tr.instant("prefill_dispatch",
+                             args={"rows": len(items), "tokens": s_real,
+                                   "bucket": s_bucket, "packed": True})
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
@@ -1695,7 +1844,7 @@ class ContinuousScheduler:
             for s in range(counts.shape[1]):
                 c = int(counts[b, s])
                 row.extend(int(t) for t in toks[b, s, :c])
-                self.metrics["spec_accepted_tokens"] += max(0, c - 1)
+                self._c_spec_accepted.inc(max(0, c - 1))
             emitted.append(row)
         return emitted
 

@@ -1,0 +1,41 @@
+"""Unified telemetry: lifecycle tracing + metric registry + exposition.
+
+Dependency-free (stdlib + numpy).  See docs/OBSERVABILITY.md for the
+metric catalog and how to open an exported trace in Perfetto.
+"""
+
+from lmrs_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS_S,
+    POW2_TOKEN_BUCKETS,
+    RATIO_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    add_label_to_exposition,
+    log_buckets,
+    merge_expositions,
+)
+from lmrs_tpu.obs.trace import (
+    PID_ENGINE,
+    PID_PIPELINE,
+    TID_SCHED,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    export_current,
+    get_tracer,
+    req_tid,
+    validate_trace_events,
+    validate_trace_file,
+)
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_S", "POW2_TOKEN_BUCKETS", "RATIO_BUCKETS",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "add_label_to_exposition", "log_buckets", "merge_expositions",
+    "PID_ENGINE", "PID_PIPELINE", "TID_SCHED", "Tracer",
+    "disable_tracing", "enable_tracing", "export_current", "get_tracer",
+    "req_tid",
+    "validate_trace_events", "validate_trace_file",
+]

@@ -1,0 +1,216 @@
+"""Request-lifecycle tracer: bounded ring buffer of Chrome trace events.
+
+One process-wide tracer (enabled explicitly — ``--trace-out`` on the CLI /
+bench, or ``enable_tracing()`` in tests) records span events as plain
+dicts in a ``deque(maxlen=...)``: recording is an O(1) append, dropping is
+oldest-first, and a disabled tracer costs one ``None`` check at each call
+site — the ≤2% overhead budget is met by never formatting or allocating
+when tracing is off.
+
+Event vocabulary (the per-request chain the scheduler emits):
+
+    enqueue → admit → [prefix_match] → prefill → first_token
+        → decode_block* → finish | preempt | cancel
+
+plus scheduler-track ``decode_block``/``prefill_dispatch`` dispatch spans
+and pipeline-track ``map_stage``/``reduce_level``/stage spans.  Export is
+Chrome trace-event JSON (``{"traceEvents": [...]}``) loadable directly in
+Perfetto / chrome://tracing; ``validate_trace_file`` checks the fields
+Perfetto requires and is shared by the tests and the CI trace-export gate.
+
+Track layout: pid 1 = engine (tid 0 the scheduler dispatch track, tid
+10+request_id one track per request), pid 2 = pipeline stages.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from pathlib import Path
+
+PID_ENGINE = 1
+PID_PIPELINE = 2
+TID_SCHED = 0
+REQ_TID_BASE = 10  # request_id -> tid offset (tid 0..9 reserved for tracks)
+
+_PHASES = {"X", "i", "I", "B", "E", "M", "C"}
+
+
+def req_tid(request_id: int) -> int:
+    return REQ_TID_BASE + request_id
+
+
+class Tracer:
+    """Bounded in-memory trace recorder (thread-safe: deque.append is
+    atomic, and writers only append)."""
+
+    def __init__(self, capacity: int = 262_144):
+        self.capacity = capacity
+        self._events: deque[dict] = deque(maxlen=capacity)
+        self.recorded = 0  # total ever recorded (recorded - len = dropped)
+        self._track_names: dict[tuple[int, int], str] = {}
+        self._process_names: dict[int, str] = {
+            PID_ENGINE: "lmrs-engine", PID_PIPELINE: "lmrs-pipeline"}
+        self.name_track(PID_ENGINE, TID_SCHED, "scheduler dispatches")
+        self.name_track(PID_PIPELINE, TID_SCHED, "stages")
+
+    # ------------------------------------------------------------- recording
+
+    def instant(self, name: str, ts: float | None = None, *,
+                tid: int = TID_SCHED, pid: int = PID_ENGINE,
+                args: dict | None = None) -> None:
+        """Point event at ``ts`` (seconds, default now)."""
+        ev = {"name": name, "ph": "i", "s": "t",
+              "ts": (time.time() if ts is None else ts) * 1e6,
+              "pid": pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+        self.recorded += 1
+
+    def complete(self, name: str, t0: float, t1: float, *,
+                 tid: int = TID_SCHED, pid: int = PID_ENGINE,
+                 args: dict | None = None) -> None:
+        """Span [t0, t1] (seconds since epoch, same clock as instant)."""
+        ev = {"name": name, "ph": "X", "ts": t0 * 1e6,
+              "dur": max(t1 - t0, 0.0) * 1e6, "pid": pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+        self.recorded += 1
+
+    def name_track(self, pid: int, tid: int, name: str) -> None:
+        """Label a track (kept outside the ring so names survive overflow)."""
+        self._track_names[(pid, tid)] = name
+
+    def clear(self) -> None:
+        self._events.clear()
+        self.recorded = 0
+
+    # --------------------------------------------------------------- reading
+
+    def events(self) -> list[dict]:
+        return list(self._events)
+
+    def timestamps(self, name: str, tid: int | None = None,
+                   ph: str | None = None) -> list[float]:
+        """Start timestamps (seconds, sorted) of retained events named
+        ``name``, optionally filtered by track/phase — the dispatch-gap
+        analysis hook (scripts/decode_latency.py; successor of the
+        LMRS_TRACE_DISPATCH list: ``timestamps("decode_block",
+        tid=TID_SCHED)`` is exactly the old per-dispatch list)."""
+        return sorted(e["ts"] / 1e6 for e in self._events
+                      if e["name"] == name
+                      and (tid is None or e["tid"] == tid)
+                      and (ph is None or e["ph"] == ph))
+
+    def spans_by_tid(self, pid: int = PID_ENGINE) -> dict[int, list[dict]]:
+        """Events grouped per track, each track ts-sorted (test helper)."""
+        out: dict[int, list[dict]] = {}
+        for e in self._events:
+            if e["pid"] == pid:
+                out.setdefault(e["tid"], []).append(e)
+        for evs in out.values():
+            evs.sort(key=lambda e: e["ts"])
+        return out
+
+    # --------------------------------------------------------------- export
+
+    def export(self, path: str | Path) -> int:
+        """Write Chrome trace-event JSON; returns the event count written.
+        Metadata (process/thread names) is regenerated on every export so
+        ring overflow can never drop it."""
+        meta: list[dict] = []
+        for pid, name in self._process_names.items():
+            meta.append({"name": "process_name", "ph": "M", "ts": 0,
+                         "pid": pid, "tid": 0, "args": {"name": name}})
+        for (pid, tid), name in self._track_names.items():
+            meta.append({"name": "thread_name", "ph": "M", "ts": 0,
+                         "pid": pid, "tid": tid, "args": {"name": name}})
+        events = meta + list(self._events)
+        payload = {"displayTimeUnit": "ms", "traceEvents": events}
+        Path(path).write_text(json.dumps(payload), encoding="utf-8")
+        return len(events)
+
+
+# ------------------------------------------------------------ global tracer
+
+_tracer: Tracer | None = None
+
+
+def get_tracer() -> Tracer | None:
+    """The process tracer, or None when tracing is off (call sites guard
+    with ``if tr:`` — the disabled path must stay allocation-free)."""
+    return _tracer
+
+
+def enable_tracing(capacity: int = 262_144) -> Tracer:
+    """Install (or return the existing) process tracer."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer(capacity=capacity)
+    return _tracer
+
+
+def disable_tracing() -> None:
+    global _tracer
+    _tracer = None
+
+
+def export_current(path: str | Path) -> tuple[int | None, str | None]:
+    """Export the process tracer (if any) to ``path`` without ever raising:
+    returns (event_count, None) on success, (None, reason) otherwise.  The
+    one exit-path export helper shared by the CLI and bench — both export
+    in a ``finally`` where a raise would mask the run's real error."""
+    tr = get_tracer()
+    if tr is None:
+        return None, "tracing was not enabled"
+    try:
+        return tr.export(path), None
+    except Exception as e:  # noqa: BLE001 - includes serialization errors;
+        return None, str(e)  # a raise here would mask the run's real error
+
+
+# ----------------------------------------------------------------- validation
+
+
+def validate_trace_events(events: list) -> list[dict]:
+    """Schema-check a trace-event list against what Perfetto requires:
+    every event carries ``name``/``ph``/``ts``/``pid``/``tid``, ``X``
+    events carry a non-negative ``dur``, ``M`` events carry ``args.name``.
+    Returns the events; raises ValueError with the first offender."""
+    if not isinstance(events, list) or not events:
+        raise ValueError("trace has no events")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i} is not an object: {ev!r}")
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                raise ValueError(f"event {i} missing {key!r}: {ev}")
+        if not isinstance(ev["name"], str) or not ev["name"]:
+            raise ValueError(f"event {i} has a non-string name: {ev}")
+        if ev["ph"] not in _PHASES:
+            raise ValueError(f"event {i} has unknown phase {ev['ph']!r}")
+        if not isinstance(ev["ts"], (int, float)):
+            raise ValueError(f"event {i} has non-numeric ts: {ev}")
+        if not isinstance(ev["pid"], int) or not isinstance(ev["tid"], int):
+            raise ValueError(f"event {i} has non-int pid/tid: {ev}")
+        if ev["ph"] == "X" and (not isinstance(ev.get("dur"), (int, float))
+                                or ev["dur"] < 0):
+            raise ValueError(f"event {i}: X event needs dur >= 0: {ev}")
+        if ev["ph"] == "M" and "name" not in (ev.get("args") or {}):
+            raise ValueError(f"event {i}: metadata event needs args.name")
+    return events
+
+
+def validate_trace_file(path: str | Path) -> list[dict]:
+    """Load + schema-check an exported trace (the CI trace-export gate)."""
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    if isinstance(data, dict):
+        events = data.get("traceEvents")
+        if events is None:
+            raise ValueError("trace JSON object lacks 'traceEvents'")
+    else:
+        events = data
+    return validate_trace_events(events)

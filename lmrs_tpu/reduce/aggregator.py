@@ -29,6 +29,7 @@ from lmrs_tpu.data.preprocessor import format_timestamp
 from lmrs_tpu.data.tokenizer import Tokenizer, get_tokenizer
 from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.obs import PID_PIPELINE, get_tracer
 from lmrs_tpu.prompts import (
     DEFAULT_BATCH_REDUCE_PROMPT,
     DEFAULT_FINAL_REDUCE_PROMPT,
@@ -85,9 +86,11 @@ class ResultAggregator:
         if hierarchical:
             summary, levels = self._hierarchical(summaries, prompt_template, metadata)
         else:
+            t_level = time.time()
             summary = self._reduce_once(
                 summaries, prompt_template or DEFAULT_REDUCE_PROMPT, metadata
             )
+            self._trace_level(1, 1, t_level)
             levels = 1
         return {
             "final_summary": summary,
@@ -195,13 +198,27 @@ class ResultAggregator:
                 jobs.append(
                     (batch, prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, batch_meta)
                 )
+            t_level = time.time()
             current = self._reduce_wave(jobs)
+            self._trace_level(level, len(batches), t_level)
         if len(current) == 1:
             return current[0], level
+        t_final = time.time()
         final = self._reduce_once(
             current, prompt_template or DEFAULT_FINAL_REDUCE_PROMPT, metadata
         )
+        self._trace_level(level + 1, 1, t_final)
         return final, level + 1
+
+    @staticmethod
+    def _trace_level(level: int, batches: int, t0: float) -> None:
+        """One ``reduce_level`` span per tree level on the pipeline track
+        (obs/trace.py) — the per-level attribution the stage-total reduce
+        timing cannot give."""
+        tr = get_tracer()
+        if tr:
+            tr.complete("reduce_level", t0, time.time(), pid=PID_PIPELINE,
+                        args={"level": level, "batches": batches})
 
     def _calculate_batch_size(self, summaries: list[str]) -> int:
         """Token-budgeted batch size, capped (result_aggregator.py:357-380)."""

@@ -275,6 +275,20 @@ class StreamingMapReduce:
         self.executor.run_requests_streaming(map_requests, on_final)
 
         t_end = time.time()
+        from lmrs_tpu.obs import PID_PIPELINE, get_tracer
+
+        tr = get_tracer()
+        if tr:
+            # streaming has no barrier, so the spans OVERLAP by design:
+            # map_stage ends at the last map summary, reduce_tail is the
+            # stream beyond it — the overlap window is visible in Perfetto
+            tr.complete("map_stage", t0, st["t_map_done"] or t_end,
+                        pid=PID_PIPELINE,
+                        args={"chunks": len(todo), "streaming": True})
+            if st["first_reduce_t"] is not None:
+                tr.complete("reduce_stream", st["first_reduce_t"], t_end,
+                            pid=PID_PIPELINE, tid=1,
+                            args={"levels": max(st["levels"], 1)})
         if st["final"] is None:  # defensive: stream ended without a final
             logger.error("stream ended without a final summary; falling back "
                          "to barrier reduce")

@@ -193,8 +193,12 @@ class RouterEngine:
                 conn.request("GET", "/metrics")
                 resp = conn.getresponse()
                 row["metrics"] = json.loads(resp.read())
-            except Exception:  # noqa: BLE001 - metrics are best-effort
-                pass
+            except Exception as e:  # noqa: BLE001 - metrics are best-effort
+                # but never SILENT: a dead backend must be visible in the
+                # aggregate, not just missing its metrics block
+                logger.debug("metrics fetch failed for %s: %s: %s",
+                             h.netloc, type(e).__name__, e)
+                row["metrics_unreachable"] = True
             finally:
                 if conn is not None:
                     conn.close()
@@ -202,6 +206,79 @@ class RouterEngine:
         return {"hosts": len(self.hosts),
                 "healthy_hosts": sum(h.healthy for h in self.hosts),
                 "per_host": per}
+
+    def prometheus_metrics(self) -> str:
+        """Fleet-wide Prometheus exposition: each backend's text-format
+        ``/metrics`` page relabeled with ``host=<netloc>`` so per-host
+        series never collide, merged with HELP/TYPE dedup, plus the
+        router's own per-host series (same label):
+        ``lmrs_router_host_up`` (the router's request-path health belief),
+        ``lmrs_router_host_scrape_ok`` (did THIS scrape fetch the host's
+        page — the alertable signal for a backend that is routable but
+        unscrapeable), and served/failed counters.  Backends are scraped
+        CONCURRENTLY on the dispatch pool — serial 2 s connect timeouts
+        would stack past a scraper's own deadline and fail the whole
+        fleet page; hosts already marked unhealthy are not scraped at all
+        (they still appear through the router-side series)."""
+        from lmrs_tpu.obs import (MetricsRegistry, add_label_to_exposition,
+                                  merge_expositions)
+
+        def scrape(h: _Host) -> str | None:
+            conn = None
+            try:
+                conn = h.connect(timeout=2.0)
+                conn.request("GET", "/metrics",
+                             headers={"Accept": "text/plain"})
+                resp = conn.getresponse()
+                body = resp.read().decode("utf-8", "replace")
+                ctype = resp.getheader("Content-Type", "")
+                if resp.status == 200 and "text/plain" in ctype:
+                    return body
+                logger.debug("host %s served no Prometheus page "
+                             "(status %s, type %s)", h.netloc, resp.status,
+                             ctype)
+            except Exception as e:  # noqa: BLE001 - scrape is best-effort
+                logger.debug("prometheus scrape failed for %s: %s: %s",
+                             h.netloc, type(e).__name__, e)
+            finally:
+                if conn is not None:
+                    conn.close()
+            return None
+
+        import time as _time
+
+        futures = {h: self._pool.submit(scrape, h)
+                   for h in self.hosts if h.healthy}
+        # ONE deadline across the gather: per-future timeouts would stack
+        # back into the serial worst case whenever the dispatch pool is
+        # saturated by in-flight generation (futures queued, not running)
+        deadline = _time.time() + 3.0
+        bodies: dict[str, str | None] = {}
+        for h, fut in futures.items():
+            try:
+                bodies[h.netloc] = fut.result(
+                    timeout=max(0.0, deadline - _time.time()))
+            except Exception:  # noqa: BLE001 - timeout / pool saturation
+                bodies[h.netloc] = None
+        pages: list[str] = []
+        for h in self.hosts:
+            body = bodies.get(h.netloc)
+            if body is not None:
+                pages.append(add_label_to_exposition(body, "host", h.netloc))
+            reg = MetricsRegistry()
+            reg.gauge("lmrs_router_host_up",
+                      "1 when the router considers the host healthy "
+                      "(request-path belief)").set(float(h.healthy))
+            reg.gauge("lmrs_router_host_scrape_ok",
+                      "1 when this scrape fetched the host's metrics "
+                      "page").set(float(body is not None))
+            reg.counter("lmrs_router_host_served_total",
+                        "requests completed on this host").inc(h.served)
+            reg.counter("lmrs_router_host_failed_total",
+                        "requests failed on this host").inc(h.failed)
+            pages.append(add_label_to_exposition(
+                reg.render_prometheus(), "host", h.netloc))
+        return merge_expositions(pages)
 
     # ------------------------------------------------------------ internals
 

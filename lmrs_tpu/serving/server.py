@@ -348,9 +348,14 @@ class EngineHTTPServer:
                 logger.debug("%s " + fmt, self.address_string(), *args)
 
             def _send(self, code: int, payload: dict) -> None:
-                data = json.dumps(payload).encode()
+                self._send_text(code, json.dumps(payload),
+                                "application/json")
+
+            def _send_text(self, code: int, text: str,
+                           content_type: str) -> None:
+                data = text.encode("utf-8")
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -371,6 +376,16 @@ class EngineHTTPServer:
                         {"id": outer.model_name, "object": "model",
                          "owned_by": "lmrs-tpu"}]})
                 elif self.path == "/metrics":
+                    # content negotiation: Prometheus text for scrapers
+                    # (Accept: text/plain / OpenMetrics), the original JSON
+                    # report otherwise — existing clients (the router's
+                    # aggregate, the tests) keep their wire format
+                    accept = self.headers.get("Accept", "") or ""
+                    if "text/plain" in accept or "openmetrics" in accept:
+                        self._send_text(
+                            200, outer.prometheus_text(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        return
                     self._send(200, {
                         "engine": outer.engine.engine_metrics(),
                         "http_batches": outer.batcher.batches_run,
@@ -625,6 +640,39 @@ class EngineHTTPServer:
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address[:2]
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition for ``GET /metrics`` with ``Accept:
+        text/plain``: the engine's typed registry (optional Engine hooks —
+        ``prometheus_metrics()`` for aggregating engines like the router,
+        ``metrics_registry()`` for scheduler-backed ones) plus this
+        server's own HTTP counters.  Parts merge through
+        ``merge_expositions``: a router-backed engine's fleet page carries
+        the SAME family names as this server's own counters (every
+        backend is an EngineHTTPServer too), and the text format demands
+        one HELP/TYPE header per family with contiguous samples."""
+        from lmrs_tpu.obs import MetricsRegistry, merge_expositions
+
+        parts: list[str] = []
+        prom = getattr(self.engine, "prometheus_metrics", None)
+        reg_fn = getattr(self.engine, "metrics_registry", None)
+        if prom is not None:
+            parts.append(prom())
+        elif reg_fn is not None:
+            reg = reg_fn()
+            if reg is not None:
+                parts.append(reg.render_prometheus())
+        http_reg = MetricsRegistry()
+        c = http_reg.counter("lmrs_http_batches_total",
+                             "engine waves dispatched by the micro-batcher")
+        c.inc(self.batcher.batches_run)
+        c = http_reg.counter("lmrs_http_requests_total",
+                             "HTTP requests served through the batcher")
+        c.inc(self.batcher.requests_served)
+        g = http_reg.gauge("lmrs_uptime_seconds", "server uptime", "seconds")
+        g.set(time.time() - self.started)
+        parts.append(http_reg.render_prometheus())
+        return merge_expositions(parts)
 
     def serve_forever(self) -> None:
         logger.info("serving on http://%s:%d (model=%s)",

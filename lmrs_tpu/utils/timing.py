@@ -44,7 +44,15 @@ class StageTimer:
         start = time.time()
         with ctx:
             yield
-        self.spans[name] = self.spans.get(name, 0.0) + (time.time() - start)
+        end = time.time()
+        self.spans[name] = self.spans.get(name, 0.0) + (end - start)
+        # mirror the stage into the lifecycle tracer's pipeline track (the
+        # engine-level spans nest under these in Perfetto)
+        from lmrs_tpu.obs import PID_PIPELINE, get_tracer
+
+        tr = get_tracer()
+        if tr:
+            tr.complete(name, start, end, pid=PID_PIPELINE)
 
     @property
     def total(self) -> float:

@@ -1,30 +1,30 @@
 """Decode-latency benchmark for the prefill_chunk default (VERDICT r1
 item 10): distribution of decode-dispatch gaps for already-active slots
 while a long prompt admits mid-stream, chunked (512) vs one-dispatch
-(4096) prefill.  Run: python scripts/decode_latency.py
+(4096) prefill.  Dispatch timestamps come from the lifecycle tracer's
+``decode_block`` span starts (obs/trace.py — the one dispatch-timestamp
+path; the LMRS_TRACE_DISPATCH env hack this script used to flip is gone).
+Run: python scripts/decode_latency.py
 """
-import os
 import time
-
 
 import _pathfix  # noqa: F401  (repo-root import shim)
 import numpy as np
 
-os.environ["LMRS_TRACE_DISPATCH"] = "1"
-
 from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.obs import TID_SCHED, enable_tracing
 from lmrs_tpu.utils.logging import setup_logging
 
 
 def run(prefill_chunk, label):
+    tracer = enable_tracing()
     model = model_preset("bench-1b")
     eng = JaxEngine(EngineConfig(
         backend="jax", max_tokens=256, max_batch_slots=8,
         retry_delay=0.0, seed=0, page_size=512, num_pages=1,
         decode_block=8, prefill_chunk=prefill_chunk), model)
-    sched = eng._scheduler
     rng = np.random.default_rng(0)
     # 6 active decoders (short prompts, long decodes)
     active = [GenerationRequest(
@@ -36,11 +36,11 @@ def run(prefill_chunk, label):
         request_id=100 + i, temperature=0.5, max_new_tokens=8)
         for i in range(8)]
     eng.generate_batch(active[:2])  # warm compile
-    sched._trace_dispatch.clear()
+    tracer.clear()  # drop warmup dispatches (compile-time gaps)
     t0 = time.time()
     eng.generate_batch(active + longs)
     wall = time.time() - t0
-    ts = np.asarray(sched._trace_dispatch)
+    ts = np.asarray(tracer.timestamps("decode_block", tid=TID_SCHED))
     gaps = np.diff(ts) * 1e3
     print(f"{label}: wall={wall:.1f}s dispatches={len(ts)} "
           f"gap p50={np.percentile(gaps, 50):.0f}ms "
