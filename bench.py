@@ -283,6 +283,14 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     except Exception as e:  # pragma: no cover - chip-side failure path
         print(f"roofline microbench failed: {e!r}", file=sys.stderr)
         roofline = {"roofline_error": str(e)[:200]}
+    # multi-row page walk attribution: per-row kernel cost grouped vs
+    # per-row (RTT-amortized chains; {} off-TPU) — the measured, not
+    # asserted, per-row gain the grouped dispatch buys
+    try:
+        roofline.update(sched.rowcost_microbench())
+    except Exception as e:  # pragma: no cover - chip-side failure path
+        print(f"rowcost microbench failed: {e!r}", file=sys.stderr)
+        roofline["rowcost_error"] = str(e)[:200]
 
     # Timed region, repeated: the tunneled link's weather produces 2-7x
     # run-to-run spread on identical code; the median + per-rep values let
@@ -332,9 +340,16 @@ def _scheduler_window(sched, before: dict) -> dict:
     occ = ((m["occupancy_sum"] - before["occupancy_sum"]) / d_disp
            if d_disp else 0.0)
     report = sched.metrics_report()  # latency pct reset at window start
+    g_disp = (m["group_dispatches"] - before["group_dispatches"])
+    g_occ = ((m["group_occupancy_sum"] - before["group_occupancy_sum"])
+             / g_disp if g_disp else 0.0)
     return {
         "mean_decode_occupancy": round(occ, 3),
         "decode_dispatches": d_disp,
+        # multi-row kernel: configured group size and live-rows-over-group-
+        # capacity occupancy over the timed window (1.0 = no padding waste)
+        "decode_row_group": getattr(sched, "_row_group", 1),
+        "mean_group_occupancy": round(g_occ, 3),
         "stalls": m["stalls"] - before["stalls"],
         "preemptions": m["preemptions"] - before["preemptions"],
         # device-wait vs host-bookkeeping split of the SCHEDULER wall over

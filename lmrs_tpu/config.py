@@ -156,6 +156,18 @@ class EngineConfig:
     # worst-case tail fairness under very long prompts outweighs both.
     prefill_chunk: int = 4096
     decode_block: int = 16  # decode steps per host sync (see scheduler)
+    # Multi-row decode page walk (ops/paged_attention.py): each ragged
+    # decode program walks `decode_row_group` batch rows' live pages
+    # through one shared double-buffered DMA pipeline, amortizing the
+    # per-program fixed cost that one-row-per-program dispatch pays per
+    # row (~2.8 ms of the 8B decode step; docs/PERF.md r5 intercept
+    # decomposition).  The scheduler length-balances the row→group
+    # assignment per dispatch and clamps to the slot count.
+    # LMRS_MULTIROW=0 is the kill switch (per-row grid, exact previous
+    # behavior — same A/B convention as LMRS_PACK_PREFILL);
+    # LMRS_DECODE_ROW_GROUP overrides the group size.
+    decode_row_group: int = field(
+        default_factory=lambda: _env("LMRS_DECODE_ROW_GROUP", 4, int))
     # prompt-lookup speculative decoding: draft length per step (0 = off).
     # Exact-distribution verify (ops/speculative.py) — output quality is
     # unchanged; latency drops when summaries quote their source.
@@ -201,6 +213,10 @@ class EngineConfig:
         if self.kv_quantize not in (None, "int8"):
             raise ValueError(f"unknown kv_quantize mode {self.kv_quantize!r}; "
                              "supported: int8")
+        if self.decode_row_group < 1:
+            raise ValueError(f"decode_row_group must be >= 1 "
+                             f"(got {self.decode_row_group}); use "
+                             "LMRS_MULTIROW=0 to disable row grouping")
 
 
 @dataclass

@@ -51,14 +51,35 @@ def _bf16_tree_gb(cfg: ModelConfig) -> float:
     the device-init feasibility test for quantized random weights.
     ``matmul_params`` counts only ACTIVATED experts (its per-token-work
     purpose); init materializes ALL of them, so the resident-MoE
-    remainder is added back."""
+    remainder is added back.  ``matmul_params`` also always counts the
+    [D, V] LM head (it is a matmul whether tied or not), but a TIED
+    model's tree holds ONE [V, D] matrix serving both embedding and head
+    — subtract the head term or e.g. gemma-2b's estimate carries a
+    phantom 1.05 GB and trips the 6.0 GB host-init gate early (ADVICE
+    r5)."""
     from lmrs_tpu.utils.perf_model import matmul_params
 
     n = matmul_params(cfg) + cfg.vocab_size * cfg.dim
+    if cfg.tie_embeddings:
+        n -= cfg.vocab_size * cfg.dim
     if cfg.n_experts:
         n += (cfg.n_layers * 3 * cfg.dim * cfg.hidden_dim
               * (cfg.n_experts - cfg.n_experts_per_token))
     return n * 2 / 1e9
+
+
+def needs_host_quant_init(cfg: ModelConfig, quantize: str | None) -> bool:
+    """True when random-init weights must be built int8 on the HOST
+    (numpy) instead of full-precision on the device: the engine asked for
+    weight quantization AND the bf16 tree is too big to ever materialize
+    on one chip (or anywhere, under the axon tunnel — no jax CPU backend
+    to stage it on).  THE one implementation of the gate: JaxEngine and
+    ReplicatedEngine both route through it, so the 6.0 GB threshold and
+    the tied-embedding accounting cannot drift between the two engines
+    (ADVICE r5).  Small quantized models deliberately keep the device
+    init — the host RNG draws DIFFERENT weights, which silently changed
+    the 1B bench workload once (docs/PERF.md round 5)."""
+    return bool(quantize) and _bf16_tree_gb(cfg) > 6.0
 
 
 def _bucket(n: int, lo: int = 64) -> int:
@@ -119,8 +140,7 @@ class JaxEngine:
                     "no checkpoint for %s: using random-init weights "
                     "(throughput-correct, content-free)", model_cfg.name,
                 )
-                big = _bf16_tree_gb(model_cfg) > 6.0
-                if engine_cfg.quantize and big:
+                if needs_host_quant_init(model_cfg, engine_cfg.quantize):
                     # quantized random init builds the int8 tree directly
                     # on the HOST (numpy): the full-precision tree of an
                     # 8B-shape model (16 GB bf16) cannot coexist with
@@ -356,6 +376,9 @@ class JaxEngine:
             def body(state):
                 step, key, last, cache, out_buf, done, n_gen = state
                 key, sub = jax.random.split(key)
+                # while_loop context, NOT vmap: sample_logits' lax.cond
+                # fast paths would silently degrade to select-both-
+                # branches under vmap (ops/sampling.py NOTE)
                 tok = sample_logits(last, sub, temps, top_k, top_p)
                 tok = jnp.where(done, eos_id, tok)
                 out_buf = out_buf.at[:, step].set(tok)

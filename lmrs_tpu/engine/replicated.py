@@ -29,7 +29,7 @@ import jax
 
 from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
 from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
-from lmrs_tpu.engine.jax_engine import _bf16_tree_gb
+from lmrs_tpu.engine.jax_engine import needs_host_quant_init
 
 logger = logging.getLogger("lmrs.replicated")
 
@@ -66,14 +66,15 @@ class ReplicatedEngine:
             from lmrs_tpu.models.loader import load_checkpoint
 
             shared = load_checkpoint(engine_cfg.checkpoint_path, model_cfg)
-        elif engine_cfg.quantize and _bf16_tree_gb(model_cfg) > 6.0:
+        elif needs_host_quant_init(model_cfg, engine_cfg.quantize):
             # quantized random init builds the int8 tree host-side (numpy)
             # without ever materializing the full-precision tree — at 8B
             # shape that tree would OOM the default device, and under the
             # axon tunnel there is no jax CPU backend to stage it on.
-            # SAME size gate as JaxEngine: small quantized models keep the
-            # device init so the random-weight workload matches the
-            # single-engine path exactly (replica-vs-single comparability)
+            # SHARED gate with JaxEngine (needs_host_quant_init): small
+            # quantized models keep the device init so the random-weight
+            # workload matches the single-engine path exactly
+            # (replica-vs-single comparability)
             from lmrs_tpu.ops.quant import random_quantized_init
 
             logger.warning("no checkpoint for %s: replicas share random-init "

@@ -156,6 +156,17 @@ class ContinuousScheduler:
         self._interpret = (os.environ.get("LMRS_FORCE_KERNELS", "").lower()
                            == "interpret")
         self._use_ragged = self._pick_kernel()
+        # Multi-row decode page walk (ops/paged_attention.py): G batch rows
+        # per ragged-decode program, sharing one DMA pipeline — amortizes
+        # the per-row program fixed cost that dominated the 8B decode
+        # intercept (docs/PERF.md r5).  Dispatches permute rows through a
+        # host-side length-balanced assignment (balanced_row_order) so a
+        # straggler row cannot serialize its group.  LMRS_MULTIROW=0 is
+        # the kill switch (exact per-row grid + unpermuted dispatch, the
+        # LMRS_PACK_PREFILL A/B convention).
+        self._row_group = 1
+        if os.environ.get("LMRS_MULTIROW", "1") != "0":
+            self._row_group = max(1, min(engine_cfg.decode_row_group, self.B))
         # flash prefill: same tp-only-mesh limit as the ragged gate (under a
         # mesh the kernel runs via shard_map over the tp head axis); also
         # cleared if lowering fails at runtime
@@ -324,6 +335,13 @@ class ContinuousScheduler:
                               buckets=RATIO_BUCKETS,
                               help="fraction of batch slots live per "
                                    "decode dispatch")
+        # multi-row kernel group occupancy: live rows over the dispatched
+        # group capacity (ceil(rows/G)*G) — the padding waste the
+        # row-group layout introduces; only observed when grouping is on
+        self._h_group_occupancy = h("lmrs_decode_group_occupancy_ratio",
+                                    buckets=RATIO_BUCKETS,
+                                    help="live rows over row-group "
+                                         "capacity per decode dispatch")
         self._tr = get_tracer()  # refreshed at each run()
 
     @property
@@ -347,6 +365,8 @@ class ContinuousScheduler:
             "prefix_queries": int(self._c_prefix_queries.value),
             "prefix_hits": int(self._c_prefix_hits.value),
             "prefix_tokens_reused": int(self._c_prefix_tokens.value),
+            "group_occupancy_sum": self._h_group_occupancy.sum,
+            "group_dispatches": int(self._h_group_occupancy.count),
         }
 
     def metrics_registry(self) -> MetricsRegistry:
@@ -1120,6 +1140,67 @@ class ContinuousScheduler:
         out["kv_kb_per_token"] = round(kv_bytes_per_token(cfg_m) / 1e3, 1)
         return out
 
+    def rowcost_microbench(self, lo: int = 64, hi: int = 256,
+                           reps: int = 3) -> dict:
+        """Per-row fixed cost of the ragged decode attention at this
+        engine's exact shape (kv heads, head dim, page size, slot count),
+        grouped vs per-row — the bench-detail attribution for the
+        multi-row page walk.  One attention layer's fused kernel chained
+        inside a jitted ``fori_loop`` (output feeds the next q, pools ride
+        the carry), timed via the shared RTT-cancelling chain method
+        (utils/perf_model.time_chain — the same implementation
+        decode_rowcost.py uses, so the two probes' us/row numbers stay
+        comparable).
+
+        Probes standalone bf16 pools (one live page per row), never the
+        engine's own cache: it can run between waves without disturbing
+        live state.  Returns {} off-TPU or under a multi-device mesh —
+        interpret-mode chains would measure the emulator."""
+        from lmrs_tpu.utils.perf_model import time_chain
+        from lmrs_tpu.utils.platform import on_tpu
+
+        if not (self._use_ragged and on_tpu() and self._single_device()):
+            return {}
+        from lmrs_tpu.ops.paged_attention import paged_decode_pallas_fused
+
+        cfg_m = self.model_cfg
+        kh, hd, ps = cfg_m.n_kv_heads, cfg_m.hd, self.cfg.page_size
+        B = self.B
+        rng = np.random.default_rng(0)
+        q0 = jnp.asarray(rng.standard_normal((B, cfg_m.n_heads, hd)),
+                         jnp.bfloat16)
+        kn = jnp.asarray(rng.standard_normal((B, kh, hd)), jnp.bfloat16)
+        vn = jnp.asarray(rng.standard_normal((B, kh, hd)), jnp.bfloat16)
+        kp0 = jnp.asarray(rng.standard_normal((B + 1, kh, ps, hd)),
+                          jnp.bfloat16)
+        vp0 = jnp.asarray(rng.standard_normal((B + 1, kh, ps, hd)),
+                          jnp.bfloat16)
+        pt = jnp.asarray((1 + np.arange(B))[:, None], jnp.int32)
+        kl = jnp.full((B,), min(64, ps), jnp.int32)
+
+        def make_chain(iters: int, g: int):
+            @jax.jit
+            def chain(q, kp, vp):
+                def body(_, carry):
+                    q, kp, vp = carry
+                    out, kp, vp = paged_decode_pallas_fused(
+                        q, kn, vn, kp, vp, pt, kl, row_group=g)
+                    return (out.astype(q.dtype), kp, vp)
+
+                return jax.lax.fori_loop(0, iters, body, (q, kp, vp))
+
+            return lambda: chain(q0, kp0, vp0)[0]
+
+        out: dict = {"decode_row_group": self._row_group}
+        arms = {"per_row": 1}
+        if self._row_group > 1:
+            arms["grouped"] = self._row_group
+        for name, g in arms.items():
+            per_kernel = time_chain(
+                lambda iters, g=g: make_chain(iters, g), lo, hi, reps)
+            out[f"decode_row_us_{name}"] = round(per_kernel / B * 1e6, 3)
+        return out
+
     # ------------------------------------------- page growth / preemption
 
     def _ensure_decode_capacity(self, slots, queue, kv_lens, last_tok,
@@ -1695,12 +1776,6 @@ class ContinuousScheduler:
             c_tp[:n] = top_p[rows]
             last_tok, kv_lens, active = c_tok, c_len, c_act
             table, temps, top_k, top_p = c_tab, c_tmp, c_tk, c_tp
-        lt = jnp.asarray(last_tok)
-        for tok0_dev, prows in pending:  # on-device scatter, no host sync
-            idx = jnp.asarray(np.array([b for b, _ in prows], np.int32))
-            src = tok0_dev[jnp.asarray(np.array([r for _, r in prows], np.int32))]
-            lt = lt.at[idx].set(src)
-        self._key, sub = jax.random.split(self._key)
         # dispatch row -> slot for the KV scale buffers (compact-batch rows
         # are a gathered subset of slots; pad rows clamp harmlessly)
         if bc < B:
@@ -1708,6 +1783,48 @@ class ContinuousScheduler:
             srows[: len(rows)] = rows
         else:
             srows = np.arange(B, dtype=np.int32)
+        # Multi-row kernel: length-balance the row→group assignment so a
+        # straggler row can't serialize its group's shared DMA pipeline
+        # (ops/paged_attention.balanced_row_order).  Pure host-side numpy
+        # reorder of the dispatch rows; srows carries the slot mapping
+        # through, so scales and the result scatter-back need no special
+        # casing.  Greedy outputs are row-order-invariant; sampled rows
+        # draw different (equally valid) tokens — LMRS_MULTIROW=0 restores
+        # the unpermuted per-row dispatch exactly.
+        perm = None
+        if self._row_group > 1 and self._use_ragged:
+            # grouping lives in the ragged kernel only: the XLA fallback
+            # dispatch stays unpermuted (it has no groups to balance)
+            from lmrs_tpu.ops.paged_attention import balanced_row_order
+            # clamp to the dispatch width like the kernel does (compact
+            # drain can pin bc below the configured group size); an
+            # unclamped denominator would under-report occupancy exactly
+            # where operators read it to pick G
+            g = min(self._row_group, bc)
+            self._h_group_occupancy.observe(
+                len(rows) / (-(-bc // g) * g))
+            perm = balanced_row_order(np.where(active, kv_lens, 0), g)
+            if np.array_equal(perm, np.arange(len(perm))):
+                perm = None
+            else:
+                last_tok = last_tok[perm]
+                kv_lens = kv_lens[perm]
+                active = active[perm]
+                table = table[perm]
+                temps, top_k, top_p = temps[perm], top_k[perm], top_p[perm]
+                srows = srows[perm]
+        lt = jnp.asarray(last_tok)
+        for tok0_dev, prows in pending:  # on-device scatter, no host sync
+            idx = np.array([b for b, _ in prows], np.int32)
+            if perm is not None:
+                # pending tok0s target SLOTS; map to their dispatch rows
+                inv = np.empty(len(perm), np.int32)
+                inv[perm] = np.arange(len(perm), dtype=np.int32)
+                idx = inv[idx]
+            idx = jnp.asarray(idx)
+            src = tok0_dev[jnp.asarray(np.array([r for _, r in prows], np.int32))]
+            lt = lt.at[idx].set(src)
+        self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
             self.kscale, self.vscale, jnp.asarray(srows),
@@ -1735,11 +1852,15 @@ class ContinuousScheduler:
         toks, n_valid, *tok0s = self._timed_get(  # one transfer
             (toks, n_valid, *[t for t, _ in pending]))
         toks, n_valid = np.asarray(toks), np.asarray(n_valid)
-        if bc < B:  # scatter compact results back to full-width slot arrays
+        if bc < B or perm is not None:
+            # scatter compact and/or group-permuted results back to
+            # full-width slot arrays (srows maps dispatch row -> slot;
+            # rows >= B are compact-batch pads)
             full_t = np.zeros((B, toks.shape[1]), toks.dtype)
             full_n = np.zeros((B,), n_valid.dtype)
-            full_t[rows] = toks[: len(rows)]
-            full_n[rows] = n_valid[: len(rows)]
+            sel = srows < B
+            full_t[srows[sel]] = toks[sel]
+            full_n[srows[sel]] = n_valid[sel]
             return full_t, full_n, tok0s
         return toks, n_valid, tok0s
 
@@ -1754,6 +1875,7 @@ class ContinuousScheduler:
         use_ragged = self._use_ragged
         mesh_ = self._kernel_mesh()
         interp = self._interpret
+        row_group = self._row_group
 
         kv_q = bool(self._kv_quant)
 
@@ -1770,9 +1892,14 @@ class ContinuousScheduler:
                     mesh=mesh_, interpret=interp,
                     kv_scales=(kscale, vscale) if kv_q else None,
                     scale_rows=scale_rows if kv_q else None,
+                    decode_row_group=row_group,
                 )
                 logits, k_pages, v_pages = out[:3]
                 key, sub = jax.random.split(key)
+                # scan context, NOT vmap: sample_logits gates its full-
+                # vocab sort behind lax.cond fast paths that vmap would
+                # silently lower to compute-both-branches (ops/sampling.py;
+                # test_model.test_sampler_cond_survives_scheduler_contexts)
                 nxt = sample_logits(logits[:, 0], sub, temps, tk, tp)
                 nxt = jnp.where(done, eos_id, nxt)
                 newly_done = jnp.logical_or(done, nxt == eos_id)
@@ -1787,7 +1914,8 @@ class ContinuousScheduler:
             return toks, jnp.sum(valid, axis=1), k_pages, v_pages
 
         logger.info("compiling paged decode: B=%d steps=%d window=%d pages "
-                    "(ragged_kernel=%s)", self.B, n_steps, w, use_ragged)
+                    "(ragged_kernel=%s row_group=%d)", self.B, n_steps, w,
+                    use_ragged, row_group)
         self._decode_fns[w] = decode
         return decode
 
@@ -1813,6 +1941,17 @@ class ContinuousScheduler:
         no per-dispatch O(B*max_len) upload."""
         w, table = self._decode_window(slots,
                                        self.decode_block + self.spec_k)
+        # the verify kernel passes the grouping but not the balanced
+        # permutation: the token-history buffer is device-resident and
+        # slot-indexed, so rows dispatch in slot order here.  Same gate as
+        # _get_spec_decode_fn's use_ragged: under a multi-device mesh the
+        # verify runs the ungrouped XLA path, and a sample here would
+        # report padding waste for a dispatch that had no group layout
+        if (self._row_group > 1 and self._use_ragged
+                and self._kernel_mesh() is None):
+            g = self._row_group
+            self._h_group_occupancy.observe(
+                int(np.sum(active)) / (-(-self.B // g) * g))
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v, self._spec_buf,
@@ -1866,6 +2005,7 @@ class ContinuousScheduler:
         # is single-device everywhere else too.
         use_ragged = self._use_ragged and self._kernel_mesh() is None
         interp = self._interpret
+        row_group = self._row_group
         kv_q = bool(self._kv_quant)
 
         from lmrs_tpu.ops.sampling import filtered_probs
@@ -1899,10 +2039,15 @@ class ContinuousScheduler:
                     interpret=interp,
                     kv_scales=(kscale, vscale) if kv_q else None,
                     scale_rows=srows if kv_q else None,
+                    decode_row_group=row_group,
                 )
                 # scales are read-only in decode (frozen at prefill):
                 # out[3:] returns them unchanged when kv_q
                 logits, k_pages, v_pages = out[:3]
+                # filtered_probs is deliberately cond-free, so this vmap
+                # over the token axis is safe; sample_logits (lax.cond
+                # fast paths) must never be called under it
+                # (ops/sampling.py NOTE)
                 probs = jax.vmap(filtered_probs, in_axes=(1, None, None, None),
                                  out_axes=1)(logits, temps, tk, tp)
                 key, sub = jax.random.split(key)

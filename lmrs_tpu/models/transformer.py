@@ -325,6 +325,9 @@ def forward_paged(
     scale_rows: jnp.ndarray | None = None,  # [B] dispatch row -> slot id
                                             # (None: rows ARE slots); >= Bs
                                             # rows are pads (updates dropped)
+    decode_row_group: int = 1,  # rows per ragged-decode program (multi-row
+                                # page walk, ops/paged_attention.py); 1 =
+                                # per-row grid (the LMRS_MULTIROW=0 path)
 ) -> tuple:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -513,7 +516,8 @@ def forward_paged(
                 attn, kp_all, vp_all = paged_decode_pallas_multi(
                     q, k, v, kp_all, vp_all, g_tables, kv_lens,
                     interpret=interpret, max_pos=rope_max,
-                    kscale=ks_m, vscale=vs_m)
+                    kscale=ks_m, vscale=vs_m,
+                    row_group=decode_row_group)
             else:
                 attn, kp_all, vp_all = paged_decode_multi_xla(
                     q, k, v, kp_all, vp_all, g_tables, kv_lens,
@@ -534,12 +538,12 @@ def forward_paged(
                 attn, kp_all, vp_all = paged_decode_fused_sharded(
                     q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables,
                     kv_lens, mesh, interpret=interpret,
-                    kscale=ks_r, vscale=vs_r)
+                    kscale=ks_r, vscale=vs_r, row_group=decode_row_group)
             else:
                 attn, kp_all, vp_all = paged_decode_pallas_fused(
                     q[:, 0], k[:, 0], v[:, 0], kp_all, vp_all, g_tables,
                     kv_lens, interpret=interpret,
-                    kscale=ks_r, vscale=vs_r)
+                    kscale=ks_r, vscale=vs_r, row_group=decode_row_group)
             attn_out = attn[:, None]  # [B, 1, H, hd]
             return _finish_layer(lp, x, attn_out, kp_all, vp_all, ksc, vsc)
 

@@ -21,6 +21,18 @@ model step) — the round-2 decode fixed cost was diagnosed as program +
 small-DMA launch latency, not bandwidth (docs/PERF.md round 2: 9.39 ms
 fitted fixed cost vs a 2.49 ms weight-stream floor).
 
+The BATCH axis folds the same way with ``row_group > 1`` (round 6, the
+multi-row page walk): one program walks a GROUP of G rows through the
+shared pipeline — grid=(B/G,) — priming row r+1's first page and running
+its RMW cycle inside row r's compute bubbles (``_make_group_kernel``).
+The per-program fixed cost that grid=(B,) pays per ROW is paid per GROUP;
+at the 8B bench shape ~2.8 ms of the decode step was this per-row cost
+(24 rows × 32 layers × 3.6 µs — docs/PERF.md r5 intercept decomposition),
+which G-row programs divide by up to G.  Callers pass a host-side
+length-balanced row order (``balanced_row_order``) so one straggler row
+cannot serialize a whole group.  ``row_group=1`` (the LMRS_MULTIROW=0
+kill switch) is byte-for-byte the previous per-row grid.
+
 Cache layout: [P_total, K, page_size, hd] (PAGE-major, round 3: one page's
 ALL kv heads are a single contiguous [K, page_size, hd] DMA — the
 head-major layout issued kh separate per-head page DMAs, and the decode
@@ -35,10 +47,55 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+
+def balanced_row_order(lengths, row_group: int) -> np.ndarray:
+    """Host-side length-balanced row→group assignment for the multi-row
+    decode kernels (``row_group > 1``): a permutation of rows such that
+    each consecutive size-G slice — one kernel program's group — carries a
+    near-equal total live length.  Within a group the rows share ONE DMA
+    pipeline and walk sequentially, so an unbalanced assignment lets a
+    straggler row serialize its whole group (and, under megacore grid
+    partitioning, unbalanced groups serialize the cores).
+
+    LPT greedy: rows sorted by length descending, each placed in the
+    group with the smallest running total that still has a free seat.
+    When ``len(lengths) % row_group != 0`` the LAST group keeps the short
+    seat count (the kernel pads the trailing rows with inactive ones).
+    Deterministic — ties break on row index — so greedy A/B runs
+    reproduce exactly.  Returns ``perm`` with dispatch row i holding
+    original row ``perm[i]``: gather inputs by ``perm``, scatter outputs
+    back through it.  Pure numpy; never traced.
+    """
+    lengths = np.asarray(lengths)
+    b = len(lengths)
+    g = max(1, int(row_group))
+    n_groups = max(1, -(-b // g))
+    # identity fast path: one group, or uniform lengths (the common
+    # equal-chunk map workload) — balancing is a no-op, and returning
+    # identity lets the scheduler skip the reorder entirely (it also
+    # keeps sampled rows' draws aligned with the LMRS_MULTIROW=0 A/B
+    # control when there was nothing to balance)
+    if n_groups == 1 or (b and (lengths == lengths[0]).all()):
+        return np.arange(b, dtype=np.int64)
+    order = np.argsort(-lengths, kind="stable")
+    sums = np.zeros(n_groups)
+    seats = np.full(n_groups, g)
+    if b % g:
+        seats[-1] = b - g * (n_groups - 1)
+    groups: list[list[int]] = [[] for _ in range(n_groups)]
+    for r in order:
+        gi = min((i for i in range(n_groups) if seats[i] > 0),
+                 key=lambda i: sums[i])
+        groups[gi].append(int(r))
+        sums[gi] += lengths[r]
+        seats[gi] -= 1
+    return np.concatenate([np.asarray(grp, np.int64) for grp in groups])
 
 
 def _n_live_pages(page_tables_ref, kv_lens_ref, row, page_size):
@@ -128,6 +185,10 @@ def _ragged_decode_all_heads(
     max_pos: int | None = None,  # static cap: no position >= this is valid
     row=None,           # batch row to walk (default: this program's row)
     external_prime: bool = False,  # caller already DMA'd page 0 into slot 0
+    after_walk=None,    # hook between the page loop and the output write:
+                        # the multi-row group kernels start the NEXT row's
+                        # first-page fetch here so its DMA overlaps this
+                        # row's epilogue (softmax normalize + output write)
     get_kscale=None,    # (row, ki) -> [hd] f32: int8 pools.  The scales are
     get_vscale=None,    # per-CHANNEL on the contracted axis, so K's dequant
                         # folds into q (one multiply per head, before the
@@ -237,6 +298,12 @@ def _ragged_decode_all_heads(
         return _
 
     jax.lax.fori_loop(0, n_pages, body, None)
+
+    # safe to issue new DMAs into the double buffers here: every copy the
+    # loop started has been waited, and the last page's compute consumed
+    # its buffer before the loop returned
+    if after_walk is not None:
+        after_walk()
 
     @pl.when(n_pages > 0)
     def _write():
@@ -447,7 +514,126 @@ def _write_new_tokens_all_heads(
     drain()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "max_pos"))
+def _make_group_kernel(*, g: int, ps: int, kh: int, hd: int, n_tokens: int,
+                       t_pad: int, n_rep_p: int, max_pos: int | None,
+                       wh: int, quantized: bool, sm_scale: float):
+    """Row-GROUP decode kernel body (the multi-row page walk): one program
+    walks ``g`` consecutive batch rows' live pages through a single shared
+    double-buffered DMA pipeline instead of one program per row.  The
+    per-program fixed cost — launch, scratch init, pipeline prime — is
+    paid once per group, and the cross-row software pipeline runs at ROW
+    granularity inside the program: while row r computes, row r+1's RMW
+    windows read/blend/write and its first page prefetches into row r's
+    compute bubbles.  This generalizes the per-row fused kernel's
+    cross-iteration trick (which already measured 3.6 µs/row fused vs 5.2
+    walk-only — the pipeline pays; docs/PERF.md round 5) from grid
+    iterations to unrolled in-program rows, where no program boundary sits
+    between them.
+
+    Shared by the single-token fused decode (``n_tokens == 1``) and the
+    speculative multi-token verify (``n_tokens > 1``): the RMW machinery
+    and the walk are already row- and token-count-parametrized.  The
+    pipeline invariants are the per-row kernel's, unchanged: rows' pages
+    are disjoint (slots own their pages exclusively), exactly one RMW
+    cycle is in flight at a time, and row r+1's first-page prime happens
+    only after r+1's RMW drain.  The LAST row of group ``gi`` hands off to
+    the FIRST row of group ``gi+1`` exactly as consecutive grid iterations
+    used to — the pipeline crosses group boundaries seamlessly.
+
+    Expects the caller's operand layout: q/o blocked ``(g, kh, rows, hd)``
+    per group; knew/vnew (and int8 scales) as WHOLE-array blocks — row
+    r+1's RMW runs inside row r's walk, so per-row blocks cannot work
+    (same constraint as the per-row fused kernel).  The batch must be
+    padded to a multiple of ``g``; padded rows carry length 0 (zero
+    output, null-page RMW — the masked-row convention throughout).
+    """
+
+    def kernel(pt_ref, len_ref, q_ref, knew_ref, vnew_ref, *rest):
+        if quantized:
+            (ksc_ref, vsc_ref, k_hbm, v_hbm, o_ref, k_out, v_out, k_scr,
+             v_scr, acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
+            gks = lambda row, ki: ksc_ref[row, ki]
+            gvs = lambda row, ki: vsc_ref[row, ki]
+        else:
+            (k_hbm, v_hbm, o_ref, k_out, v_out, k_scr, v_scr, acc_scr,
+             m_scr, l_scr, k8_scr, v8_scr, sem, wsem) = rest
+            gks = gvs = None
+        gi = pl.program_id(0)
+        nrows = pl.num_programs(0) * g
+        base = gi * g
+        rmw = _make_rmw(
+            pt_ref, len_ref,
+            lambda row, ki: knew_ref[row, ki],
+            lambda row, ki: vnew_ref[row, ki],
+            k_out, v_out, k8_scr, v8_scr, wsem,
+            page_size=ps, kh=kh, n_tokens=n_tokens, t_pad=t_pad, hd=hd,
+            max_pos=max_pos, wh=wh, get_kscale=gks, get_vscale=gvs,
+        )
+
+        def prime_row(row):
+            # same fetch layout as the walk body: its step-0 wait pairs
+            # with fetch(page 0, slot 0)
+            @pl.when(_n_live_pages(pt_ref, len_ref, row, ps) > 0)
+            def _():
+                _fetch_page(pt_ref, k_out, v_out, k_scr, v_scr, sem,
+                            row, 0, 0)
+
+        @pl.when(gi == 0)
+        def _bootstrap():  # the very first row has no predecessor
+            sr, bw, dr = rmw(0)
+            sr()
+            bw()
+            dr()
+            prime_row(0)
+
+        for j in range(g):  # static unroll: one walk per group row
+            row = base + j
+            nxt = row + 1
+            # clamped for closure creation only (same contract as the
+            # per-row fused kernel): for_row's scalar SMEM reads trace
+            # unguarded; the pl.when guards keep the phases from
+            # EXECUTING past the last row
+            nxt_reads, nxt_blend, nxt_drain = rmw(
+                jnp.minimum(nxt, nrows - 1))
+
+            @pl.when(nxt < nrows)
+            def _next_rmw_reads(nxt_reads=nxt_reads):
+                nxt_reads()
+
+            def after_walk(nxt=nxt, nxt_blend=nxt_blend,
+                           nxt_drain=nxt_drain):
+                # row nxt's RMW completes and its first page primes while
+                # row ``row``'s epilogue (normalize + output write) runs
+                @pl.when(nxt < nrows)
+                def _():
+                    nxt_blend()
+                    nxt_drain()
+                    prime_row(nxt)
+
+            _ragged_decode_all_heads(
+                pt_ref, len_ref, q_ref.at[j], k_out, v_out, o_ref.at[j],
+                k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+                page_size=ps, sm_scale=sm_scale, kh=kh,
+                n_rep_p=n_rep_p, n_tokens=n_tokens, max_pos=max_pos,
+                row=row, external_prime=True, after_walk=after_walk,
+                get_kscale=gks, get_vscale=gvs,
+            )
+
+    return kernel
+
+
+def _pad_rows(x, bp: int, fill=0):
+    """Pad axis 0 of ``x`` from b to ``bp`` rows with ``fill`` (group-path
+    batch padding; padded rows carry length 0 and are inactive)."""
+    b = x.shape[0]
+    if b == bp:
+        return x
+    pad = [(0, bp - b)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad, constant_values=fill)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "max_pos", "row_group"))
 def paged_decode_pallas_multi(
     q: jnp.ndarray,            # [B, T, H, hd] queries (token-major)
     k_new: jnp.ndarray,        # [B, T, K, hd] the T tokens' K (post-rope)
@@ -462,6 +648,8 @@ def paged_decode_pallas_multi(
     max_pos: int | None = None,  # static position cap (max_seq_len)
     kscale: jnp.ndarray | None = None,  # [B, K, hd] f32: int8 pools — the
     vscale: jnp.ndarray | None = None,  # per-(slot, head, channel) scales
+    row_group: int = 1,        # rows per program (multi-row page walk);
+                               # 1 = the per-row grid (LMRS_MULTIROW=0)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Ragged multi-token verify: the speculative-decoding analog of
     ``paged_decode_pallas_fused``.  One program per batch row writes all T
@@ -505,6 +693,87 @@ def paged_decode_pallas_multi(
         knew = jnp.pad(knew, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
         vnew = jnp.pad(vnew, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
     n_win = 1 if t == 1 else (t - 2) // wh + 2
+
+    g = max(1, min(row_group, b))
+    if g > 1:
+        # multi-row page walk: pad the batch to a multiple of g (padded
+        # rows: length 0, inactive) and dispatch one program per GROUP.
+        # knew/vnew (and scales) become whole-array blocks — the group
+        # kernel's pipeline runs row r+1's RMW inside row r's walk, so
+        # per-row blocks cannot cross rows (same constraint as the fused
+        # kernel); their VMEM footprint scales with batch.
+        bp = -(-b // g) * g
+        qg = _pad_rows(qg, bp)
+        knew, vnew = _pad_rows(knew, bp), _pad_rows(vnew, bp)
+        page_tables = _pad_rows(page_tables, bp)
+        kv_lens = _pad_rows(kv_lens, bp)
+        new_tok_bytes = 2 * bp * kh * t_pad * hd * knew.dtype.itemsize
+        assert new_tok_bytes <= 4 * 1024 * 1024, (
+            f"multi-row verify keeps all rows' draft K/V in VMEM "
+            f"({new_tok_bytes/2**20:.1f} MiB at B={bp}, T={t_pad}, kh={kh}, "
+            f"hd={hd}); shard the batch or lower max_batch_slots")
+        scale_specs = []
+        if quantized:
+            # pad scales with ones: a padded row's null-page RMW still
+            # quantizes (harmless garbage by convention), and a zero
+            # scale would turn that into NaN rows
+            kscale = _pad_rows(kscale.astype(jnp.float32), bp, fill=1)
+            vscale = _pad_rows(vscale.astype(jnp.float32), bp, fill=1)
+            scale_specs = [
+                pl.BlockSpec((bp, kh, hd), lambda gi, *_: (0, 0, 0)),
+                pl.BlockSpec((bp, kh, hd), lambda gi, *_: (0, 0, 0)),
+            ]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bp // g,),
+            in_specs=[
+                pl.BlockSpec((g, kh, rows, hd), lambda gi, *_: (gi, 0, 0, 0)),
+                pl.BlockSpec((bp, kh, t_pad, hd), lambda gi, *_: (0, 0, 0, 0)),
+                pl.BlockSpec((bp, kh, t_pad, hd), lambda gi, *_: (0, 0, 0, 0)),
+                *scale_specs,
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((g, kh, rows, hd), lambda gi, *_: (gi, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),  # whole pages
+                pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+                pltpu.VMEM((kh, rows, hd), jnp.float32),
+                pltpu.VMEM((kh, rows, 128), jnp.float32),
+                pltpu.VMEM((kh, rows, 128), jnp.float32),
+                pltpu.VMEM((n_win, kh, wh, hd), k_pages.dtype),
+                pltpu.VMEM((n_win, kh, wh, hd), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+                pltpu.SemaphoreType.DMA((n_win, 2)),
+            ],
+        )
+        kernel = _make_group_kernel(
+            g=g, ps=ps, kh=kh, hd=hd, n_tokens=t, t_pad=t_pad,
+            n_rep_p=n_rep_p, max_pos=max_pos, wh=wh, quantized=quantized,
+            sm_scale=hd**-0.5)
+        operands = [qg, knew, vnew]
+        if quantized:
+            operands += [kscale, vscale]
+        pool_at = 2 + len(operands)
+        out, k_pages, v_pages = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((bp, kh, rows, hd), q.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            ],
+            input_output_aliases={pool_at: 1, pool_at + 1: 2},
+            interpret=interpret,
+        )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+          *operands, k_pages, v_pages)
+        out = out[:b].reshape(b, kh, t, n_rep_p, hd)[:, :, :, :n_rep]
+        return (out.transpose(0, 2, 1, 3, 4).reshape(b, t, h, hd),
+                k_pages, v_pages)
 
     scale_specs = []
     if quantized:
@@ -650,7 +919,7 @@ def paged_decode_multi_xla(
     return out, k_pages, v_pages
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "row_group"))
 def paged_decode_pallas_fused(
     q: jnp.ndarray,            # [B, H, hd]
     k_new: jnp.ndarray,        # [B, K, hd] current token K (post-rope)
@@ -662,6 +931,8 @@ def paged_decode_pallas_fused(
     interpret: bool = False,
     kscale: jnp.ndarray | None = None,  # [B, K, hd] f32: int8 pools — the
     vscale: jnp.ndarray | None = None,  # per-(slot, head, channel) scales
+    row_group: int = 1,        # rows per program (multi-row page walk);
+                               # 1 = the per-row grid (LMRS_MULTIROW=0)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write-fused ragged decode: scatter the current token's K/V into the
     page pool (in place — the pools are input/output aliased) and attend the
@@ -669,6 +940,13 @@ def paged_decode_pallas_fused(
     Replaces XLA scatter + kernel: the XLA scatter on the multi-GiB pool was
     measured copying the whole pool per decode step (no in-place aliasing
     through the scan carry).
+
+    With ``row_group > 1`` one program walks a GROUP of rows through the
+    shared pipeline (``_make_group_kernel``): programs/step drop by the
+    group factor and the per-program fixed cost — the dominant share of
+    the measured ~3.6 µs/row decode attention cost at 8B (docs/PERF.md
+    round 5) — amortizes over the group.  Exact-output-equal to the
+    per-row grid; callers balance groups host-side (balanced_row_order).
 
     With ``kscale``/``vscale`` the pools are int8: pages stream as raw int8
     (half the decode bytes), K's per-channel dequant folds into q before
@@ -697,6 +975,79 @@ def paged_decode_pallas_fused(
         f"fused decode keeps all rows' new-token K/V in VMEM "
         f"({new_tok_bytes/2**20:.1f} MiB at B={b}, kh={kh}, hd={hd}); "
         "shard the batch or lower max_batch_slots")
+
+    g = max(1, min(row_group, b))
+    if g > 1:
+        # multi-row page walk: one program per GROUP of g rows (padded
+        # rows are inactive), same operands as the per-row grid except
+        # q/o block per group.  knew/vnew/scales were already whole-array
+        # blocks here (the cross-row RMW needed them), so only the grid
+        # and q/o blocking change.
+        bp = -(-b // g) * g
+        qg = _pad_rows(qg, bp)
+        knew, vnew = _pad_rows(knew, bp), _pad_rows(vnew, bp)
+        page_tables = _pad_rows(page_tables, bp)
+        kv_lens = _pad_rows(kv_lens, bp)
+        scale_specs = []
+        if quantized:
+            # ones, not zeros: a padded row's null-page RMW still divides
+            # by its scale (garbage-by-convention, but NaN-free)
+            kscale = _pad_rows(kscale.astype(jnp.float32), bp, fill=1)
+            vscale = _pad_rows(vscale.astype(jnp.float32), bp, fill=1)
+            scale_specs = [
+                pl.BlockSpec((bp, kh, hd), lambda gi, *_: (0, 0, 0)),
+                pl.BlockSpec((bp, kh, hd), lambda gi, *_: (0, 0, 0)),
+            ]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bp // g,),
+            in_specs=[
+                pl.BlockSpec((g, kh, n_rep_p, hd),
+                             lambda gi, *_: (gi, 0, 0, 0)),
+                pl.BlockSpec((bp, kh, 8, hd), lambda gi, *_: (0, 0, 0, 0)),
+                pl.BlockSpec((bp, kh, 8, hd), lambda gi, *_: (0, 0, 0, 0)),
+                *scale_specs,
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((g, kh, n_rep_p, hd),
+                             lambda gi, *_: (gi, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),  # whole pages
+                pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+                pltpu.VMEM((kh, n_rep_p, hd), jnp.float32),
+                pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
+                pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
+                pltpu.VMEM((1, kh, wh, hd), k_pages.dtype),  # one RMW window
+                pltpu.VMEM((1, kh, wh, hd), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+                pltpu.SemaphoreType.DMA((1, 2)),
+            ],
+        )
+        kernel = _make_group_kernel(
+            g=g, ps=ps, kh=kh, hd=hd, n_tokens=1, t_pad=8, n_rep_p=0,
+            max_pos=None, wh=wh, quantized=quantized, sm_scale=hd**-0.5)
+        operands = [qg, knew, vnew]
+        if quantized:
+            operands += [kscale, vscale]
+        pool_at = 2 + len(operands)
+        out, k_pages, v_pages = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((bp, kh, n_rep_p, hd), q.dtype),
+                jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            ],
+            input_output_aliases={pool_at: 1, pool_at + 1: 2},
+            interpret=interpret,
+        )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+          *operands, k_pages, v_pages)
+        return out[:b, :, :n_rep].reshape(b, h, hd), k_pages, v_pages
 
     scale_specs = []
     scale_scratch = []
@@ -847,6 +1198,7 @@ def paged_decode_fused_sharded(
     interpret: bool = False,
     kscale: jnp.ndarray | None = None,  # [B, K, hd] (K sharded over tp)
     vscale: jnp.ndarray | None = None,
+    row_group: int = 1,  # rows per program (multi-row page walk)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Write-fused ragged decode under a tensor-parallel mesh.
 
@@ -873,7 +1225,7 @@ def paged_decode_fused_sharded(
         ks_, vs_ = sc if sc else (None, None)
         return paged_decode_pallas_fused(
             q_, kn_, vn_, kp_, vp_, pt_, kl_, interpret=interpret,
-            kscale=ks_, vscale=vs_)
+            kscale=ks_, vscale=vs_, row_group=row_group)
 
     fn = jax.shard_map(
         call,
@@ -887,7 +1239,7 @@ def paged_decode_fused_sharded(
               *extra_args)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "row_group"))
 def paged_decode_pallas(
     q: jnp.ndarray,            # [B, H, hd]
     k_pages: jnp.ndarray,      # [P, K, ps, hd]
@@ -895,6 +1247,7 @@ def paged_decode_pallas(
     page_tables: jnp.ndarray,  # [B, W]
     kv_lens: jnp.ndarray,      # [B]
     interpret: bool = False,
+    row_group: int = 1,        # rows per program (multi-row page walk)
 ) -> jnp.ndarray:
     b, h, hd = q.shape
     _, kh, ps, _ = k_pages.shape
@@ -907,6 +1260,78 @@ def paged_decode_pallas(
     qg = q.reshape(b, kh, n_rep, hd)
     if n_rep_p != n_rep:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, n_rep_p - n_rep), (0, 0)))
+
+    g = max(1, min(row_group, b))
+    if g > 1:
+        # walk-only multi-row variant (no RMW): one program walks g rows
+        # through the shared double-buffered pipeline, priming row r+1's
+        # first page during row r's epilogue.  Used by the rowcost probe's
+        # group arm; the serving path runs the fused variant.
+        bp = -(-b // g) * g
+        qg = _pad_rows(qg, bp)
+        page_tables = _pad_rows(page_tables, bp)
+        kv_lens = _pad_rows(kv_lens, bp)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bp // g,),
+            in_specs=[
+                pl.BlockSpec((g, kh, n_rep_p, hd),
+                             lambda gi, *_: (gi, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((g, kh, n_rep_p, hd),
+                                   lambda gi, *_: (gi, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),
+                pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+                pltpu.VMEM((kh, n_rep_p, hd), jnp.float32),
+                pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
+                pltpu.VMEM((kh, n_rep_p, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+
+        def group_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
+                         k_scr, v_scr, acc_scr, m_scr, l_scr, sem):
+            gi = pl.program_id(0)
+            nrows = pl.num_programs(0) * g
+            base = gi * g
+
+            def prime_row(row):
+                @pl.when(_n_live_pages(pt_ref, len_ref, row, ps) > 0)
+                def _():
+                    _fetch_page(pt_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
+                                row, 0, 0)
+
+            @pl.when(gi == 0)
+            def _bootstrap():
+                prime_row(0)
+
+            for j in range(g):
+                row = base + j
+                nxt = row + 1
+
+                def after_walk(nxt=nxt):
+                    @pl.when(nxt < nrows)
+                    def _():
+                        prime_row(nxt)
+
+                _ragged_decode_all_heads(
+                    pt_ref, len_ref, q_ref.at[j], k_hbm, v_hbm, o_ref.at[j],
+                    k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+                    page_size=ps, sm_scale=hd**-0.5, kh=kh,
+                    row=row, external_prime=True, after_walk=after_walk,
+                )
+
+        out = pl.pallas_call(
+            group_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((bp, kh, n_rep_p, hd), q.dtype),
+            interpret=interpret,
+        )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), qg,
+          k_pages, v_pages)
+        return out[:b, :, :n_rep].reshape(b, h, hd)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

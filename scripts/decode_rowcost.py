@@ -1,20 +1,31 @@
 """Per-row fixed-cost probe for the ragged decode kernel, in isolation.
 
 Times ONE attention layer's kernel (no model around it) at bench-1b's
-attention shape across a batch sweep, for two arms:
+attention shape across a batch sweep, for the arms:
 
-* walk     — ``paged_decode_pallas`` (page walk only, no RMW)
-* fused    — ``paged_decode_pallas_fused`` (walk + RMW + cross-row pipeline)
+* walk       — ``paged_decode_pallas`` (page walk only, no RMW)
+* fused      — ``paged_decode_pallas_fused`` (walk + RMW + cross-row pipeline)
+* walk_gG / fused_gG — the multi-row kernels at row_group=G (one pair per
+               entry in LMRS_ROWCOST_GROUPS, default "2,4,8"): the
+               group-size sweep behind EngineConfig.decode_row_group —
+               pick the G where the us/row curve flattens (past that,
+               groups only add padding waste at partial occupancy).  The
+               walk arms isolate the grouped pipeline itself; the fused
+               arms are what the serving path actually runs.
 
 Kernel calls are chained inside one jitted ``fori_loop`` (output feeds
-the next q, pools ride the carry — the decode-block scan's shape), and
-the per-kernel time is the DIFFERENCE between a long and a short chain
-divided by the iteration delta: the tunnel's ~100 ms fetch RTT and the
-dispatch cost cancel exactly instead of polluting the fit (the naive
-per-call timing here is ~97% RTT).
+the next q, pools ride the carry — the decode-block scan's shape) and
+timed by the shared LONG-minus-SHORT chain method
+(lmrs_tpu.utils.perf_model.time_chain): the tunnel's ~100 ms fetch RTT
+and the dispatch cost cancel exactly instead of polluting the fit (the
+naive per-call timing here is ~97% RTT).
 Run: python scripts/decode_rowcost.py
+Env hooks: LMRS_ROWCOST_GROUPS (comma list, "" disables the group arms),
+LMRS_ROWCOST_INTERPRET=1 (Pallas interpret mode — the CPU-only stand-in
+harness: us/kernel numbers then measure the emulator and are only
+meaningful RELATIVE to each other per arm, never absolutely).
 """
-import time
+import os
 
 import _pathfix  # noqa: F401
 import jax
@@ -25,23 +36,28 @@ from lmrs_tpu.ops.paged_attention import (
     paged_decode_pallas,
     paged_decode_pallas_fused,
 )
+from lmrs_tpu.utils.perf_model import time_chain
 
 KH, NREP, HD, PS = 8, 2, 128, 512   # bench-1b attention shape
 LIVE = 64
 LO, HI = 64, 2048
 REPS = 5
+INTERPRET = os.environ.get("LMRS_ROWCOST_INTERPRET", "") == "1"
 
 
-def make_chain(arm, iters, kn, vn, pt, kl):
+def make_chain(arm, iters, kn, vn, pt, kl, row_group=1):
     @jax.jit
     def chain(q, kp, vp):
         def body(_, carry):
             q, kp, vp = carry
-            if arm == "walk":
-                out = paged_decode_pallas(q, kp, vp, pt, kl)
+            if arm.startswith("walk"):
+                out = paged_decode_pallas(q, kp, vp, pt, kl,
+                                          interpret=INTERPRET,
+                                          row_group=row_group)
             else:
                 out, kp, vp = paged_decode_pallas_fused(
-                    q, kn, vn, kp, vp, pt, kl)
+                    q, kn, vn, kp, vp, pt, kl, interpret=INTERPRET,
+                    row_group=row_group)
             return (out.astype(q.dtype), kp, vp)
 
         return jax.lax.fori_loop(0, iters, body, (q, kp, vp))
@@ -51,6 +67,14 @@ def make_chain(arm, iters, kn, vn, pt, kl):
 
 def main():
     rng = np.random.default_rng(0)
+    lo, hi, reps = LO, HI, REPS
+    if INTERPRET:  # emulator chains are ~1000x slower; keep the harness usable
+        lo, hi, reps = 2, 8, 2
+    groups = [int(g) for g in
+              os.environ.get("LMRS_ROWCOST_GROUPS", "2,4,8").split(",") if g]
+    arms = [("walk", 1), ("fused", 1)]
+    for g in groups:
+        arms += [(f"walk_g{g}", g), (f"fused_g{g}", g)]
     results = {}
     for B in (8, 16, 24, 32):
         P = B + 1
@@ -63,29 +87,22 @@ def main():
             (1 + np.arange(B))[:, None], jnp.int32)  # one live page per row
         kl = jnp.full((B,), LIVE, jnp.int32)
 
-        for arm in ("walk", "fused"):
-            walls = {}
-            for iters in (LO, HI):
-                fn = make_chain(arm, iters, kn, vn, pt, kl)
-                out = fn(q, kp, vp)
-                np.asarray(jax.device_get(out[0]))  # compile + settle
-                best = float("inf")
-                for _ in range(REPS):
-                    t0 = time.time()
-                    out = fn(q, kp, vp)
-                    np.asarray(jax.device_get(out[0]))
-                    best = min(best, time.time() - t0)
-                walls[iters] = best
-            us = (walls[HI] - walls[LO]) / (HI - LO) * 1e6
+        for arm, g in arms:
+            def chain(iters, arm=arm, g=g):
+                fn = make_chain(arm, iters, kn, vn, pt, kl, row_group=g)
+                return lambda: fn(q, kp, vp)[0]
+
+            us = time_chain(chain, lo, hi, reps) * 1e6
             results.setdefault(arm, []).append((B, us))
-            print(f"B={B:3d} {arm:6s} {us:8.2f} us/kernel", flush=True)
+            print(f"B={B:3d} {arm:9s} {us:8.2f} us/kernel"
+                  f"  ({us/B:6.2f} us/row)", flush=True)
 
     for arm, rows in results.items():
         bs = np.array([r[0] for r in rows], float)
         us = np.array([r[1] for r in rows], float)
         A = np.vstack([bs, np.ones_like(bs)]).T
         slope, icpt = np.linalg.lstsq(A, us, rcond=None)[0]
-        print(f"{arm:6s}: {slope:6.3f} us/row + {icpt:6.1f} us launch")
+        print(f"{arm:9s}: {slope:6.3f} us/row + {icpt:6.1f} us launch")
 
 
 if __name__ == "__main__":

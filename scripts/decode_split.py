@@ -4,7 +4,10 @@ cost (intercept) from the KV-walk cost (slope).
 Run: python scripts/decode_split.py
 Env hooks: LMRS_SPLIT_MODEL (preset, default bench-1b),
 LMRS_SPLIT_QUANT=int8 (int8 weights+KV, e.g. the bench-8b arm),
-LMRS_SPLIT_PS (page_size, default 512).
+LMRS_SPLIT_PS (page_size, default 512),
+LMRS_SPLIT_GROUP (decode_row_group, default 4; LMRS_MULTIROW=0 is the
+per-row A/B control — the refreshed-intercept measurement for the
+multi-row page walk is this script run with both settings).
 """
 import os
 import time
@@ -30,8 +33,12 @@ def main():
         retry_delay=0.0, seed=0,
         page_size=int(os.environ.get("LMRS_SPLIT_PS", "512")), num_pages=1,
         decode_block=128, prefill_chunk=4096, tokenizer="byte",
+        decode_row_group=int(os.environ.get("LMRS_SPLIT_GROUP", "4")),
         quantize=quant or None, kv_quantize=quant or None), model)
     sched = eng._scheduler
+    print(f"decode_row_group={sched._row_group} "
+          f"(LMRS_MULTIROW={'0 (per-row control)' if sched._row_group == 1 else 'on'})",
+          flush=True)
     rng = np.random.default_rng(0)
     B, S = sched.B, model.max_seq_len
     w = sched.cache.max_pages_per_slot

@@ -134,3 +134,43 @@ def test_tokenizer_vocab_mismatch_refused():
         JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
                                max_batch_slots=1, seed=0), mc,
                   tokenizer=BigVocabTok())
+
+
+def test_bf16_tree_gb_tied_embeddings_not_double_counted():
+    """Regression (ADVICE r5): ``matmul_params`` always counts the [D, V]
+    LM-head matmul, so adding the embedding term double-counted the ONE
+    shared [V, D] matrix of tied models — gemma-2b's estimate carried a
+    phantom ~1.05 GB toward the 6.0 GB host-init gate.  The estimate must
+    track the REAL tree (eval_shape of init_params, no allocation) within
+    1% for tied and untied shapes; the residual is the norm scales."""
+    import dataclasses
+
+    import numpy as np
+
+    from lmrs_tpu.config import model_preset
+    from lmrs_tpu.engine.jax_engine import _bf16_tree_gb, needs_host_quant_init
+    from lmrs_tpu.models.transformer import init_params
+
+    def actual_gb(cfg):
+        shapes = jax.eval_shape(
+            lambda: init_params(cfg, jax.random.PRNGKey(0)))
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+        return n * 2 / 1e9
+
+    for name in ("llama3-8b", "gemma-2b"):
+        cfg = model_preset(name)
+        for tied in (False, True):
+            c = dataclasses.replace(cfg, tie_embeddings=tied)
+            est, real = _bf16_tree_gb(c), actual_gb(c)
+            assert abs(est - real) / real < 0.01, (name, tied, est, real)
+        # tied vs untied estimates differ by exactly the [V, D] matrix
+    c_t = dataclasses.replace(cfg, tie_embeddings=True)
+    c_u = dataclasses.replace(cfg, tie_embeddings=False)
+    np.testing.assert_allclose(
+        _bf16_tree_gb(c_u) - _bf16_tree_gb(c_t),
+        cfg.vocab_size * cfg.dim * 2 / 1e9, rtol=1e-9)
+
+    # the shared gate both engines route through (jax_engine + replicated)
+    assert needs_host_quant_init(model_preset("llama3-8b"), "int8")
+    assert not needs_host_quant_init(model_preset("llama3-8b"), None)
+    assert not needs_host_quant_init(tiny_model(), "int8")

@@ -354,6 +354,217 @@ def test_multi_token_verify_no_window_alias_at_table_edge():
                                   np.asarray(v_ref[1:3]))
 
 
+# --------------------------------------------------- multi-row page walk
+# Parity contract (ISSUE 4): with row_group > 1 every decode kernel's
+# output AND pool contents must be BIT-IDENTICAL to the per-row grid
+# (row_group=1, the LMRS_MULTIROW=0 path) across ragged lengths, inactive
+# rows, batch sizes that don't divide the group, bf16 and int8 pools, and
+# the n_tokens > 1 speculative-verify shape.  Page 0 (the reserved null
+# page) is excluded from pool comparison: padded group rows park their
+# masked writes there by the same convention as inactive dispatch rows.
+
+
+def _ragged_fixture(seed, b=5, h=8, kh=4, hd=128, ps=16, n_pages=32):
+    rng = jax.random.split(jax.random.PRNGKey(seed), 5)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
+    tables = jnp.asarray(
+        np.random.default_rng(seed).permutation(n_pages - 1)[: b * 3]
+        .reshape(b, 3) + 1, jnp.int32)
+    # ragged: multi-page, inactive (0), single-token, page-boundary rows
+    kv_lens = jnp.asarray([40, 0, 17, 48, 1], jnp.int32)
+    return q, k_new, v_new, k_pages, v_pages, tables, kv_lens
+
+
+def test_multirow_walk_parity():
+    """Walk-only group kernel vs the per-row grid: bit-identical outputs
+    across group sizes, including g not dividing B (padded tail group)."""
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas
+
+    q, _, _, kp, vp, tables, kv_lens = _ragged_fixture(0)
+    want = paged_decode_pallas(q, kp, vp, tables, kv_lens, interpret=True)
+    for g in (2, 3, 5):
+        got = paged_decode_pallas(q, kp, vp, tables, kv_lens,
+                                  interpret=True, row_group=g)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_multirow_fused_parity_bf16():
+    """Fused walk+RMW group kernel vs per-row: outputs and REAL pool pages
+    bit-identical (the cross-row RMW pipeline crossing group boundaries)."""
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas_fused
+
+    q, kn, vn, kp, vp, tables, kv_lens = _ragged_fixture(1)
+    want, k_ref, v_ref = paged_decode_pallas_fused(
+        q, kn, vn, kp, vp, tables, kv_lens, interpret=True)
+    for g in (2, 4, 5):
+        got, k_out, v_out = paged_decode_pallas_fused(
+            q, kn, vn, kp, vp, tables, kv_lens, interpret=True, row_group=g)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(k_out[1:]),
+                                      np.asarray(k_ref[1:]))
+        np.testing.assert_array_equal(np.asarray(v_out[1:]),
+                                      np.asarray(v_ref[1:]))
+
+
+def test_multirow_fused_parity_int8():
+    """Group kernel over int8 pools (32-row RMW windows, folded per-channel
+    dequant): bit-identical to the per-row int8 kernel — the quantize →
+    clip → store path must round identically through the group pipeline."""
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas_fused
+
+    rng = np.random.default_rng(7)
+    B, H, K, hd, ps, P = 5, 4, 2, 128, 64, 16
+    kq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
+    tables = jnp.asarray(rng.permutation(P - 1)[: B * 3].reshape(B, 3) + 1,
+                         jnp.int32)
+    lens = jnp.asarray([ps * 2 + 17, 33, 0, ps * 3, 1], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, K, hd)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, K, hd)), jnp.float32)
+    ks = jnp.asarray(rng.uniform(0.01, 0.05, (B, K, hd)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.05, (B, K, hd)), jnp.float32)
+
+    want, k_ref, v_ref = paged_decode_pallas_fused(
+        q, kn, vn, kq, vq, tables, lens, interpret=True,
+        kscale=ks, vscale=vs)
+    for g in (2, 5):
+        got, k_out, v_out = paged_decode_pallas_fused(
+            q, kn, vn, kq, vq, tables, lens, interpret=True,
+            kscale=ks, vscale=vs, row_group=g)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(k_out[1:]),
+                                      np.asarray(k_ref[1:]))
+        np.testing.assert_array_equal(np.asarray(v_out[1:]),
+                                      np.asarray(v_ref[1:]))
+
+
+def test_multirow_multi_token_verify_parity():
+    """Speculative-verify shape (n_tokens > 1) through the group kernel:
+    bit-identical emit-path outputs and pool contents vs per-row, with
+    token spans straddling pages and RMW windows, an out-of-span
+    stale-length row, and a fresh (length == T) row."""
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas_multi
+
+    b, t, h, kh, hd, ps, n_pages = 5, 3, 8, 4, 128, 16, 32
+    rng = jax.random.split(jax.random.PRNGKey(11), 5)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, t, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, t, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, t, kh, hd), jnp.float32)
+    tables = jnp.asarray(
+        np.random.default_rng(11).permutation(n_pages - 1)[: b * 3]
+        .reshape(b, 3) + 1, jnp.int32)
+    # spans: page-straddling, in-page, stale (out-of-span), window-
+    # straddling, fresh row (length == T)
+    kv_lens = jnp.asarray([18, 6, 100, 35, t], jnp.int32)
+
+    want, k_ref, v_ref = paged_decode_pallas_multi(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens, interpret=True)
+    for g in (2, 5):
+        got, k_out, v_out = paged_decode_pallas_multi(
+            q, k_new, v_new, k_pages, v_pages, tables, kv_lens,
+            interpret=True, row_group=g)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(k_out[1:]),
+                                      np.asarray(k_ref[1:]))
+        np.testing.assert_array_equal(np.asarray(v_out[1:]),
+                                      np.asarray(v_ref[1:]))
+
+
+def test_multirow_multi_token_verify_parity_int8():
+    """n_tokens > 1 over int8 pools through the group kernel: the draft
+    rows' RMW quantization and the walk's folded dequant must reproduce
+    the per-row kernel bit-for-bit."""
+    from lmrs_tpu.ops.paged_attention import paged_decode_pallas_multi
+
+    rng = np.random.default_rng(13)
+    B, T, H, K, hd, ps, P = 3, 4, 4, 2, 128, 64, 12
+    kq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (P, K, ps, hd)), jnp.int8)
+    tables = jnp.asarray(rng.permutation(P - 1)[: B * 2].reshape(B, 2) + 1,
+                         jnp.int32)
+    lens = jnp.asarray([ps + 9, T, 70], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, T, K, hd)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, T, K, hd)), jnp.float32)
+    ks = jnp.asarray(rng.uniform(0.01, 0.05, (B, K, hd)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.05, (B, K, hd)), jnp.float32)
+
+    want, k_ref, v_ref = paged_decode_pallas_multi(
+        q, kn, vn, kq, vq, tables, lens, interpret=True,
+        kscale=ks, vscale=vs)
+    got, k_out, v_out = paged_decode_pallas_multi(
+        q, kn, vn, kq, vq, tables, lens, interpret=True,
+        kscale=ks, vscale=vs, row_group=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(k_out[1:]), np.asarray(k_ref[1:]))
+    np.testing.assert_array_equal(np.asarray(v_out[1:]), np.asarray(v_ref[1:]))
+
+
+def test_multirow_balanced_row_order():
+    """Host-side length-balanced row→group assignment: a valid permutation,
+    near-equal group sums, deterministic, short-tail-group aware."""
+    from lmrs_tpu.ops.paged_attention import balanced_row_order
+
+    lens = np.array([100, 1, 50, 49, 2, 99])
+    perm = balanced_row_order(lens, 2)
+    assert sorted(perm.tolist()) == list(range(6))
+    sums = lens[perm.reshape(3, 2)].sum(axis=1)
+    assert sums.max() - sums.min() <= 2, sums
+    # deterministic
+    np.testing.assert_array_equal(perm, balanced_row_order(lens, 2))
+    # b % g != 0: the LAST group keeps the short seat count (kernel pads)
+    perm5 = balanced_row_order(np.array([5, 4, 3, 2, 1]), 2)
+    assert sorted(perm5.tolist()) == list(range(5))
+    # identity-friendly degenerates
+    np.testing.assert_array_equal(balanced_row_order(np.array([3, 3]), 1),
+                                  np.argsort(-np.array([3, 3]), kind="stable"))
+
+
+@pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                    reason="this jax build has no jax.shard_map (same env "
+                           "gap as the pre-existing sharded-kernel tests)")
+def test_multirow_sharded_fused_matches_xla():
+    """The shard_map-wrapped fused kernel with row grouping under a tp=2
+    mesh keeps the XLA reference contract (per-shard group walks)."""
+    import jax.numpy as jnp
+    from lmrs_tpu.ops.paged_attention import (
+        paged_decode_fused_sharded,
+        paged_decode_xla,
+    )
+
+    b, h, kh, hd, ps, n_pages = 3, 8, 2, 128, 16, 12
+    rng = jax.random.split(jax.random.PRNGKey(2), 5)
+    k_pages = jax.random.normal(rng[0], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[1], (n_pages, kh, ps, hd), jnp.float32)
+    q = jax.random.normal(rng[2], (b, h, hd), jnp.float32)
+    k_new = jax.random.normal(rng[3], (b, kh, hd), jnp.float32)
+    v_new = jax.random.normal(rng[4], (b, kh, hd), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [6, 7, 8, 0]], jnp.int32)
+    kv_lens = jnp.asarray([40, 17, 33], jnp.int32)
+
+    pos = kv_lens - 1
+    page = jnp.take_along_axis(tables, (pos // ps)[:, None], 1)[:, 0]
+    off = pos % ps
+    k_ref = k_pages.at[page, :, off].set(k_new)
+    v_ref = v_pages.at[page, :, off].set(v_new)
+    want = paged_decode_xla(q, k_ref, v_ref, tables, kv_lens)
+
+    got, k_out, v_out = paged_decode_fused_sharded(
+        q, k_new, v_new, k_pages, v_pages, tables, kv_lens,
+        _tp_mesh(), interpret=True, row_group=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
+    np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
+
+
 def test_multi_token_verify_out_of_span_skips_on_both_paths():
     """A degenerate row whose length exceeds the table span (stale-length
     class) must write NOTHING on BOTH implementations — the XLA reference
@@ -389,3 +600,38 @@ def test_multi_token_verify_out_of_span_skips_on_both_paths():
                               (k_out, k_pages), (v_out, v_pages)):
         np.testing.assert_array_equal(np.asarray(pool_out[3:5]),
                                       np.asarray(pool_in[3:5]))
+
+
+def test_multirow_engine_greedy_ab_parity(monkeypatch):
+    """End-to-end A/B through the real continuous scheduler (interpret
+    kernels): greedy output with the multi-row kernel + length-balanced
+    dispatch permutation must be token-identical to LMRS_MULTIROW=0 (the
+    per-row control) — the same convention as the LMRS_PACK_PREFILL A/B.
+    Ragged prompt lengths so the balancer actually permutes."""
+    from lmrs_tpu.config import EngineConfig, ModelConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    monkeypatch.setenv("LMRS_FORCE_KERNELS", "interpret")
+    mc = ModelConfig(vocab_size=512, dim=512, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=256, max_seq_len=256,
+                     dtype="float32")
+
+    def run():
+        ec = EngineConfig(backend="jax", scheduler="continuous",
+                          max_tokens=8, max_batch_slots=3, seed=0,
+                          page_size=32, decode_block=4, retry_delay=0.0,
+                          decode_row_group=2)
+        eng = JaxEngine(ec, mc)
+        reqs = [GenerationRequest(prompt=f"multi row probe {i} " * (1 + 3 * i),
+                                  request_id=i, temperature=0.0,
+                                  max_new_tokens=8) for i in range(3)]
+        out = eng.generate_batch(reqs)
+        assert all(r.error is None for r in out)
+        return [r.text for r in out]
+
+    monkeypatch.setenv("LMRS_MULTIROW", "0")
+    want = run()
+    monkeypatch.delenv("LMRS_MULTIROW")
+    got = run()
+    assert got == want

@@ -202,3 +202,36 @@ def test_gelu_activation_forward():
     cfg_bad = dataclasses.replace(cfg_s, activation="relu")
     with pytest.raises(ValueError, match="activation"):
         forward(params, cfg_bad, tokens, pos)
+
+
+def test_checkpoint_mesh_portability(tmp_path):
+    """VERDICT r5 item 7: a checkpoint SAVED from a tp=2-sharded tree must
+    restore onto a DIFFERENT topology — tp=4 and a dp×tp mesh — with
+    forward parity.  Orbax stores the logical array regardless of the
+    save-time sharding; this pins that no shard-layout detail leaks into
+    the checkpoint and that restore re-shards to whatever mesh serves."""
+    from lmrs_tpu.parallel.mesh import build_mesh
+    from lmrs_tpu.parallel.sharding import shard_params
+
+    # n_kv_heads=4 so kv heads divide the widest tp axis under test (4)
+    cfg = _cfg(n_kv_heads=4)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(1, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16)[None], (2, 16))
+    want, _ = forward(params, cfg, tokens, pos)
+
+    mesh_save = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    sharded = shard_params(params, mesh_save, cfg.tie_embeddings)
+    assert sharded["layers"]["attn"]["wq"].sharding.spec[2] == "tp"
+    save_checkpoint(str(tmp_path / "ckpt"), sharded)
+
+    for mesh_cfg in (MeshConfig(tp=4), MeshConfig(dp=2, tp=2)):
+        mesh = build_mesh(mesh_cfg, jax.devices()[: mesh_cfg.n_devices])
+        restored = load_checkpoint(str(tmp_path / "ckpt"), cfg, mesh=mesh)
+        # the tree restored onto the NEW topology, values intact
+        _trees_equal(params, restored)
+        got, _ = forward(restored, cfg, tokens, pos)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)

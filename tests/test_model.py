@@ -153,6 +153,61 @@ def test_sampling_temp_only_matches_filtered_formulation():
         assert fast.tolist() == want.tolist()
 
 
+def test_sampler_cond_survives_scheduler_contexts():
+    """Guard for the round-5 lax.cond fast paths (ADVICE r5): the full-
+    vocab sort and the categorical draw are gated by TWO lax.conds that
+    must survive the jit contexts the engines actually call from — a
+    ``lax.scan`` decode block (continuous scheduler) and a
+    ``lax.while_loop`` body (static engine).  Under ``vmap`` over batched
+    sampler params those conds silently lower to compute-both-branches
+    (the sort runs for every row mix) — asserted here as the degenerate
+    so the guard fails loudly if anyone ever routes sampling through
+    vmap.  The jaxpr is the contract: 'cond' surviving tracing is exactly
+    'the sort is device-branched', no timing flakiness."""
+    logits = jnp.zeros((4, 32))
+    key = jax.random.PRNGKey(0)
+    temps = jnp.zeros((4,))
+    tk = jnp.zeros((4,), jnp.int32)
+    tp = jnp.ones((4,))
+
+    def scan_block(logits, key, temps, tk, tp):
+        # the scheduler's decode-block shape: sample_logits per scan step
+        def step(carry, _):
+            key, sub = jax.random.split(carry)
+            return key, sample_logits(logits, sub, temps, tk, tp)
+
+        return jax.lax.scan(step, key, None, length=4)
+
+    def while_block(logits, key, temps, tk, tp):
+        # the static engine's while_loop shape (jax_engine._get_gen_fn)
+        def cond(state):
+            return state[0] < 2
+
+        def body(state):
+            i, key, _ = state
+            key, sub = jax.random.split(key)
+            return i + 1, key, sample_logits(logits, sub, temps, tk, tp)
+
+        return jax.lax.while_loop(
+            cond, body, (0, key, jnp.zeros((4,), jnp.int32)))
+
+    for ctx in (scan_block, while_block):
+        jaxpr = str(jax.make_jaxpr(ctx)(logits, key, temps, tk, tp))
+        assert jaxpr.count("cond[") >= 2, (
+            f"{ctx.__name__}: sampler lax.cond gates did not survive "
+            "tracing — the 4.8 ms/step full-vocab sort would run "
+            "unconditionally (docs/PERF.md round 5)")
+
+    # the documented degradation is real: vmap over batched sampler
+    # params batches the predicate and the conds vanish
+    vmapped = jax.vmap(
+        lambda l, t: sample_logits(l[None], key, t[None], tk[:1], tp[:1])[0])
+    jaxpr = str(jax.make_jaxpr(vmapped)(logits, temps))
+    assert "cond[" not in jaxpr, (
+        "vmap no longer degrades the cond gates — the call-site comments "
+        "(scheduler/jax_engine) and ops/sampling.py NOTE can be relaxed")
+
+
 def test_model_presets_exist():
     for name in ["tiny", "llama3-8b", "llama3-70b", "gemma-2b", "gemma-7b"]:
         cfg = model_preset(name)
