@@ -201,6 +201,21 @@ class EngineConfig:
     # forms as data.tokenizer.get_tokenizer: "byte", a *.model SentencePiece
     # path, or an HF tokenizer directory/repo id (local_files_only).
     tokenizer: str = ""
+    # Fault-injection plane (lmrs_tpu/testing/faults.py): a JSON FaultPlan
+    # (or "@/path/to/plan.json") installed process-globally by make_engine.
+    # Empty = disabled — every injection site is a module-level no-op and
+    # the hot path pays nothing (the tier-1 A/B gate asserts the greedy
+    # output is token-identical with the plane disarmed).
+    fault_plan: str = field(
+        default_factory=lambda: _env("LMRS_FAULT_PLAN", ""))
+    # Deadline budget (seconds) the MAP EXECUTOR stamps onto every request
+    # it runs that doesn't already carry one (0 = no deadline).  A
+    # deadline-carrying request is shed at admission when the remaining
+    # budget can't cover the TTFT estimate (finish_reason="shed") and
+    # expired in flight at the next block boundary ("deadline"); executor
+    # and router retries clip to the remaining budget.
+    request_deadline_s: float = field(
+        default_factory=lambda: _env("LMRS_REQUEST_DEADLINE", 0.0, float))
 
     def __post_init__(self) -> None:
         # Reference DEFAULT_PROVIDER values name HTTP vendors; both map to
@@ -217,6 +232,10 @@ class EngineConfig:
             raise ValueError(f"decode_row_group must be >= 1 "
                              f"(got {self.decode_row_group}); use "
                              "LMRS_MULTIROW=0 to disable row grouping")
+        if self.request_deadline_s < 0:
+            raise ValueError(f"request_deadline_s must be >= 0 "
+                             f"(got {self.request_deadline_s}); 0 disables "
+                             "deadlines")
 
 
 @dataclass

@@ -40,6 +40,31 @@ class GenerationRequest:
     # off-by-a-few-chars hint costs nothing.  Engines without a prefix cache
     # ignore it.
     cache_prefix: int | None = None
+    # Absolute deadline (``time.time()`` epoch seconds) after which this
+    # request's result is worthless to its caller.  None = unbounded (the
+    # pre-deadline behavior).  Contract (docs/ROBUSTNESS.md): a request
+    # whose remaining budget cannot cover the engine's TTFT estimate is
+    # shed BEFORE prefill (``finish_reason="shed"``, no engine work); one
+    # that expires in flight is finished at the next block boundary with
+    # ``finish_reason="deadline"`` keeping the tokens generated so far;
+    # retries (executor + router) clip to the remaining budget.  Wire
+    # clients send a RELATIVE budget (``deadline_s`` body field /
+    # ``X-LMRS-Deadline`` header, seconds); the server anchors it to its
+    # own clock at ingress, and the router re-derives the remaining budget
+    # when forwarding — absolute wall-clock never crosses a host boundary.
+    deadline_s: float | None = None
+
+
+def remaining_budget(req: GenerationRequest,
+                     now: float | None = None) -> float | None:
+    """Seconds of deadline budget left (negative = expired); None when the
+    request carries no deadline.  The one remaining-time computation shared
+    by scheduler shedding, executor retry clipping, and router forwarding."""
+    if req.deadline_s is None:
+        return None
+    import time
+
+    return req.deadline_s - (time.time() if now is None else now)
 
 
 @dataclass
@@ -51,7 +76,15 @@ class GenerationResult:
     text: str = ""
     prompt_tokens: int = 0
     completion_tokens: int = 0
-    finish_reason: str = "stop"  # stop | length | error | cancelled
+    # stop | length | error | cancelled | deadline | shed — the last two
+    # are deadline-lifecycle terminals (api.GenerationRequest.deadline_s):
+    # "deadline" expired in flight (partial text kept), "shed" rejected at
+    # admission before any engine work.  Engine-side neither sets
+    # ``error`` (they are outcomes the caller asked for, not faults to
+    # retry); the one exception is the executor's retry clip, which marks
+    # a request that FAILED and then ran out of budget to retry with both
+    # finish_reason="deadline" and the error — the failure stays visible.
+    finish_reason: str = "stop"
     # which request stop string ended generation, if any — lets wire formats
     # that distinguish stop-sequence hits from EOS (Anthropic's
     # stop_reason="stop_sequence") report faithfully
@@ -62,6 +95,23 @@ class GenerationResult:
     @property
     def total_tokens(self) -> int:
         return self.prompt_tokens + self.completion_tokens
+
+
+def degraded_reason(res: "GenerationResult") -> str | None:
+    """Why this result carries NO usable content, or None when it does:
+    the error itself, or a content-less terminal outcome (``shed`` /
+    ``deadline`` / ``cancelled`` with no partial text).  Pipeline
+    consumers branch on THIS instead of ``res.error`` — the deadline
+    terminals deliberately leave ``error`` unset, and without this check
+    a shed map chunk would masquerade as a successful empty summary and
+    silently drop its section from the final output.  A deadline/cancel
+    result that DOES carry partial text counts as usable
+    (degrade-and-continue keeps real output)."""
+    if res.error is not None:
+        return res.error
+    if res.finish_reason in ("shed", "deadline", "cancelled") and not res.text:
+        return f"request {res.finish_reason} before any output"
+    return None
 
 
 def apply_stop_sequences(text: str, stops: tuple[str, ...]) -> tuple[str, str | None]:
@@ -167,6 +217,12 @@ def make_engine(
     mesh_cfg: "MeshConfig | None" = None,
 ) -> Engine:
     """Engine factory keyed on ``EngineConfig.backend``."""
+    if engine_cfg.fault_plan:
+        # arm the fault-injection plane for this process (testing/faults.py);
+        # default-empty configs never touch it (module no-op stays in place)
+        from lmrs_tpu.testing import faults
+
+        faults.install_spec(engine_cfg.fault_plan)
     if engine_cfg.backend == "mock":
         from lmrs_tpu.engine.mock import MockEngine
 

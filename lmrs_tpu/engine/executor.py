@@ -22,13 +22,15 @@ reference's semantics are preserved exactly, re-based onto a local engine:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import replace
 from typing import Sequence
 
 from lmrs_tpu.config import EngineConfig
 from lmrs_tpu.data.chunker import Chunk
-from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+from lmrs_tpu.engine.api import (Engine, GenerationRequest, GenerationResult,
+                                 degraded_reason, remaining_budget)
 from lmrs_tpu.obs import PID_PIPELINE, get_tracer
 from lmrs_tpu.prompts import safe_format, shared_prefix_chars
 
@@ -46,6 +48,110 @@ class MapExecutor:
         self.total_device_seconds = 0.0
         self.total_requests = 0
         self.failed_requests = 0
+        # request ids aborted via cancel(): consulted before every retry so
+        # a cancelled request is never resurrected by a retry clone, and
+        # set from any thread (set.add is GIL-atomic) while a run is live.
+        # RUN-SCOPED: request ids are reused across runs on one executor
+        # (map chunks and reduce nodes both count from 0), so unlike the
+        # scheduler's globally-unique-rid convention, a cancel here only
+        # targets the run in flight — cancel() no-ops when none is (a
+        # stale id must not poison a later run's same-numbered request),
+        # and the set clears at run start.
+        self._cancelled: set[int] = set()
+        self._run_live = False
+        # orders cancel()'s liveness check + add against the run-start
+        # clear and run-end flag flip: without it a cancel racing a run
+        # boundary could pass the check for run N and land its id in run
+        # N+1's freshly-cleared set — the poisoning run-scoping exists to
+        # prevent.
+        self._cancel_lock = threading.Lock()
+        # Engine-boundary rid epoch: the ENGINE sees caller ids offset by
+        # a per-run base (run N uses [N<<20, (N+1)<<20); retry clones sit
+        # just below their base).  Engines keep cancel state across run
+        # boundaries by design (the scheduler's set clears at END of run,
+        # assuming globally-unique batcher rids) — with raw reused caller
+        # ids, a cancel forwarded as a run ends would alias an unrelated
+        # same-numbered request in the next run.  Epoch ids make every
+        # engine-visible id process-unique, so a stale forward can never
+        # match anything.  Caller-facing ids are unchanged: results are
+        # normalized back before any bookkeeping or delivery.
+        self._epoch = 0
+        self._rid_base = 0
+        # original id -> live retry-clone ENGINE-SPACE id (streaming
+        # retries), so cancel() can chase the clone currently in flight
+        self._live_clone: dict[int, int] = {}
+        # wakes the retry backoff early (cancel/interrupt): the wave loop
+        # must never sit in an uninterruptible sleep while its requests'
+        # deadlines burn down.  _interrupted makes interrupt() sticky for
+        # the rest of the run (every later backoff is skipped too).
+        self._wake = threading.Event()
+        self._interrupted = False
+
+    def cancel(self, request_id: int) -> None:
+        """Abort ``request_id`` of the CURRENT run: the id is never
+        retried again (a cancel must not be resurrected by a retry clone),
+        any in-flight retry clone is chased through the engine's cancel
+        hook, and a sleeping retry backoff wakes immediately.  Callable
+        from any thread; unknown ids no-op, and so does a cancel with no
+        run in flight — ids are reused across runs, so a stale cancel has
+        no valid target.  The engine is told the EPOCH id (see __init__),
+        so even a forward racing the run boundary can never alias a later
+        run's request inside the engine's own cancel bookkeeping."""
+        with self._cancel_lock:
+            if not self._run_live:
+                return
+            self._cancelled.add(request_id)
+            engine_rid = self._rid_base + request_id
+            clone = self._live_clone.get(request_id)
+        self._wake.set()
+        eng_cancel = getattr(self.engine, "cancel", None)
+        if eng_cancel is not None:
+            eng_cancel(engine_rid)
+            if clone is not None:
+                eng_cancel(clone)
+
+    def _new_epoch(self) -> int:
+        """Advance to the next engine-rid epoch (run start, under the
+        cancel lock by callers).  2**20 of headroom per run bounds caller
+        ids; ``register`` enforces the bound on the streaming path."""
+        self._rid_base = self._epoch
+        self._epoch += 1 << 20
+        return self._rid_base
+
+    def interrupt(self) -> None:
+        """Wake any in-progress retry backoff AND skip the remaining ones
+        (shutdown paths): sticky for the current run — a one-shot wake
+        would only skip the backoff in flight and then sleep out every
+        later retry's full delay.  Cleared at the next run's start."""
+        self._interrupted = True
+        self._wake.set()
+
+    @staticmethod
+    def _cancelled_result(rid: int, res: GenerationResult) -> GenerationResult:
+        """Terminal-cancel conversion — ONE rule shared by the wave loop
+        and the streaming wrapper: the abandoned id reports cancelled;
+        text and token accounting survive only from a completed attempt
+        (real output, the keep-partial-output convention), never from a
+        failure, and the error never surfaces (the caller cancelled)."""
+        ok = res.error is None
+        return GenerationResult(
+            request_id=rid,
+            text=res.text if ok else "",
+            prompt_tokens=res.prompt_tokens if ok else 0,
+            completion_tokens=res.completion_tokens if ok else 0,
+            finish_reason="cancelled")
+
+    def _stamp_deadlines(self, reqs: list[GenerationRequest]) -> None:
+        """Apply the config-level deadline budget to requests that don't
+        already carry one — the single point where EngineConfig
+        .request_deadline_s enters the request stream (map chunks, reduce
+        nodes, and streamed submissions all pass through here)."""
+        budget = self.config.request_deadline_s
+        if budget and budget > 0:
+            now = time.time()
+            for r in reqs:
+                if r.deadline_s is None:
+                    r.deadline_s = now + budget
 
     # ------------------------------------------------------------------ map
 
@@ -96,9 +202,13 @@ class MapExecutor:
         results = self.run_requests(requests)
         failed = 0
         for chunk, res in zip(flat, results):
-            if res.error is not None:
-                chunk.summary = f"[Error processing chunk: {res.error}]"
-                chunk.error = res.error
+            # degraded_reason, not res.error: shed/deadline terminals carry
+            # no error but may carry no content either — an empty summary
+            # must be marked, not silently aggregated as success
+            reason = degraded_reason(res)
+            if reason is not None:
+                chunk.summary = f"[Error processing chunk: {reason}]"
+                chunk.error = reason
                 failed += 1
             else:
                 chunk.summary = res.text
@@ -158,15 +268,50 @@ class MapExecutor:
             wave = max(1, len(requests))
         else:
             wave = max(1, self.config.max_concurrent_requests)
+        for r in requests:
+            # same bound register() enforces on the streaming path: an id
+            # past the epoch stride would land in a later run's reserved
+            # engine-id band, re-enabling the stale-cancel aliasing the
+            # epoch scheme exists to prevent
+            if not 0 <= r.request_id < 1 << 19:
+                raise ValueError(f"request_ids must be in [0, {1 << 19}) "
+                                 f"(got {r.request_id}); the engine-boundary "
+                                 "epoch reserves the rest")
+        self._stamp_deadlines(requests)
         done: dict[int, GenerationResult] = {}
         pending = list(requests)
         attempt = 1
+        with self._cancel_lock:  # run-scoped cancel state (see __init__)
+            self._cancelled.clear()
+            self._live_clone.clear()
+            self._new_epoch()
+            self._interrupted = False
+            self._run_live = True
+        try:
+            return self._run_waves(pending, done, attempt, wave, requests)
+        finally:
+            with self._cancel_lock:
+                self._run_live = False
+
+    def _run_waves(self, pending, done, attempt, wave,
+                   requests) -> list[GenerationResult]:
+        last_error: dict[int, str] = {}  # rid -> most recent failure
         while pending:
+            # re-arm BEFORE dispatching the wave: a cancel()/interrupt()
+            # landing any time after this (mid-wave or mid-backoff) leaves
+            # the event set, so the backoff below returns immediately
+            self._wake.clear()
             failed: list[GenerationRequest] = []
             for i in range(0, len(pending), wave):
                 batch = pending[i : i + wave]
+                # the engine sees epoch ids (__init__); results normalize
+                # straight back to caller space before any bookkeeping
+                base = self._rid_base
+                ebatch = [replace(r, request_id=base + r.request_id)
+                          for r in batch]
                 try:
-                    results = self.engine.generate_batch(batch)
+                    results = [replace(res, request_id=res.request_id - base)
+                               for res in self.engine.generate_batch(ebatch)]
                 except Exception as e:  # engine-level fault: fail the batch
                     logger.exception("engine batch failure")
                     results = [
@@ -175,7 +320,14 @@ class MapExecutor:
                     ]
                 for req, res in zip(batch, results):
                     self.total_requests += 1
-                    if res.error is not None:
+                    if (req.request_id in self._cancelled
+                            and res.finish_reason != "cancelled"):
+                        # terminal cancel: a completed attempt must not
+                        # resurrect an abandoned id as a success
+                        done[req.request_id] = self._cancelled_result(
+                            req.request_id, res)
+                    elif res.error is not None:
+                        last_error[req.request_id] = res.error
                         failed.append(req)
                     else:
                         done[res.request_id] = res
@@ -186,21 +338,61 @@ class MapExecutor:
             if attempt >= self.config.retry_attempts:
                 for req in failed:
                     self.failed_requests += 1
+                    # root cause kept alongside the exhaustion marker (the
+                    # same keep-the-failure-visible rule as the deadline
+                    # clip below): triage must not have to go to the logs
+                    cause = last_error.get(req.request_id)
                     done.setdefault(
                         req.request_id,
                         GenerationResult(
                             request_id=req.request_id,
                             finish_reason="error",
-                            error=f"failed after {attempt} attempts",
+                            error=f"failed after {attempt} attempts"
+                                  + (f": {cause}" if cause else ""),
                         ),
                     )
                 break
+            # Deadline-aware, interruptible backoff (the reference slept
+            # RETRY_DELAY unconditionally): the wait clips to the soonest
+            # failed request's remaining budget — sleeping past a deadline
+            # would burn the budget the retry needs — and cancel()/
+            # interrupt() wake it immediately instead of stalling the wave
+            # loop.
+            delay = self.config.retry_delay
+            # positive budgets only: an ALREADY-expired request is dropped
+            # from the retry set right below and never retried, so its
+            # negative budget must not zero the backoff for the others
+            rems = [r for r in (remaining_budget(q) for q in failed)
+                    if r is not None and r > 0]
+            if rems:
+                delay = max(0.0, min(delay, min(rems)))
             logger.warning(
                 "retrying %d failed requests (attempt %d/%d) after %.1fs",
-                len(failed), attempt + 1, self.config.retry_attempts, self.config.retry_delay,
+                len(failed), attempt + 1, self.config.retry_attempts, delay,
             )
-            time.sleep(self.config.retry_delay)
-            pending = failed
+            if delay and not self._interrupted:
+                self._wake.wait(delay)
+            # clip the retry set: cancelled ids must not resurrect, and a
+            # request whose budget is gone finishes as "deadline" now —
+            # a retry could not complete in time anyway
+            now = time.time()
+            pending = []
+            for req in failed:
+                rid = req.request_id
+                if rid in self._cancelled:
+                    done.setdefault(rid, GenerationResult(
+                        request_id=rid, finish_reason="cancelled"))
+                elif req.deadline_s is not None and req.deadline_s <= now:
+                    self.failed_requests += 1
+                    # the root-cause failure stays visible (api.py
+                    # contract; the streaming clip preserves it too) —
+                    # finish_reason already says the budget ran out
+                    done.setdefault(rid, GenerationResult(
+                        request_id=rid, finish_reason="deadline",
+                        error=last_error.get(
+                            rid, "deadline exceeded before retry")))
+                else:
+                    pending.append(req)
             attempt += 1
         return [done[r.request_id] for r in requests]
 
@@ -215,57 +407,100 @@ class MapExecutor:
         immediately — device faults don't need the HTTP-style
         ``retry_delay`` spacing — up to ``retry_attempts``, then delivered
         with its error (degrade-and-continue).  Retried copies get fresh
-        NEGATIVE request_ids internally (the scheduler's stream requires
-        unique ids) and are delivered under the original id; callers must
-        use ids >= 0.
+        ids just below the run's engine-rid epoch base (the scheduler's
+        stream requires unique ids; the epoch keeps them unique across
+        runs too) and are delivered under the original id; callers must
+        use ids in [0, 2**19).
         """
-        by_id: dict[int, GenerationRequest] = {}
+        by_id: dict[int, GenerationRequest] = {}  # CALLER-space throughout
         attempts: dict[int, int] = {}
-        orig_of: dict[int, int] = {}  # retry clone id -> original id
+        orig_of: dict[int, int] = {}  # engine-space clone id -> caller id
         finals: set[int] = set()
         retry_seq = [0]
+        with self._cancel_lock:  # run-scoped cancel state (see __init__)
+            self._cancelled.clear()
+            self._live_clone.clear()
+            base = self._new_epoch()  # engine sees base-offset ids
+            self._interrupted = False
+            self._run_live = True
 
         def register(reqs: list[GenerationRequest]) -> None:
+            self._stamp_deadlines(reqs)
             for r in reqs:
-                if r.request_id < 0:
-                    raise ValueError("streaming request_ids must be >= 0")
+                if not 0 <= r.request_id < 1 << 19:
+                    raise ValueError("streaming request_ids must be in "
+                                     f"[0, {1 << 19}) (got {r.request_id}); "
+                                     "the engine-boundary epoch reserves "
+                                     "the rest")
                 by_id[r.request_id] = r
                 attempts[r.request_id] = 1
+
+        def to_engine(reqs: list[GenerationRequest]) -> list[GenerationRequest]:
+            return [replace(r, request_id=base + r.request_id) for r in reqs]
 
         register(requests)
 
         def wrapper(res: GenerationResult, submit) -> None:
-            rid = orig_of.pop(res.request_id, res.request_id)
+            rid = orig_of.pop(res.request_id, None)
+            if rid is not None:  # a retry clone came home
+                self._live_clone.pop(rid, None)
+            else:
+                rid = res.request_id - base
             self.total_requests += 1
             req = by_id.get(rid)
+            # Retry gate: cancelled ids must never be resurrected by a
+            # retry clone (the cancel-vs-retry race), and a request whose
+            # deadline budget is gone is delivered now — the clone could
+            # not finish in time.
+            cancelled = rid in self._cancelled
+            expired = (req is not None and req.deadline_s is not None
+                       and req.deadline_s <= time.time())
             if (res.error is not None and req is not None
+                    and not cancelled and not expired
                     and attempts[rid] < self.config.retry_attempts):
                 attempts[rid] += 1
                 retry_seq[0] -= 1
-                clone = replace(req, request_id=retry_seq[0])
+                # clone ids sit just below this run's epoch base: unique
+                # within the run (scheduler stream requirement) AND across
+                # runs (stale engine-side cancels can never alias them)
+                clone = replace(req, request_id=base + retry_seq[0])
                 orig_of[clone.request_id] = rid
+                self._live_clone[rid] = clone.request_id
                 logger.warning("streaming retry %d/%d for request %d",
                                attempts[rid], self.config.retry_attempts, rid)
                 submit([clone])
                 return
+            if cancelled and res.finish_reason != "cancelled":
+                # a recorded cancel is TERMINAL at this layer: even an
+                # attempt (or retry clone) that completed — the engine may
+                # lack a cancel hook, or the cancel raced the completion —
+                # must not come back as a normal success for an id its
+                # caller abandoned
+                res = self._cancelled_result(rid, res)
+            elif (res.error is not None and expired and req is not None
+                    and attempts[rid] < self.config.retry_attempts):
+                # the retry was blocked by the expired budget alone: the
+                # same clip as run_requests — a deadline outcome with the
+                # underlying failure preserved
+                res = replace(res, request_id=rid, finish_reason="deadline")
             if res.error is not None:
                 self.failed_requests += 1
             else:
                 self.total_tokens_used += res.total_tokens
                 self.total_device_seconds += res.device_seconds
-            if res.request_id != rid:
+            if res.request_id != rid:  # engine/clone space -> caller space
                 res = replace(res, request_id=rid)
             finals.add(rid)
 
             def submit_user(new_reqs: list[GenerationRequest]) -> None:
                 register(new_reqs)
-                submit(new_reqs)
+                submit(to_engine(new_reqs))
 
             on_final(res, submit_user)
 
         try:
-            self.engine.generate_batch(requests, on_result=wrapper)
-        except Exception as e:
+            self.engine.generate_batch(to_engine(requests), on_result=wrapper)
+        except Exception as e:  # noqa: BLE001 - degrade-and-continue below
             # engine-level fault mid-stream: the same degrade-and-continue
             # contract run_requests enforces (every registered request gets
             # an error result; no exception escapes to the pipeline)
@@ -278,6 +513,9 @@ class MapExecutor:
                 on_final(GenerationResult(request_id=rid, finish_reason="error",
                                           error=msg),
                          lambda new_reqs: None)
+        finally:
+            with self._cancel_lock:
+                self._run_live = False
 
     # ------------------------------------------------------------ reporting
 

@@ -238,6 +238,11 @@ class JaxEngine:
                        on_result=None, on_tokens=None) -> list[GenerationResult]:
         if not requests:
             return []
+        # injection site: an engine-level batch fault — callers (executor,
+        # HTTP batcher) must degrade it to per-request error results
+        from lmrs_tpu.testing import faults
+
+        faults.fire("engine.batch")
         if self._scheduler is not None:
             return self._scheduler.run(requests, on_result=on_result,
                                        on_tokens=on_tokens)
@@ -263,6 +268,21 @@ class JaxEngine:
 
     def _generate_static(self, requests: list[GenerationRequest]) -> list[GenerationResult]:
         t0 = time.time()
+        results: dict[int, GenerationResult] = {}
+        # Deadline admission on the static path: an expired request sheds
+        # before any encode/dispatch work.  IN-FLIGHT expiry is not
+        # available here — whole completions decode inside one on-device
+        # while_loop with no host sync to sweep at (docs/ROBUSTNESS.md
+        # scheduler-coverage note); the continuous scheduler is the
+        # deadline-complete path.
+        live = []
+        for req in requests:
+            if req.deadline_s is not None and req.deadline_s <= time.time():
+                results[id(req)] = GenerationResult(
+                    request_id=req.request_id, finish_reason="shed")
+            else:
+                live.append(req)
+        requests, all_requests = live, requests
         # Sort by tokenized length to minimize padding waste per bucket.
         encoded = []
         for req in requests:
@@ -276,14 +296,14 @@ class JaxEngine:
             encoded.append((req, ids))
         encoded.sort(key=lambda e: len(e[1]))
 
-        results: dict[int, GenerationResult] = {}
         B = max(1, self.cfg.max_batch_slots)
         for i in range(0, len(encoded), B):
             group = encoded[i : i + B]
             for req, res in self._run_group(group):
                 results[id(req)] = (req, res)[1]
-        out = [results[id(r)] for r in requests]
-        logger.info("generated %d requests in %.2fs", len(requests), time.time() - t0)
+        out = [results[id(r)] for r in all_requests]
+        logger.info("generated %d requests in %.2fs", len(all_requests),
+                    time.time() - t0)
         return out
 
     def _max_new(self, req: GenerationRequest) -> int:

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lmrs_tpu.config import ModelConfig
+from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.kv_cache")
 
@@ -212,6 +213,10 @@ class PagedKVCache:
     def alloc_pages(self, n: int) -> list[int]:
         """``allocator.alloc`` with the reclaim hook applied: under pressure,
         ask the prefix cache to evict before declaring OutOfPages."""
+        # injection site: a fired plan forces the back-pressure path even
+        # with free pages on hand — every caller must already treat
+        # OutOfPages as pressure, not error (tests/test_chaos.py proves it)
+        faults.fire("kv_cache.allocate", OutOfPages)
         if n > self.allocator.free_count and self.reclaim_cb is not None:
             self.reclaim_cb(n - self.allocator.free_count)
         return self.allocator.alloc(n)
@@ -243,3 +248,50 @@ class PagedKVCache:
             if s is not None:
                 out[i, : len(s.pages)] = s.pages
         return out
+
+
+def audit_allocator(allocator, num_pages: int,
+                    holders: dict[int, int]) -> list[str]:
+    """Page-pool invariant audit (the scheduler's ``audit()`` core).
+
+    ``holders`` maps page id -> how many references the CALLER can account
+    for (live sequences + prefix-cache retention).  Checks, returning one
+    human-readable string per violation (empty list = clean):
+
+    * conservation — every non-reserved page is either free (refcount 0)
+      or held (refcount > 0), and the two partitions sum to the pool;
+    * refcount balance — each page's allocator refcount equals the
+      accounted holder count (a leak shows as refcount > holders == 0; a
+      double-free / stray incref as a mismatch);
+    * no accounted holder points at a free or reserved page.
+
+    Works against both allocator implementations (Python free-list and the
+    native C++ one) through the shared ``free_count``/``refcount`` API.
+    """
+    violations: list[str] = []
+    reserved = getattr(type(allocator), "RESERVED", 1)
+    free = allocator.free_count
+    held = 0
+    for p in range(reserved, num_pages):
+        rc = allocator.refcount(p)
+        if rc < 0:
+            violations.append(f"page {p}: negative refcount {rc}")
+            continue
+        if rc > 0:
+            held += 1
+        expected = holders.get(p, 0)
+        if rc != expected:
+            kind = "leaked" if expected == 0 else "unbalanced"
+            violations.append(
+                f"page {p}: refcount {rc} but {expected} accounted "
+                f"holder(s) ({kind})")
+    if free + held != num_pages - reserved:
+        violations.append(
+            f"page conservation broken: {free} free + {held} held != "
+            f"{num_pages - reserved} usable")
+    for p in holders:
+        if not reserved <= p < num_pages:
+            violations.append(f"holder references out-of-range page {p}")
+    if allocator.refcount(0) != 0:
+        violations.append("reserved null page has a nonzero refcount")
+    return violations

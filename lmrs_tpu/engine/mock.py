@@ -19,6 +19,7 @@ from lmrs_tpu.data.tokenizer import ApproxTokenizer
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  apply_stop_sequences)
 from lmrs_tpu.obs import get_tracer, req_tid
+from lmrs_tpu.testing import faults
 
 _TS_RE = re.compile(r"\[(?:\d+:)?\d{2}:\d{2}\]")
 
@@ -48,6 +49,9 @@ class MockEngine:
         # cancel a later batch's same-numbered request or accumulate
         # unboundedly; callers keep ids unique across cancels (the HTTP
         # batcher's rids are global)
+        # injection site: same engine-level batch fault as JaxEngine — the
+        # no-device arm of the chaos soak (tests/test_chaos.py)
+        faults.fire("engine.batch")
 
         def one(req: GenerationRequest) -> GenerationResult:
             tr = get_tracer()
@@ -88,8 +92,22 @@ class MockEngine:
         return {}
 
     def _one(self, req: GenerationRequest) -> GenerationResult:
+        def expired() -> bool:
+            return (req.deadline_s is not None
+                    and time.time() >= req.deadline_s)
+
+        # deadline lifecycle on the no-device path, same split as the
+        # scheduler: expired BEFORE any work -> shed (zero-cost explicit
+        # rejection); expired during the simulated generation latency ->
+        # deadline (work was spent)
+        if expired():
+            return GenerationResult(request_id=req.request_id,
+                                    finish_reason="shed")
         if self.latency_s:
             time.sleep(self.latency_s)
+            if expired():
+                return GenerationResult(request_id=req.request_id,
+                                        finish_reason="deadline")
         if req.request_id in self.cancelled:
             return GenerationResult(request_id=req.request_id,
                                     finish_reason="cancelled")

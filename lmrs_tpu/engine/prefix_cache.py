@@ -47,6 +47,8 @@ from __future__ import annotations
 
 import logging
 
+from lmrs_tpu.testing import faults
+
 logger = logging.getLogger("lmrs.prefix_cache")
 
 
@@ -169,6 +171,10 @@ class PrefixCache:
         Adopted pages gain one allocator reference (the cache's); the
         caller keeps its own reference and releases it as usual.
         """
+        # injection site: fires BEFORE any tree/refcount mutation, so a
+        # fault here leaves the cache exactly as it was — the scheduler
+        # treats insertion failure as a lost optimization, never an error
+        faults.fire("prefix_cache.insert")
         ps = self.page_size
         limit = (len(ids) // ps) * ps
         if max_tokens is not None:
@@ -277,6 +283,69 @@ class PrefixCache:
     def clear(self) -> int:
         """Drop every node no live sequence shares (kill switch / tests)."""
         return self._evict_lru(self.cached_pages or 0) if self.cached_pages else 0
+
+    # ---------------------------------------------------------------- audit
+
+    def retained_pages(self) -> list[int]:
+        """Every page id the tree currently holds a reference on (one entry
+        per retention — duplicates would themselves be a bug ``audit``
+        reports)."""
+        out: list[int] = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            out.extend(node.pages)
+        return out
+
+    def audit(self) -> list[str]:
+        """Radix-tree structural invariants, one string per violation:
+
+        * every non-root node labels ``len(pages) * page_size`` tokens;
+        * each child is keyed by its first page's token block and points
+          back at its parent;
+        * no page is retained twice; ``cached_pages`` matches the walk;
+        * every retained page is live in the allocator (refcount >= 1 —
+          the cache's own reference; a refcount-0 retained page means the
+          cache is handing out freed pages).
+
+        Refcount BALANCE (tree + live sequences == allocator refcounts) is
+        the scheduler auditor's job — only it knows the live sequences.
+        """
+        ps = self.page_size
+        violations: list[str] = []
+        seen: dict[int, int] = {}
+        total = 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node is not self.root:
+                if len(node.tokens) != len(node.pages) * ps:
+                    violations.append(
+                        f"node with {len(node.tokens)} tokens holds "
+                        f"{len(node.pages)} pages (page_size {ps})")
+                if not node.tokens:
+                    violations.append("non-root node with empty edge label")
+            for key, child in node.children.items():
+                if child.parent is not node:
+                    violations.append("child's parent link is stale")
+                if tuple(child.tokens[:ps]) != key:
+                    violations.append(
+                        "child keyed by a block that is not its first page")
+                stack.append(child)
+            for p in node.pages:
+                seen[p] = seen.get(p, 0) + 1
+                total += 1
+                if self.allocator.refcount(p) < 1:
+                    violations.append(f"cache retains freed page {p}")
+        for p, n in seen.items():
+            if n > 1:
+                violations.append(f"page {p} retained {n} times")
+        if total != self.cached_pages:
+            violations.append(
+                f"cached_pages counter {self.cached_pages} != {total} "
+                "pages found in the tree")
+        return violations
 
     # -------------------------------------------------------------- reports
 

@@ -37,13 +37,15 @@ import numpy as np
 
 from lmrs_tpu.config import EngineConfig, ModelConfig
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
-                                 apply_stop_sequences)
-from lmrs_tpu.engine.kv_cache import OutOfPages, PagedKVCache, SequencePages
+                                 apply_stop_sequences, remaining_budget)
+from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
+                                      audit_allocator)
 from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.models.transformer import forward_paged
 from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, MetricsRegistry,
                           get_tracer, req_tid)
 from lmrs_tpu.ops.sampling import sample_logits
+from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.scheduler")
 
@@ -298,6 +300,18 @@ class ContinuousScheduler:
                            "dispatches a slot sat out waiting for pages")
         self._c_cancelled = c("lmrs_cancelled_total",
                               "requests aborted via cancel()")
+        # deadline lifecycle (GenerationRequest.deadline_s): in-flight
+        # expiries swept at block boundaries, admission-time sheds, and the
+        # slack requests arrive with (how close to the line the fleet runs)
+        self._c_deadline = c("lmrs_deadline_exceeded_total",
+                             "requests expired in flight "
+                             "(finish_reason=deadline)")
+        self._c_shed = c("lmrs_requests_shed_total",
+                         "requests shed at admission "
+                         "(finish_reason=shed)")
+        self._h_deadline_remaining = h("lmrs_deadline_remaining_seconds",
+                                       help="remaining deadline budget at "
+                                            "admission", unit="seconds")
         # prefix-cache counters (present even when the cache is off, so
         # bench windowing can always delta them): admissions that queried
         # the radix tree, admissions that matched, and prompt tokens whose
@@ -343,6 +357,16 @@ class ContinuousScheduler:
                                     help="live rows over row-group "
                                          "capacity per decode dispatch")
         self._tr = get_tracer()  # refreshed at each run()
+        # Deadline bookkeeping: fastest TTFT ever observed on this engine —
+        # the OPTIMISTIC admission estimate (shed only what is provably
+        # unmeetable; the mean would embed multi-second first-compile
+        # samples and shed healthy requests).  _any_deadline gates the
+        # per-iteration expiry sweep so deadline-free workloads pay zero.
+        self._ttft_min = float("inf")
+        self._any_deadline = False
+        # auditor bookkeeping: result records that OVERWROTE an existing
+        # result (every submitted id must terminate exactly once)
+        self._audit_double_finish = 0
 
     @property
     def metrics(self) -> dict:
@@ -361,6 +385,8 @@ class ContinuousScheduler:
             "stalls": int(self._c_stalls.value),
             "peak_active_slots": int(self._g_peak_slots.value),
             "cancelled": int(self._c_cancelled.value),
+            "deadline_exceeded": int(self._c_deadline.value),
+            "shed": int(self._c_shed.value),
             "blocked_seconds": self._c_blocked_seconds.value,
             "prefix_queries": int(self._c_prefix_queries.value),
             "prefix_hits": int(self._c_prefix_hits.value),
@@ -411,6 +437,8 @@ class ContinuousScheduler:
             "preemptions": m["preemptions"],
             "stalls": m["stalls"],
             "cancelled": m["cancelled"],
+            "deadline_exceeded": m["deadline_exceeded"],
+            "shed": m["shed"],
             "peak_active_slots": m["peak_active_slots"],
             "ttft_ms": self._h_ttft.percentile_report(),
             "decode_block_gap_ms": self._h_block_gap.percentile_report(),
@@ -546,12 +574,17 @@ class ContinuousScheduler:
         t_enq: dict[int, float] = {}
         last_block_t: float | None = None  # prev decode-dispatch timestamp
 
+        # deadline-free runs skip the per-iteration expiry sweep entirely
+        self._any_deadline = any(r.deadline_s is not None for r in requests)
+
         def submit(new_requests: list[GenerationRequest]) -> None:
             for req in new_requests:
                 ids, max_new = self._encode(req)
                 queue.append((req, ids, max_new, len(ids), [], None))
                 all_requests.append(req)
                 t_enq[req.request_id] = time.time()
+                if req.deadline_s is not None:
+                    self._any_deadline = True
                 if tr:
                     tr.instant("enqueue", ts=t_enq[req.request_id],
                                tid=req_tid(req.request_id),
@@ -580,8 +613,21 @@ class ContinuousScheduler:
 
         def admit():
             for b in range(self.B):
-                if slots[b] is not None or not queue:
+                if slots[b] is not None:
                     continue
+                # Deadline admission control (load shedding): drop head
+                # entries whose remaining budget cannot cover the TTFT
+                # estimate — a fast explicit rejection BEFORE prefill beats
+                # letting a saturated pod convert overload into queue wait
+                # that expires in a slot anyway.
+                while queue and self._any_deadline:
+                    rem = remaining_budget(queue[0][0])
+                    if rem is None or rem >= self._ttft_estimate(
+                            len(queue[0][1])):
+                        break
+                    self._expire_queue_entry(queue, 0, results, fresh)
+                if not queue:
+                    break
                 req, ids, max_new, n_prompt, prior, t0 = queue[0]
                 # Prefix-cache probe: clone the longest cached page prefix
                 # (ref-counted, read-only) and start prefill at the match
@@ -619,8 +665,17 @@ class ContinuousScheduler:
                             self.cache.allocator.free(cached_pages)
                         break  # back-pressure: wait for pages to free up
                 queue.popleft()
-                seq = SequencePages(
-                    pages=cached_pages + self.cache.alloc_pages(need))
+                try:
+                    seq = SequencePages(
+                        pages=cached_pages + self.cache.alloc_pages(need))
+                except OutOfPages:
+                    # pressure raced (or was injected) past the free-count
+                    # check above: release the match references, requeue at
+                    # the head, and wait — back-pressure, never failure
+                    if cached_pages:
+                        self.cache.allocator.free(cached_pages)
+                    queue.appendleft((req, ids, max_new, n_prompt, prior, t0))
+                    break
                 # counted at ADMISSION, not per probe: a back-pressured
                 # request re-probes every scheduler tick until pages free
                 # up, and retry ticks must not dilute the hit rate
@@ -634,6 +689,8 @@ class ContinuousScheduler:
                 # youngest-victim selection (a refreshed t_start would make
                 # the same request the perpetual preemption victim)
                 now = time.time()
+                if req.deadline_s is not None:
+                    self._h_deadline_remaining.observe(req.deadline_s - now)
                 st = _SlotState(req=req, prompt_ids=ids, max_new=max_new,
                                 seq=seq,
                                 t_start=t0 if t0 is not None else now,
@@ -681,11 +738,21 @@ class ContinuousScheduler:
 
         try:
             while True:
+                # injection site: a fired plan fails this scheduler
+                # iteration the way a bad dispatch would — exercising the
+                # pool-recovery path in the except below
+                faults.fire("scheduler.step")
                 # sweep cancellations first (block boundary): their results are
                 # then delivered with this iteration's fresh batch
                 if self._cancelled:
                     self._sweep_cancelled(queue, slots, results, active, fresh,
                                           kv_lens, last_tok)
+                # deadline expiry rides the same block-boundary cadence as
+                # the cancel sweep: an in-flight request expires within one
+                # decode block of its deadline
+                if self._any_deadline:
+                    self._sweep_deadlines(queue, slots, results, active,
+                                          fresh, kv_lens, last_tok)
                 # deliver fresh results first: the callback may submit new work,
                 # which the loop-exit check below must see (a reduce batch
                 # submitted by the LAST map result must still run)
@@ -827,6 +894,33 @@ class ContinuousScheduler:
                     if slots[b] is not None:
                         active[b] = True
 
+        except Exception:
+            # Dispatch/step failure mid-run.  The exception re-raises —
+            # every caller (MapExecutor, the HTTP batcher) already
+            # translates engine exceptions into per-request error results —
+            # but the ENGINE must survive for the next batch, so restore
+            # the pool invariants first: live slots' pages free, the queue
+            # drops (entries hold no pages), the device pools reallocate
+            # (a failed DONATED dispatch leaves k/v consumed), and the
+            # prefix cache — whose pages point into the discarded pool
+            # content — drops its retained nodes.
+            for b in range(self.B):
+                if slots[b] is not None:
+                    try:
+                        self.cache.close_sequence(slots[b].seq)
+                    except ValueError:
+                        logger.exception(
+                            "slot %d page release failed in recovery", b)
+                    slots[b] = None
+            queue.clear()
+            if self._prefix_cache is not None:
+                self._prefix_cache.clear()
+            self.cache.reallocate()
+            if self._kv_quant:
+                self.kscale = jnp.ones_like(self.kscale)
+                self.vscale = jnp.ones_like(self.vscale)
+            self._spec_buf = None  # donated with the pools; reseeds lazily
+            raise
         finally:
             # runs on normal completion AND mid-run failure: a dead
             # callback, stale streamed text, or stale cancel ids must not
@@ -864,14 +958,14 @@ class ContinuousScheduler:
                 # preemption semantics ever change
                 gen, text, stop_hit, _ = self._trim_tokens(
                     list(prior), max_new, req.stop)
-                results[req.request_id] = GenerationResult(
+                self._record_result(results, GenerationResult(
                     request_id=req.request_id,
                     text=text,
                     prompt_tokens=n_prompt,
                     completion_tokens=len(gen),
                     finish_reason="cancelled",
                     stop_sequence=stop_hit,
-                )
+                ))
                 fresh.append(req.request_id)
                 hit.add(req.request_id)
                 self._c_cancelled.inc()
@@ -891,6 +985,120 @@ class ContinuousScheduler:
             logger.debug("cancelled request %d (slot %d)",
                          st.req.request_id, b)
         self._cancelled -= hit
+
+    def _record_result(self, results: dict, res: GenerationResult) -> None:
+        """The ONE write path into a run's result dict: every submitted id
+        must terminate exactly once, so an overwrite is recorded for the
+        auditor instead of silently replacing the first outcome."""
+        if res.request_id in results:
+            self._audit_double_finish += 1
+            logger.error("request %d terminated more than once "
+                         "(%s over %s)", res.request_id, res.finish_reason,
+                         results[res.request_id].finish_reason)
+        results[res.request_id] = res
+
+    # ------------------------------------------------------------ deadlines
+
+    def _ttft_estimate(self, n_tokens: int) -> float:
+        """Optimistic engine-side TTFT estimate for admission shedding: the
+        fastest TTFT this engine has ever delivered (it reflects the real
+        chips, compiled programs, and host link), else the perf-model
+        prefill roofline bound (utils/perf_model).  Optimistic by design —
+        a request shed on this number is PROVABLY unmeetable, while a mean
+        would embed multi-second first-compile samples and shed healthy
+        traffic."""
+        if self._ttft_min != float("inf"):
+            return self._ttft_min
+        from lmrs_tpu.utils.perf_model import chip_spec, prefill_flops
+
+        return prefill_flops(self.model_cfg, max(1, n_tokens),
+                             head_tokens=1) / chip_spec().peak_flops
+
+    def _expire_queue_entry(self, queue, i: int, results, fresh) -> None:
+        """Terminate queue entry ``i`` that cannot (or can no longer) meet
+        its deadline.  Fresh requests shed before any prefill
+        (``finish_reason="shed"``, zero engine work); a preemption
+        continuation already produced output, so it finishes as
+        ``"deadline"`` keeping the trimmed prior tokens."""
+        req, _ids, max_new, n_prompt, prior, t0 = queue[i]
+        del queue[i]
+        continuation = t0 is not None
+        gen, text, stop_hit, _ = self._trim_tokens(list(prior), max_new,
+                                                   req.stop)
+        reason = "deadline" if continuation else "shed"
+        self._record_result(results, GenerationResult(
+            request_id=req.request_id,
+            text=text if continuation else "",
+            prompt_tokens=n_prompt,
+            completion_tokens=len(gen) if continuation else 0,
+            finish_reason=reason,
+            stop_sequence=stop_hit if continuation else None,
+        ))
+        fresh.append(req.request_id)
+        (self._c_deadline if continuation else self._c_shed).inc()
+        if self._tr:
+            self._tr.instant(reason, tid=req_tid(req.request_id),
+                             args={"queued": True})
+
+    def _sweep_deadlines(self, queue, slots, results, active, fresh,
+                         kv_lens, last_tok) -> None:
+        """Expire deadline-passed requests at a block boundary, riding the
+        cancel machinery: live slots finish with ``finish_reason=
+        "deadline"`` (pages freed, partial output kept — same teardown as a
+        cancel, _finish_slot); queued entries terminate without prefilling.
+        The WHOLE queue is scanned, not just the head: an entry stuck
+        behind back-pressure must not have to reach the head to expire."""
+        now = time.time()
+        for i in range(len(queue) - 1, -1, -1):
+            req = queue[i][0]
+            if req.deadline_s is not None and req.deadline_s <= now:
+                self._expire_queue_entry(queue, i, results, fresh)
+        for b in range(self.B):
+            st = slots[b]
+            if (st is None or st.req.deadline_s is None
+                    or st.req.deadline_s > now):
+                continue
+            gen, text, stop_hit, _ = self._trimmed_output(st)
+            self._finish_slot(b, slots, results, active, fresh, kv_lens,
+                              last_tok, gen, text, stop_hit, "deadline")
+            self._c_deadline.inc()
+            logger.debug("request %d expired in flight (slot %d)",
+                         st.req.request_id, b)
+
+    # ---------------------------------------------------------------- audit
+
+    def audit(self, live_seqs=None) -> list[str]:
+        """Cross-layer invariant auditor (tests/test_chaos.py closes every
+        soak scenario on it).  Checks, returning one string per violation
+        (empty list = clean):
+
+        * page conservation — free + live + prefix-cached pages cover the
+          pool exactly (kv_cache.audit_allocator);
+        * refcount balance — each page's allocator refcount equals its
+          accounted holders (live sequences + radix-tree retention);
+        * radix-tree structure — edge labels, child keys, parent links,
+          no double retention (prefix_cache.audit);
+        * termination discipline — no request of any run on this scheduler
+          ever terminated more than once (_record_result bookkeeping).
+
+        Between runs (the default) there are no live sequences; pass
+        ``live_seqs`` to audit mid-run state from a callback."""
+        holders: dict[int, int] = {}
+        for seq in live_seqs or ():
+            for p in seq.pages:
+                holders[p] = holders.get(p, 0) + 1
+        violations: list[str] = []
+        if self._prefix_cache is not None:
+            violations += self._prefix_cache.audit()
+            for p in self._prefix_cache.retained_pages():
+                holders[p] = holders.get(p, 0) + 1
+        violations += audit_allocator(self.cache.allocator,
+                                      self.cache.num_pages, holders)
+        if self._audit_double_finish:
+            violations.append(f"{self._audit_double_finish} result "
+                              "record(s) overwrote an existing result "
+                              "(termination-exactly-once broken)")
+        return violations
 
     def _trimmed_output(self, st: _SlotState):
         """(gen, text, stop_hit, hit_eos) for a slot's output so far —
@@ -913,6 +1121,7 @@ class ContinuousScheduler:
         t0 = t_enq.pop(st.req.request_id, None)
         if t0 is not None and not st.prior:
             now = time.time()
+            self._ttft_min = min(self._ttft_min, now - t0)
             self._h_ttft.observe(now - t0)
             if self._tr:
                 self._tr.instant("first_token", ts=now,
@@ -935,7 +1144,7 @@ class ContinuousScheduler:
         cancel sweep so finish semantics can never diverge."""
         st = slots[b]
         now = time.time()
-        results[st.req.request_id] = GenerationResult(
+        self._record_result(results, GenerationResult(
             request_id=st.req.request_id,
             text=text,
             prompt_tokens=st.n_prompt,
@@ -943,7 +1152,7 @@ class ContinuousScheduler:
             finish_reason=finish_reason,
             stop_sequence=stop_hit,
             device_seconds=now - st.t_start,
-        )
+        ))
         if self._tr:
             tid = req_tid(st.req.request_id)
             if st.t_decode_start:  # close the decode span of this slot life
@@ -1277,7 +1486,14 @@ class ContinuousScheduler:
             if not text:
                 return  # hint 0 and no system prompt: nothing shared
             cap = 1 + len(self.tokenizer.encode(text))
-        self._prefix_cache.insert(st.prompt_ids, st.seq.pages, max_tokens=cap)
+        try:
+            self._prefix_cache.insert(st.prompt_ids, st.seq.pages,
+                                      max_tokens=cap)
+        except Exception:
+            # caching is an optimization: an insertion fault (injected or
+            # real) must cost a cache hit, never the request
+            logger.warning("prefix-cache insert failed; request continues "
+                           "uncached", exc_info=True)
 
     def _preempt(self, b, slots, queue, kv_lens, last_tok, active) -> None:
         st = slots[b]

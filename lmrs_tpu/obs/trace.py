@@ -12,6 +12,11 @@ Event vocabulary (the per-request chain the scheduler emits):
     enqueue → admit → [prefix_match] → prefill → first_token
         → decode_block* → finish | preempt | cancel
 
+Deadline-lifecycle terminals add ``shed`` (rejected before prefill) and
+``deadline`` (queued expiry) instants; an in-flight expiry closes the
+``decode`` span and emits ``finish`` with ``reason="deadline"``
+(docs/ROBUSTNESS.md).
+
 plus scheduler-track ``decode_block``/``prefill_dispatch`` dispatch spans
 and pipeline-track ``map_stage``/``reduce_level``/stage spans.  Export is
 Chrome trace-event JSON (``{"traceEvents": [...]}``) loadable directly in

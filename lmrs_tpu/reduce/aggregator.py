@@ -27,7 +27,7 @@ from lmrs_tpu.config import ReduceConfig
 from lmrs_tpu.data.chunker import Chunk
 from lmrs_tpu.data.preprocessor import format_timestamp
 from lmrs_tpu.data.tokenizer import Tokenizer, get_tokenizer
-from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.api import GenerationRequest, degraded_reason
 from lmrs_tpu.engine.executor import MapExecutor
 from lmrs_tpu.obs import PID_PIPELINE, get_tracer
 from lmrs_tpu.prompts import (
@@ -150,8 +150,8 @@ class ResultAggregator:
         # degrade to an error string, never raise
         # (result_aggregator.py:256-259,284-286)
         return [
-            res.text if res.error is None
-            else f"[Error aggregating summaries: {res.error}]"
+            res.text if degraded_reason(res) is None
+            else f"[Error aggregating summaries: {degraded_reason(res)}]"
             for res in results
         ]
 
@@ -260,7 +260,8 @@ class SimpleAggregator:
             max_new_tokens=self.executor.config.max_tokens,
         )
         res = self.executor.run_requests([req])[0]
-        return res.text if res.error is None else f"[Error aggregating summaries: {res.error}]"
+        return (res.text if degraded_reason(res) is None
+                else f"[Error aggregating summaries: {degraded_reason(res)}]")
 
 
 # backwards-compat alias (tests import it from here)

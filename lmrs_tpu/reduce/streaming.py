@@ -36,6 +36,7 @@ from typing import Any, Sequence
 
 from lmrs_tpu.data.chunker import Chunk
 from lmrs_tpu.data.preprocessor import format_timestamp
+from lmrs_tpu.engine.api import degraded_reason
 from lmrs_tpu.prompts import (
     DEFAULT_BATCH_REDUCE_PROMPT,
     DEFAULT_FINAL_REDUCE_PROMPT,
@@ -221,9 +222,10 @@ class StreamingMapReduce:
             rid = res.request_id
             if rid in chunk_by_rid:  # ------------------------- map result
                 c = chunk_by_rid[rid]
-                if res.error is not None:
-                    c.summary = f"[Error processing chunk: {res.error}]"
-                    c.error = res.error
+                reason = degraded_reason(res)  # shed/deadline terminals
+                if reason is not None:           # carry no error field
+                    c.summary = f"[Error processing chunk: {reason}]"
+                    c.error = reason
                 else:
                     c.summary = res.text
                 c.tokens_used = res.total_tokens
@@ -258,8 +260,9 @@ class StreamingMapReduce:
                 return
             # ------------------------------------------------ reduce result
             kind = reduce_meta.pop(rid)
-            text = (res.text if res.error is None
-                    else f"[Error aggregating summaries: {res.error}]")
+            text = (res.text if degraded_reason(res) is None
+                    else f"[Error aggregating summaries: "
+                         f"{degraded_reason(res)}]")
             if kind[0] == "final":
                 st["final"] = text
                 st["levels"] = max(st["levels"], kind[1])

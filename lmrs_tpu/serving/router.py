@@ -39,12 +39,15 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import random
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import urlsplit
 
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
-                                 drain_with_callback)
+                                 drain_with_callback, remaining_budget)
+from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.router")
 
@@ -64,6 +67,11 @@ def _request_body(req: GenerationRequest) -> dict:
         body["top_k"] = req.top_k
     if req.seed is not None:
         body["seed"] = req.seed
+    if req.deadline_s is not None:
+        # the wire carries the REMAINING budget, re-derived at send time:
+        # absolute wall-clock never crosses a host boundary (clock skew),
+        # and a retry on a later host automatically forwards less budget
+        body["deadline_s"] = max(0.0, remaining_budget(req))
     return body
 
 
@@ -84,15 +92,27 @@ class _Host:
         self.healthy = True
         self.served = 0
         self.failed = 0
+        # earliest clock time the next recovery probe may launch (probe
+        # pacing lives in RouterEngine._launch_probes; 0 = probe freely)
+        self.next_probe_t = 0.0
 
     def connect(self, timeout: float) -> http.client.HTTPConnection:
+        # injection site: a connection-phase fault, raised AS the
+        # host-down class so it exercises the unhealthy-marking +
+        # failover path exactly like a dead backend
+        faults.fire("router.connect", _HostConnectError)
         return http.client.HTTPConnection(self.netloc, timeout=timeout)
 
     def probe(self) -> bool:
         """GET /healthz; re-admits an unhealthy host when it answers."""
         conn = None
         try:
-            conn = self.connect(timeout=2.0)
+            # own injection site, own connection: probes run on pool
+            # threads and must neither consume nor race the request
+            # path's ``router.connect`` occurrences (plan replay stays
+            # deterministic); a plan targets probes explicitly instead
+            faults.fire("router.probe", _HostConnectError)
+            conn = http.client.HTTPConnection(self.netloc, timeout=2.0)
             conn.request("GET", "/healthz")
             ok = conn.getresponse().status == 200
         except Exception:  # noqa: BLE001 - still down
@@ -110,13 +130,27 @@ class RouterEngine:
 
     schedules_internally = True  # each backend admission-controls itself
 
-    def __init__(self, hosts: list[str], timeout_s: float = 600.0):
+    def __init__(self, hosts: list[str], timeout_s: float = 600.0,
+                 probe_floor_s: float = 5.0, probe_jitter_s: float = 2.5,
+                 clock=time.monotonic):
         if not hosts:
             raise ValueError("RouterEngine needs at least one backend host")
         self.hosts = [_Host(h) for h in hosts]
         # per-recv socket timeout: must exceed the worst-case SILENT wait —
         # a non-streamed generation sends nothing until it completes
         self.timeout_s = timeout_s
+        # Recovery-probe pacing: a dead host under heavy traffic formerly
+        # drew one /healthz probe per WAVE — a probe storm scaling with
+        # offered load, each probe burning a pool thread on a 2 s connect
+        # timeout.  Probes now space at least ``probe_floor_s`` apart per
+        # host plus a random jitter in [0, probe_jitter_s) so a fleet of
+        # hosts dying together doesn't re-probe in lockstep.  ``clock`` is
+        # injectable for tests (fake time).
+        self.probe_floor_s = probe_floor_s
+        self.probe_jitter_s = probe_jitter_s
+        self._clock = clock
+        self._probe_rng = random.Random(0x90BE)
+        self._probe_lock = threading.Lock()  # waves race _launch_probes
         self._pool = ThreadPoolExecutor(
             max_workers=max(8, 4 * len(self.hosts)),
             thread_name_prefix="lmrs-router")
@@ -289,10 +323,10 @@ class RouterEngine:
         self._rr_base += len(requests)
         # recovery probes run CONCURRENTLY with the wave, on unhealthy
         # hosts only — a restarted worker re-admits without waiting for
-        # total fleet failure (ReplicatedEngine's probe loop, ported)
-        for host in self.hosts:
-            if not host.healthy:
-                self._pool.submit(host.probe)
+        # total fleet failure (ReplicatedEngine's probe loop, ported);
+        # paced per host so heavy traffic cannot turn a dead host into a
+        # probe storm (_launch_probes)
+        self._launch_probes()
         try:
             futures = [
                 self._pool.submit(self._one, base + i, req, on_tokens,
@@ -302,6 +336,26 @@ class RouterEngine:
             return [f.result() for f in futures]
         finally:
             self._wave_cancelled = None
+
+    def _launch_probes(self) -> list[_Host]:
+        """Submit a /healthz probe for each unhealthy host whose pacing
+        window has elapsed; returns the hosts probed (test hook).  The
+        next-probe stamp is claimed under a lock BEFORE submission, so
+        concurrent waves racing this method cannot double-probe a host —
+        the loser of the race just skips, covered by the winner's probe."""
+        now = self._clock()
+        probed: list[_Host] = []
+        with self._probe_lock:
+            for host in self.hosts:
+                if host.healthy or now < host.next_probe_t:
+                    continue
+                host.next_probe_t = (now + self.probe_floor_s
+                                     + self._probe_rng.random()
+                                     * self.probe_jitter_s)
+                probed.append(host)
+        for host in probed:
+            self._pool.submit(host.probe)
+        return probed
 
     def _targets(self, start: int) -> list[_Host]:
         """Healthy hosts in round-robin order from ``start``; every host
@@ -320,6 +374,13 @@ class RouterEngine:
             if rid in cancelled:
                 return GenerationResult(request_id=rid,
                                         finish_reason="cancelled")
+            rem = remaining_budget(req)
+            if rem is not None and rem <= 0:
+                # retry clipping: the budget is gone — a second host could
+                # not answer in time, so report the deadline instead of
+                # burning a backend slot on a worthless attempt
+                return GenerationResult(request_id=rid,
+                                        finish_reason="deadline")
             streamed = [0]  # deltas already forwarded on THIS request
             try:
                 res = self._post(host, req, on_tokens, streamed, cancelled)
@@ -355,7 +416,15 @@ class RouterEngine:
         if on_tokens is not None:
             body["stream"] = True
             body["stream_options"] = {"include_usage": True}
-        conn = host.connect(self.timeout_s)
+        timeout = self.timeout_s
+        rem = remaining_budget(req)
+        if rem is not None:
+            # the socket wait needs no more than the remaining budget plus
+            # slack for the backend's own deadline result to come back —
+            # without the clip an expired request would hold a dispatch
+            # thread for the full worst-case-generation timeout
+            timeout = max(1.0, min(timeout, rem + 5.0))
+        conn = host.connect(timeout)
         rid = req.request_id
         with self._inflight_lock:
             self._inflight[rid] = conn
@@ -433,6 +502,10 @@ class RouterEngine:
         done_seen = False  # the [DONE] terminator actually arrived
         try:
             for raw in resp:
+                # injection site: a mid-stream fault — "raise" simulates a
+                # worker dying mid-response (no retry: deltas already
+                # forwarded), "stall" a backend gone slow under load
+                faults.fire("router.recv", OSError)
                 line = raw.decode("utf-8", "replace").strip()
                 if not line.startswith("data:"):
                     continue

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import queue
 import socket
 import threading
@@ -37,6 +38,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.serving")
 
@@ -102,8 +104,10 @@ class _Batcher:
         to max_tokens holding its slot and pages."""
         job = _Job(request)
         with self._close_lock:
+            self._assign_rid(job)
             if self.closed:
-                return GenerationResult(request_id=0, finish_reason="error",
+                return GenerationResult(request_id=job.rid,
+                                        finish_reason="error",
                                         error="server shutting down")
             self.queue.put(job)
         if poll_disconnect is None:
@@ -122,15 +126,26 @@ class _Batcher:
         the None sentinel, then ``job.result`` is set (SSE handlers)."""
         job = _Job(request, stream=True)
         with self._close_lock:
+            self._assign_rid(job)
             if self.closed:
                 job.result = GenerationResult(
-                    request_id=0, finish_reason="error",
+                    request_id=job.rid, finish_reason="error",
                     error="server shutting down")
                 job.event.set()
                 job.deltas.put(None)
                 return job
             self.queue.put(job)
         return job
+
+    def _assign_rid(self, job: _Job) -> None:
+        """Give the job its wave rid AT ENQUEUE (caller holds _close_lock).
+        Rids were formerly assigned at dispatch, which left every
+        rejection path (shutdown fast-fail, the sentinel drain) emitting a
+        placeholder ``request_id=0`` that clients could not correlate —
+        now every result, error or not, echoes the job's real id."""
+        job.rid = self._next_rid
+        self._next_rid += 1
+        job.request.request_id = job.rid
 
     def cancel(self, job: _Job) -> None:
         """Abort ``job`` (client disconnected).  Queued jobs are dropped
@@ -187,18 +202,19 @@ class _Batcher:
                 return
             if job is None:
                 continue
+            # echo the job's enqueue-time rid; direct-constructed jobs
+            # (tests) fall back to the request's own id
+            rid = job.rid if job.rid is not None else job.request.request_id
             job.result = GenerationResult(
-                request_id=0, finish_reason="error", error="server shutting down")
+                request_id=rid, finish_reason="error",
+                error="server shutting down")
             job.event.set()
             if job.deltas is not None:
                 job.deltas.put(None)
 
     def _run(self, jobs: list[_Job]) -> None:
-        base = self._next_rid
-        self._next_rid += len(jobs)
-        for i, job in enumerate(jobs):  # engine results map back by id
-            job.request.request_id = base + i
-            job.rid = base + i
+        # rids were assigned at enqueue (_assign_rid): globally unique and
+        # monotonic across waves, and every rejection path can echo them
         # publish the wave BEFORE dispatch so cancel() can route a
         # disconnect into the running engine call; then drop jobs already
         # cancelled while queued (their clients are gone — finish them
@@ -252,6 +268,21 @@ class _Batcher:
             job.event.set()
             if job.deltas is not None:  # sentinel strictly after result
                 job.deltas.put(None)
+
+
+def _anthropic_stop_reason(res: GenerationResult) -> str:
+    """GenerationResult -> Anthropic ``stop_reason`` (one mapping for the
+    plain and SSE paths).  ``deadline`` and ``shed`` pass through as
+    extension values: collapsing them into ``max_tokens`` would make a
+    zero-work shed indistinguishable from a normal truncated completion
+    (docs/ROBUSTNESS.md promises the deadline outcomes stay visible)."""
+    if res.stop_sequence is not None:
+        return "stop_sequence"
+    if res.finish_reason == "stop":
+        return "end_turn"
+    if res.finish_reason in ("deadline", "shed"):
+        return res.finish_reason
+    return "max_tokens"
 
 
 def _clamp_max_tokens(value, cap: int) -> int:
@@ -407,6 +438,11 @@ class EngineHTTPServer:
                 early client FIN as abort matches common HTTP server
                 practice (e.g. nginx's default); half-close POST clients
                 are rare and still receive a well-formed response."""
+                # injection site: a fired plan reports the client gone —
+                # driving the disconnect->cancel propagation path without
+                # a real socket teardown
+                if faults.check("server.client_disconnect"):
+                    return True
                 try:
                     self.connection.setblocking(False)
                     try:
@@ -419,6 +455,35 @@ class EngineHTTPServer:
                     return True
                 return data == b""
 
+            def _apply_deadline(self, req: GenerationRequest,
+                                body: dict) -> bool:
+                """Anchor the wire deadline budget (RELATIVE seconds from
+                the ``X-LMRS-Deadline`` header, or the ``deadline_s`` body
+                field — header wins) to this server's clock.  Returns
+                False (after answering 400) on an unparseable value: a
+                silently dropped deadline would run the request
+                unbounded, the opposite of what the client asked for."""
+                raw = self.headers.get("X-LMRS-Deadline")
+                if raw is None:
+                    raw = body.get("deadline_s")
+                if raw is None:
+                    return True
+                try:
+                    budget = float(raw)
+                    # NaN poisons every downstream comparison (a NaN
+                    # deadline sheds on one engine and runs unbounded on
+                    # another) and inf is "no deadline" spelled wrong —
+                    # both are garbage, not budgets
+                    if not math.isfinite(budget):
+                        raise ValueError(budget)
+                except (TypeError, ValueError):
+                    self._send(400, {"error": {
+                        "message": f"invalid deadline budget {raw!r} "
+                                   "(want finite seconds as a number)"}})
+                    return False
+                req.deadline_s = time.time() + budget
+                return True
+
             def do_POST(self):
                 body = self._read_json()
                 if body is None:
@@ -427,6 +492,8 @@ class EngineHTTPServer:
                 try:
                     if self.path == "/v1/chat/completions":
                         req = _chat_to_request(body, outer.max_tokens_cap)
+                        if not self._apply_deadline(req, body):
+                            return
                         if body.get("stream"):
                             self._stream_openai(
                                 body, outer.batcher.submit_stream(req))
@@ -444,6 +511,8 @@ class EngineHTTPServer:
                         return
                     elif self.path == "/v1/messages":
                         req = _messages_to_request(body, outer.max_tokens_cap)
+                        if not self._apply_deadline(req, body):
+                            return
                         if body.get("stream"):
                             self._stream_anthropic(
                                 body, outer.batcher.submit_stream(req))
@@ -581,11 +650,8 @@ class EngineHTTPServer:
                         event="content_block_stop")
                     self._sse(json.dumps({
                         "type": "message_delta",
-                        "delta": {"stop_reason": (
-                            "stop_sequence" if res.stop_sequence is not None
-                            else "end_turn" if res.finish_reason == "stop"
-                            else "max_tokens"),
-                            "stop_sequence": res.stop_sequence},
+                        "delta": {"stop_reason": _anthropic_stop_reason(res),
+                                  "stop_sequence": res.stop_sequence},
                         "usage": {"input_tokens": res.prompt_tokens,
                                   "output_tokens": res.completion_tokens}}),
                         event="message_delta")
@@ -629,10 +695,7 @@ class EngineHTTPServer:
                     "role": "assistant",
                     "model": body.get("model") or outer.model_name,
                     "content": [{"type": "text", "text": res.text}],
-                    "stop_reason": (
-                        "stop_sequence" if res.stop_sequence is not None
-                        else "end_turn" if res.finish_reason == "stop"
-                        else "max_tokens"),
+                    "stop_reason": _anthropic_stop_reason(res),
                     "stop_sequence": res.stop_sequence,
                     "usage": {"input_tokens": res.prompt_tokens,
                               "output_tokens": res.completion_tokens},

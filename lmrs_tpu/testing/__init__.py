@@ -1,0 +1,4 @@
+"""Test-support subsystems that ship in-tree because production code hooks
+into them: the deterministic fault-injection plane (``testing.faults``) is
+threaded through the real engine/serving code paths and compiled to a no-op
+when no plan is installed."""

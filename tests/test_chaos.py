@@ -1,0 +1,397 @@
+"""Chaos soak: seeded random workloads x seeded fault plans, audited.
+
+The tentpole robustness gate (SURVEY.md §5.3 "no fault injection"): every
+scenario drives real requests through the MapExecutor into an engine while
+a deterministic FaultPlan (lmrs_tpu/testing/faults.py) fires OutOfPages
+pressure, scheduler step faults, engine batch faults, and prefix-cache
+insertion faults — then asserts the system-level invariants:
+
+* every submitted request terminates EXACTLY once, with a valid finish
+  reason (``stop|length|error|cancelled|deadline|shed``);
+* the scheduler's invariant auditor is clean after every scenario — page
+  conservation, refcount balance, radix-tree structure
+  (``ContinuousScheduler.audit``);
+* identical seeds x identical plans reproduce identical outcomes;
+* the fault plane disarmed is a byte-for-byte no-op (greedy A/B).
+
+Scenario seeds and plans are PINNED — the tier-1 chaos gate replays them
+verbatim.  Both engine arms run: MockEngine (no-device) and a CPU
+JaxEngine with a real continuous scheduler under page pressure.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.testing import faults
+from lmrs_tpu.testing.faults import FaultPlan
+
+VALID_REASONS = {"stop", "length", "error", "cancelled", "deadline", "shed"}
+
+_WORDS = ("alpha bravo charlie delta echo foxtrot golf hotel india "
+          "juliet kilo lima mike november oscar papa").split()
+
+
+def chaos_model() -> ModelConfig:
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def jax_engine():
+    # small page pool (vs. the 16-page-per-slot worst case) so real — not
+    # only injected — OutOfPages pressure occurs; decode_block 4 gives the
+    # sweeps frequent block boundaries
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=64, max_batch_slots=2, seed=0,
+                                 decode_block=4, page_size=16, num_pages=20),
+                    chaos_model())
+    yield eng
+    eng.shutdown()
+
+
+def make_workload(rng: random.Random, n: int,
+                  deadlines: bool = False,
+                  greedy: bool = False) -> list[GenerationRequest]:
+    reqs = []
+    for i in range(n):
+        prompt = f"chaos {i} " + " ".join(
+            rng.choice(_WORDS) for _ in range(rng.randint(2, 24)))
+        req = GenerationRequest(
+            prompt=prompt, request_id=i,
+            temperature=0.0 if greedy else rng.choice((0.0, 0.8)),
+            max_new_tokens=rng.randint(2, 16))
+        if deadlines and rng.random() < 0.4:
+            # a mix of already-expired, tight, and comfortable budgets
+            req.deadline_s = time.time() + rng.choice((-1.0, 0.05, 30.0))
+        reqs.append(req)
+    return reqs
+
+
+def soak(engine, sched, seed: int, plan_faults: list,
+         deadlines: bool = False, retries: int = 3, greedy: bool = False):
+    """One pinned scenario: run a seeded workload under a seeded plan
+    through the executor's retry machinery, then assert the termination
+    and auditor invariants."""
+    rng = random.Random(seed)
+    reqs = make_workload(rng, rng.randint(3, 6), deadlines, greedy)
+    ex = MapExecutor(engine, EngineConfig(
+        retry_attempts=retries, retry_delay=0.01))
+    with faults.injected(FaultPlan(seed=seed, faults=plan_faults)):
+        results = ex.run_requests(reqs)
+    # no result lost or duplicated, order preserved
+    assert [r.request_id for r in results] == [r.request_id for r in reqs]
+    for res in results:
+        assert res.finish_reason in VALID_REASONS, res
+        if res.finish_reason in ("stop", "length", "cancelled", "shed"):
+            # "deadline" may carry an error when a FAILED request's budget
+            # expired before its retry (executor clip); the others never do
+            assert res.error is None, res
+    if sched is not None:
+        violations = sched.audit()
+        assert violations == [], violations
+    return results
+
+
+# Pinned fault plans (the seed x plan grid is the tier-1 chaos gate's
+# contract — do not rotate values without updating the gate's rationale).
+JAX_PLANS = {
+    "oom": [{"site": "kv_cache.allocate", "p": 0.35, "max_fires": 6}],
+    "step": [{"site": "scheduler.step", "at": [4], "max_fires": 1}],
+    "insert": [{"site": "prefix_cache.insert", "p": 0.6, "max_fires": 8}],
+    "combo": [{"site": "kv_cache.allocate", "p": 0.25, "max_fires": 4},
+              {"site": "scheduler.step", "at": [7], "max_fires": 1},
+              {"site": "prefix_cache.insert", "p": 0.5, "max_fires": 4},
+              {"site": "engine.batch", "at": [1], "max_fires": 1}],
+}
+
+MOCK_PLANS = {
+    "batch": [{"site": "engine.batch", "at": [1], "max_fires": 1}],
+    "batch_p": [{"site": "engine.batch", "p": 0.5, "max_fires": 2}],
+}
+
+
+# 8 jax + 12 mock = 20 pinned scenarios.  The jax arm carries the real
+# scheduler/pool machinery (each scenario ~1-3 s warm); the mock arm is
+# near-free, so it carries the wider seed sweep — tier-1 wall-clock stays
+# bounded without thinning coverage.
+@pytest.mark.parametrize("seed", [11, 23])
+@pytest.mark.parametrize("plan", sorted(JAX_PLANS))
+def test_chaos_jax(jax_engine, seed, plan):
+    soak(jax_engine, jax_engine._scheduler, seed, JAX_PLANS[plan],
+         deadlines=(plan == "combo"))
+
+
+@pytest.mark.parametrize("seed", [3, 5, 9, 17, 25, 33])
+@pytest.mark.parametrize("plan", sorted(MOCK_PLANS))
+def test_chaos_mock(seed, plan):
+    soak(MockEngine(seed=0), None, seed, MOCK_PLANS[plan], deadlines=True)
+
+
+def test_chaos_step_fault_recovery_is_deterministic(jax_engine):
+    """A scheduler-step fault on the FIRST iteration kills the whole run;
+    the executor retries; the engine must survive with a clean pool and
+    produce the same greedy text a fault-free run produces."""
+    sched = jax_engine._scheduler
+    plan = [{"site": "scheduler.step", "at": [1], "max_fires": 1}]
+    baseline = soak(jax_engine, sched, 99, [], greedy=True)
+    faulted = soak(jax_engine, sched, 99, plan, greedy=True)
+    assert [(r.request_id, r.finish_reason, r.text) for r in baseline] == \
+        [(r.request_id, r.finish_reason, r.text) for r in faulted]
+    assert sched.audit() == []
+
+
+def test_chaos_identical_seeds_identical_outcomes():
+    """Same workload seed + same plan seed => identical outcome tuples
+    (the replayability contract chaos triage depends on)."""
+    def once():
+        return [(r.request_id, r.finish_reason, r.text, r.completion_tokens)
+                for r in soak(MockEngine(seed=0), None, 29,
+                              MOCK_PLANS["batch_p"])]
+
+    assert once() == once()
+
+
+def test_chaos_jax_identical_seeds_identical_outcomes(jax_engine):
+    """Greedy replay on the live engine: insert faults perturb the cache,
+    never the tokens — two identical scenario runs match exactly."""
+    def once():
+        return [(r.request_id, r.finish_reason, r.text)
+                for r in soak(jax_engine, jax_engine._scheduler, 31,
+                              JAX_PLANS["insert"], greedy=True)]
+
+    assert once() == once()
+
+
+def test_fault_plan_object_reinstalls_replay_identically():
+    """All mutable evaluation state (occurrence counters, fire counts,
+    RNG streams) lives on the injector, so installing the SAME plan
+    object repeatedly replays exactly — the shape a triage harness takes
+    when it parses LMRS_FAULT_PLAN once and reruns per scenario."""
+    from lmrs_tpu.testing.faults import InjectedFault
+
+    plan = FaultPlan(seed=7, faults=[
+        {"site": "s", "at": [1], "max_fires": 1},
+        {"site": "q", "p": 0.5, "max_fires": 2}])
+    runs = []
+    for _ in range(3):
+        with faults.injected(plan) as inj:
+            outcomes = []
+            for site in ("s", "q"):
+                for _ in range(6):
+                    try:
+                        faults.fire(site)
+                        outcomes.append(0)
+                    except InjectedFault:
+                        outcomes.append(1)
+            runs.append((outcomes, list(inj.fires)))
+    assert runs[0] == runs[1] == runs[2]
+    assert runs[0][0][0] == 1  # the at=[1] spec fired on every install
+
+
+def test_spec_reinstall_is_idempotent_per_process():
+    """make_engine re-applies the env-derived fault_plan knob on every
+    engine construction: re-arming the SAME spec string must keep the
+    live injector (occurrence counters, max_fires state) — 'fire once'
+    means once per process, not once per engine built."""
+    from lmrs_tpu.testing.faults import InjectedFault
+
+    spec = '{"faults": [{"site": "z", "at": [1], "max_fires": 1}]}'
+    try:
+        inj1 = faults.install_spec(spec)
+        with pytest.raises(InjectedFault):
+            faults.fire("z")
+        assert faults.install_spec(spec) is inj1  # second make_engine
+        faults.fire("z")  # max_fires already spent: must NOT fire again
+        # a DIFFERENT spec replaces the injector with fresh state
+        assert faults.install_spec(spec + " ") is not inj1
+    finally:
+        faults.uninstall()
+
+
+def test_fault_plane_disabled_is_token_identical(jax_engine):
+    """The acceptance A/B: with LMRS_FAULT_PLAN unset (no plan installed)
+    and with a plan installed whose sites never fire, the greedy output is
+    token-identical — the injection sites cost nothing when disarmed."""
+    assert faults.active() is None  # tier-1 runs with the env unset
+
+    def run():
+        return jax_engine.generate_batch([GenerationRequest(
+            prompt="fault plane ab check", request_id=0,
+            temperature=0.0, max_new_tokens=12)])[0]
+
+    base = run()
+    with faults.injected(FaultPlan(seed=1, faults=[
+            {"site": "no.such.site", "at": [1]}])):
+        armed = run()
+    after = run()
+    assert base.text == armed.text == after.text
+    assert base.finish_reason == armed.finish_reason == after.finish_reason
+
+
+# ------------------------------------------------------ deadline contract
+
+
+def test_deadline_shed_before_prefill(jax_engine):
+    """An unadmittable request (expired budget) is shed with ZERO engine
+    work: no prefill tokens spent, finish_reason='shed', empty text."""
+    sched = jax_engine._scheduler
+    before = sched.metrics["prefill_tokens"]
+    shed_before = sched.metrics["shed"]
+    res = jax_engine.generate_batch([GenerationRequest(
+        prompt="far too late", request_id=0, temperature=0.0,
+        max_new_tokens=8, deadline_s=time.time() - 1.0)])[0]
+    assert res.finish_reason == "shed"
+    assert res.error is None
+    assert res.completion_tokens == 0 and res.text == ""
+    assert sched.metrics["prefill_tokens"] == before
+    assert sched.metrics["shed"] == shed_before + 1
+    assert sched.audit() == []
+
+
+def test_deadline_expires_in_flight_within_a_block(jax_engine):
+    """An in-flight request whose deadline passes finishes with
+    finish_reason='deadline' at the next block boundary, keeping the
+    tokens generated so far.  A fault-plane STALL at scheduler iteration 3
+    burns the budget while the request provably holds a slot (one decode
+    block is already recorded by then), so expiry lands mid-flight
+    deterministically, regardless of machine speed."""
+    sched = jax_engine._scheduler
+    # warm the compiled shapes AND the observed-TTFT floor so the 0.4 s
+    # budget is comfortably admittable (the estimate is the fastest
+    # observed TTFT; the second warmup runs on compiled shapes)
+    for rid in (900, 901):
+        jax_engine.generate_batch([GenerationRequest(
+            prompt="warmup", request_id=rid, temperature=0.0,
+            max_new_tokens=8)])
+    assert sched._ttft_min < 0.4, sched._ttft_min
+    dl_before = sched.metrics["deadline_exceeded"]
+    plan = FaultPlan(faults=[{"site": "scheduler.step", "at": [3],
+                              "action": "stall", "stall_s": 0.7}])
+    with faults.injected(plan):
+        res = jax_engine.generate_batch([GenerationRequest(
+            prompt="expire me in flight", request_id=0,
+            temperature=0.0, max_new_tokens=64,
+            deadline_s=time.time() + 0.4)])[0]
+    assert res.finish_reason == "deadline", res
+    assert res.error is None
+    # expiry was swept at the block boundary right after the stall: the
+    # blocks already recorded are kept, the remaining budget is abandoned
+    assert 1 <= res.completion_tokens < 64
+    assert sched.metrics["deadline_exceeded"] == dl_before + 1
+    assert sched.audit() == []
+
+
+def test_static_scheduler_sheds_expired_at_admission():
+    """The static scheduler also honors admission shedding (it cannot
+    expire in flight — no host sync inside its on-device while_loop; see
+    docs/ROBUSTNESS.md scheduler coverage)."""
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="static",
+                                 max_tokens=4, max_batch_slots=1, seed=0),
+                    chaos_model())
+    try:
+        res = eng.generate_batch([GenerationRequest(
+            prompt="late", request_id=0, max_new_tokens=4,
+            deadline_s=time.time() - 1.0)])[0]
+        assert res.finish_reason == "shed" and res.text == ""
+        ok = eng.generate_batch([GenerationRequest(
+            prompt="fine", request_id=1, temperature=0.0,
+            max_new_tokens=4)])[0]
+        assert ok.finish_reason in ("stop", "length")
+    finally:
+        eng.shutdown()
+
+
+def test_deadline_mock_sheds_expired():
+    res = MockEngine().generate_batch([GenerationRequest(
+        prompt="late", request_id=4, deadline_s=time.time() - 0.1)])[0]
+    assert res.finish_reason == "shed" and res.text == ""
+
+
+# ------------------------------------------------- auditor negative cases
+
+
+def _ensure_cached_prefix(engine) -> list[int]:
+    """Make sure the prefix cache retains at least one page — the tests
+    below corrupt cache state and must not depend on earlier soak tests
+    having run (any -k selection or reordering would otherwise break)."""
+    sched = engine._scheduler
+    if not sched._prefix_cache.retained_pages():
+        engine.generate_batch([GenerationRequest(
+            prompt="seed the prefix cache with a long enough prompt " * 2,
+            request_id=800, temperature=0.0, max_new_tokens=2)])
+    pages = sched._prefix_cache.retained_pages()
+    assert pages, "a full-page prompt must populate the cache"
+    return pages
+
+
+def test_audit_reports_leaked_page(jax_engine):
+    """The auditor must be PROVEN able to fail: a page allocated outside
+    any accounted owner is a leak it reports; releasing it restores a
+    clean report."""
+    sched = jax_engine._scheduler
+    assert sched.audit() == []
+    leaked = sched.cache.allocator.alloc(1)
+    violations = sched.audit()
+    assert any("leaked" in v for v in violations), violations
+    sched.cache.allocator.free(leaked)
+    assert sched.audit() == []
+
+
+def test_audit_reports_unbalanced_refcount(jax_engine):
+    """A stray incref on a cache-retained page shows as a refcount the
+    accounted holders cannot explain."""
+    sched = jax_engine._scheduler
+    retained = _ensure_cached_prefix(jax_engine)
+    assert sched.audit() == []
+    victim = retained[0]
+    sched.cache.allocator.incref([victim])
+    violations = sched.audit()
+    assert any("unbalanced" in v for v in violations), violations
+    sched.cache.allocator.free([victim])
+    assert sched.audit() == []
+
+
+def test_audit_reports_tree_corruption(jax_engine):
+    """A radix node whose page list disagrees with its token span is a
+    structural violation."""
+    sched = jax_engine._scheduler
+    _ensure_cached_prefix(jax_engine)
+    pc = sched._prefix_cache
+    node = next(iter(pc.root.children.values()))
+    saved = node.tokens
+    node.tokens = saved[:-1]  # no longer a page multiple
+    try:
+        violations = sched.audit()
+        assert any("tokens" in v or "pages" in v for v in violations), \
+            violations
+    finally:
+        node.tokens = saved
+    assert sched.audit() == []
+
+
+def test_audit_reports_double_finish(jax_engine):
+    """Termination-exactly-once: a second result record for one id is
+    counted and reported."""
+    from lmrs_tpu.engine.api import GenerationResult
+
+    sched = jax_engine._scheduler
+    assert sched.audit() == []
+    results = {}
+    sched._record_result(results, GenerationResult(request_id=7))
+    sched._record_result(results, GenerationResult(request_id=7))
+    try:
+        violations = sched.audit()
+        assert any("terminat" in v for v in violations), violations
+    finally:
+        sched._audit_double_finish = 0
+    assert sched.audit() == []

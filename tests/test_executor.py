@@ -134,6 +134,414 @@ def test_internally_scheduled_engine_gets_whole_queue():
     assert calls == [7]  # one call with the whole queue, not ceil(7/2) waves
 
 
+def test_retry_wait_clips_to_deadline_budget():
+    """The retry backoff must not sleep past a failed request's remaining
+    deadline budget (the reference slept RETRY_DELAY unconditionally,
+    stalling the whole wave loop): with retry_delay=30s and a 0.2 s
+    budget, the run resolves in well under a second of backoff."""
+    import time
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class AlwaysFail:
+        def generate_batch(self, requests, **kw):
+            return [GenerationResult(request_id=r.request_id,
+                                     finish_reason="error", error="down")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(AlwaysFail(),
+                     EngineConfig(retry_attempts=3, retry_delay=30.0))
+    req = GenerationRequest(prompt="p", request_id=0,
+                            deadline_s=time.time() + 0.2)
+    t0 = time.time()
+    res = ex.run_requests([req])[0]
+    assert time.time() - t0 < 5.0  # not 30s
+    assert res.finish_reason == "deadline"
+    assert res.error is not None  # the underlying failure stays visible
+
+
+def test_retry_wait_is_interruptible_by_cancel():
+    """cancel() must wake a sleeping retry backoff immediately and the
+    cancelled id must resolve as cancelled, never retried."""
+    import threading
+    import time
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class AlwaysFail:
+        def generate_batch(self, requests, **kw):
+            return [GenerationResult(request_id=r.request_id,
+                                     finish_reason="error", error="down")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(AlwaysFail(),
+                     EngineConfig(retry_attempts=5, retry_delay=30.0))
+    threading.Timer(0.2, lambda: ex.cancel(0)).start()
+    t0 = time.time()
+    res = ex.run_requests([GenerationRequest(prompt="p", request_id=0)])[0]
+    assert time.time() - t0 < 10.0  # woken, not slept out
+    assert res.finish_reason == "cancelled"
+
+
+def test_streaming_retry_does_not_resurrect_cancelled_request():
+    """The cancel-vs-retry race: request 0 fails, the executor submits a
+    retry clone, and the cancel lands while the clone is in flight — the
+    final result must be 'cancelled', the clone's output discarded, and
+    the clone chased through the engine's cancel hook."""
+    from collections import deque
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class RetryRaceEngine:
+        schedules_internally = True
+
+        def __init__(self):
+            self.cancelled: set[int] = set()
+            self.race_hook = lambda: None
+            self.first = True
+
+        def cancel(self, rid: int) -> None:
+            self.cancelled.add(rid)
+
+        def generate_batch(self, requests, on_result=None, on_tokens=None):
+            pending = deque(requests)
+            out = []
+
+            def submit(new):
+                pending.extend(new)
+
+            while pending:
+                r = pending.popleft()
+                if r.request_id >= 0 and self.first:
+                    self.first = False
+                    res = GenerationResult(request_id=r.request_id,
+                                           finish_reason="error", error="boom")
+                    out.append(res)
+                    if on_result:
+                        on_result(res, submit)  # clone gets submitted here
+                    self.race_hook()  # ...and the cancel lands right after
+                    continue
+                if r.request_id in self.cancelled:
+                    res = GenerationResult(request_id=r.request_id,
+                                           finish_reason="cancelled")
+                else:
+                    res = GenerationResult(request_id=r.request_id,
+                                           text="resurrected!",
+                                           finish_reason="stop")
+                out.append(res)
+                if on_result:
+                    on_result(res, submit)
+            return out
+
+    eng = RetryRaceEngine()
+    ex = MapExecutor(eng, EngineConfig(retry_attempts=3, retry_delay=0.0))
+    eng.race_hook = lambda: ex.cancel(0)
+    finals = {}
+    ex.run_requests_streaming(
+        [GenerationRequest(prompt="x", request_id=0)],
+        lambda res, submit: finals.__setitem__(res.request_id, res))
+    assert finals[0].finish_reason == "cancelled"
+    assert finals[0].text != "resurrected!"
+    # the live clone (negative id) was chased through the engine hook
+    assert any(rid < 0 for rid in eng.cancelled), eng.cancelled
+
+
+def test_streaming_no_retry_once_cancelled_before_failure_delivery():
+    """When the cancel is already recorded by the time the failed result
+    is delivered, no retry clone is submitted at all.  (A cancel with NO
+    run in flight is a no-op — ids are reused across runs — so the cancel
+    here lands from inside the running wave, before the failure.)"""
+    from collections import deque
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    seen: list[int] = []
+
+    class FailOnceEngine:
+        schedules_internally = True
+
+        def __init__(self):
+            self.wave_start_hook = lambda: None
+
+        def cancel(self, rid):
+            pass
+
+        def generate_batch(self, requests, on_result=None, on_tokens=None):
+            self.wave_start_hook()
+            pending = deque(requests)
+            out = []
+
+            def submit(new):
+                pending.extend(new)
+
+            while pending:
+                r = pending.popleft()
+                seen.append(r.request_id)
+                res = GenerationResult(request_id=r.request_id,
+                                       finish_reason="error", error="boom")
+                out.append(res)
+                if on_result:
+                    on_result(res, submit)
+            return out
+
+    eng = FailOnceEngine()
+    ex = MapExecutor(eng, EngineConfig(retry_attempts=5, retry_delay=0.0))
+    ex.cancel(0)  # no run in flight: must no-op, not poison the run below
+    eng.wave_start_hook = lambda: ex.cancel(0)
+    finals = {}
+    ex.run_requests_streaming(
+        [GenerationRequest(prompt="x", request_id=0)],
+        lambda res, submit: finals.__setitem__(res.request_id, res))
+    assert finals[0].finish_reason == "cancelled"
+    assert seen == [0]  # the original only — no clone ever dispatched
+
+
+def test_streaming_cancel_terminal_even_if_attempt_succeeds():
+    """An engine WITHOUT a cancel hook cannot abort in flight: when the
+    cancel races a completion (here: the retry clone of a failed request
+    finishes successfully), the executor must still deliver the id as
+    cancelled — never resurrect an abandoned request as a success.  The
+    clone's text is kept (real output, keep-partial-output convention)."""
+    from collections import deque
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class NoCancelHookEngine:
+        schedules_internally = True
+
+        def __init__(self):
+            self.race_hook = lambda: None
+            self.first = True
+
+        def generate_batch(self, requests, on_result=None, on_tokens=None):
+            pending = deque(requests)
+            out = []
+
+            def submit(new):
+                pending.extend(new)
+
+            while pending:
+                r = pending.popleft()
+                if self.first:
+                    self.first = False
+                    res = GenerationResult(request_id=r.request_id,
+                                           finish_reason="error", error="boom")
+                    out.append(res)
+                    if on_result:
+                        on_result(res, submit)
+                    self.race_hook()  # cancel lands; nothing can stop the clone
+                    continue
+                res = GenerationResult(request_id=r.request_id,
+                                       text="clone output",
+                                       finish_reason="stop")
+                out.append(res)
+                if on_result:
+                    on_result(res, submit)
+            return out
+
+    eng = NoCancelHookEngine()
+    ex = MapExecutor(eng, EngineConfig(retry_attempts=3, retry_delay=0.0))
+    eng.race_hook = lambda: ex.cancel(0)
+    finals = {}
+    ex.run_requests_streaming(
+        [GenerationRequest(prompt="x", request_id=0)],
+        lambda res, submit: finals.__setitem__(res.request_id, res))
+    assert finals[0].finish_reason == "cancelled"
+    assert finals[0].error is None
+    assert finals[0].text == "clone output"  # output kept, status honest
+
+
+def test_cancel_state_is_run_scoped():
+    """Request ids are reused across runs on one executor (map chunks and
+    reduce nodes both count from 0): a cancel in one run must not poison a
+    later run's same-numbered request — its transient failure must still
+    be retried to success."""
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class FlakyOnce:
+        def __init__(self):
+            self.calls = 0
+
+        def cancel(self, rid):
+            pass
+
+        def generate_batch(self, requests, **kw):
+            self.calls += 1
+            if self.calls == 2:  # run 2, attempt 1: transient failure
+                return [GenerationResult(request_id=r.request_id,
+                                         finish_reason="error",
+                                         error="transient")
+                        for r in requests]
+            return [GenerationResult(request_id=r.request_id, text="ok")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(FlakyOnce(),
+                     EngineConfig(retry_attempts=3, retry_delay=0.0))
+    assert ex.run_requests(
+        [GenerationRequest(prompt="a", request_id=0)])[0].error is None
+    ex.cancel(0)  # stale: its run is already over
+    res = ex.run_requests([GenerationRequest(prompt="b", request_id=0)])[0]
+    assert res.error is None and res.finish_reason != "cancelled"
+    assert res.text == "ok"
+
+
+def test_shed_chunk_is_marked_failed_not_empty_success():
+    """A content-less shed/deadline result must surface as a chunk ERROR:
+    branching on res.error alone would aggregate an empty summary as a
+    success and silently drop the section from the final output."""
+    from lmrs_tpu.data.chunker import Chunk
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class SheddingEngine:
+        def generate_batch(self, requests, **kw):
+            out = []
+            for r in requests:
+                if "drop me" in r.prompt:
+                    out.append(GenerationResult(request_id=r.request_id,
+                                                finish_reason="shed"))
+                else:
+                    out.append(GenerationResult(request_id=r.request_id,
+                                                text="fine",
+                                                finish_reason="stop"))
+            return out
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(SheddingEngine(), EngineConfig(retry_delay=0.0))
+    chunks = [Chunk(text="a", text_with_context="keep me"),
+              Chunk(text="b", text_with_context="drop me", chunk_index=1)]
+    ex.process_chunks(chunks, "{transcript}")
+    assert chunks[0].error is None and chunks[0].summary == "fine"
+    assert chunks[1].error is not None
+    assert chunks[1].summary.startswith("[Error processing chunk:")
+    assert "shed" in chunks[1].summary
+
+
+def test_interrupt_is_sticky_across_remaining_backoffs():
+    """interrupt() must skip EVERY remaining backoff of the run, not just
+    the one in flight — a shutdown path must not sleep out the rest of a
+    30s-per-retry ladder."""
+    import threading
+    import time
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    class AlwaysFail:
+        def generate_batch(self, requests, **kw):
+            return [GenerationResult(request_id=r.request_id,
+                                     finish_reason="error", error="down")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(AlwaysFail(),
+                     EngineConfig(retry_attempts=4, retry_delay=30.0))
+    threading.Timer(0.2, ex.interrupt).start()
+    t0 = time.time()
+    res = ex.run_requests([GenerationRequest(prompt="p", request_id=0)])[0]
+    # 3 backoffs of 30s would be 90s; sticky interrupt skips them all
+    assert time.time() - t0 < 10.0
+    assert res.finish_reason == "error"
+
+
+def test_batch_path_rejects_out_of_band_request_ids():
+    """The epoch guard run_requests applies (mirroring the streaming
+    register): an id past the stride would land in a later run's reserved
+    engine-id band."""
+    ex = _executor()
+    with pytest.raises(ValueError):
+        ex.run_requests([GenerationRequest(prompt="p", request_id=1 << 20)])
+
+
+def test_engine_never_sees_reused_request_ids_across_runs():
+    """Engines keep cancel state across run boundaries (the scheduler's
+    set clears at END of run, relying on globally-unique rids), so the
+    executor presents epoch-offset ids: two runs with identical caller
+    ids must show the engine disjoint id sets — a cancel forwarded as one
+    run ends can then never alias the next run's work — while the caller
+    keeps its own numbering on the results."""
+    from lmrs_tpu.engine.api import GenerationResult
+
+    seen_ids: list[set] = []
+
+    class Recorder:
+        def generate_batch(self, requests, **kw):
+            seen_ids.append({r.request_id for r in requests})
+            return [GenerationResult(request_id=r.request_id, text="ok")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(Recorder(), EngineConfig(retry_delay=0.0))
+    for _ in range(2):
+        out = ex.run_requests(
+            [GenerationRequest(prompt="p", request_id=i) for i in range(3)])
+        assert [r.request_id for r in out] == [0, 1, 2]  # caller space kept
+    assert seen_ids[0].isdisjoint(seen_ids[1]), seen_ids
+
+
+def test_executor_stamps_config_deadline():
+    """EngineConfig.request_deadline_s lands on every request that doesn't
+    already carry a deadline (and never overwrites an explicit one)."""
+    import time
+
+    from lmrs_tpu.engine.api import GenerationResult
+
+    captured = []
+
+    class Capture:
+        def generate_batch(self, requests, **kw):
+            captured.extend(requests)
+            return [GenerationResult(request_id=r.request_id, text="ok")
+                    for r in requests]
+
+        def shutdown(self):
+            pass
+
+        def engine_metrics(self):
+            return {}
+
+    ex = MapExecutor(Capture(), EngineConfig(request_deadline_s=60.0))
+    explicit = time.time() + 5.0
+    ex.run_requests([
+        GenerationRequest(prompt="a", request_id=0),
+        GenerationRequest(prompt="b", request_id=1, deadline_s=explicit),
+    ])
+    assert captured[0].deadline_s is not None
+    assert 50.0 < captured[0].deadline_s - time.time() <= 60.0
+    assert captured[1].deadline_s == explicit
+
+
 def test_chunk_groups_interleave_round_robin():
     """Multi-transcript pooling must admit round-robin across groups
     (VERDICT r2 item 9): FIFO admission of whole groups would starve later

@@ -363,3 +363,100 @@ def test_fuzzed_router_waves_with_cancels_and_kills(seed):
     finally:
         router.shutdown()
         _teardown(procs)
+
+
+# --------------------------------------------------- probe pacing (no fleet)
+
+
+def test_probe_pacing_with_fake_clock():
+    """A dead host under heavy traffic must not draw one /healthz probe per
+    wave (a probe storm scaling with offered load): probes space at least
+    probe_floor_s apart per host, plus jitter, enforced on an injectable
+    clock so this test never sleeps."""
+    clock = [100.0]
+    router = RouterEngine(["127.0.0.1:1", "127.0.0.1:2"],
+                          probe_floor_s=5.0, probe_jitter_s=2.0,
+                          clock=lambda: clock[0])
+    try:
+        for h in router.hosts:
+            h.healthy = False
+            h.probe = lambda: False  # stays dead; no network touched
+        assert len(router._launch_probes()) == 2  # both eligible at t=100
+        # a storm of waves at the same instant: zero further probes
+        for _ in range(50):
+            assert router._launch_probes() == []
+        clock[0] += 4.99  # just under the floor
+        assert router._launch_probes() == []
+        clock[0] += 5.0 + 2.0  # beyond floor + max jitter
+        assert len(router._launch_probes()) == 2  # exactly one more each
+        assert router._launch_probes() == []
+        # a healthy host is never probed
+        router.hosts[0].healthy = True
+        clock[0] += 100.0
+        assert router._launch_probes() == [router.hosts[1]]
+    finally:
+        router.shutdown()
+
+
+# ------------------------------------------- fault-injection sites (no fleet)
+
+
+def _mock_server():
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(MockEngine(), port=0, batch_window_s=0.01)
+    srv.start_background()
+    return srv
+
+
+def test_router_connect_fault_fails_over_and_marks_host():
+    """An injected connection-phase fault must mark the first target
+    unhealthy and fail the request over to the next host — the same path a
+    dead backend takes, driven without killing a process."""
+    from lmrs_tpu.testing import faults
+    from lmrs_tpu.testing.faults import FaultPlan
+
+    srv = _mock_server()
+    url = f"127.0.0.1:{srv.port}"
+    router = RouterEngine([url, url], timeout_s=30.0)  # same backend twice
+    try:
+        with faults.injected(FaultPlan(faults=[
+                {"site": "router.connect", "at": [1], "max_fires": 1}])):
+            res = router.generate_batch([GenerationRequest(
+                prompt="failover probe", request_id=0)])[0]
+        assert res.error is None  # the second target served it
+        assert res.text
+        fails = [h.failed for h in router.hosts]
+        assert sorted(fails) == [0, 1], fails
+        assert any(not h.healthy for h in router.hosts)  # condemned target
+    finally:
+        router.shutdown()
+        srv.shutdown()
+
+
+def test_router_recv_fault_surfaces_midstream_error():
+    """An injected mid-stream fault AFTER deltas were forwarded must
+    surface as an error result without a retry — a replay would duplicate
+    the deltas already delivered (Engine streaming contract)."""
+    from lmrs_tpu.testing import faults
+    from lmrs_tpu.testing.faults import FaultPlan
+
+    srv = _mock_server()
+    router = RouterEngine([f"127.0.0.1:{srv.port}"], timeout_s=30.0)
+    deltas: list[str] = []
+    try:
+        # SSE lines for the mock: role frame, blank, content frame, blank,
+        # finish frame... — occurrence 5 lands after the content delta
+        with faults.injected(FaultPlan(faults=[
+                {"site": "router.recv", "at": [5], "max_fires": 1}])):
+            res = router.generate_batch(
+                [GenerationRequest(prompt="One fact. Two facts.",
+                                   request_id=1)],
+                on_tokens=lambda rid, d: deltas.append(d))[0]
+        assert res.finish_reason == "error"
+        assert deltas, "fault should land after the first content delta"
+        assert router.hosts[0].healthy  # per-request fault, not a dead host
+    finally:
+        router.shutdown()
+        srv.shutdown()

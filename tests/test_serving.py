@@ -406,3 +406,116 @@ def test_serve_cli_tokenizer_flag_reaches_engine_config():
     cfg = EngineConfig(backend=args.backend, model=args.model,
                        tokenizer=args.tokenizer or "")
     assert cfg.tokenizer == "byte"
+
+
+def test_rejection_results_echo_real_request_ids():
+    """submit()/submit_stream() after shutdown and the sentinel drain must
+    echo the job's real rid, never a placeholder 0 — clients correlate
+    failures by id (rids are assigned at enqueue now)."""
+    from lmrs_tpu.serving.server import _Batcher
+
+    b = _Batcher(MockEngine(), window_s=0.01)
+    # burn a rid with a normal request so the rejection rids are provably
+    # non-zero (a 0 here could be a legitimate first id OR the old bug)
+    ok = b.submit(GenerationRequest(prompt="warm"))
+    assert ok.request_id == 0 and ok.error is None
+    b.shutdown()
+    r1 = b.submit(GenerationRequest(prompt="late"))
+    job = b.submit_stream(GenerationRequest(prompt="later"))
+    assert r1.error and job.result.error
+    assert r1.request_id == 1
+    assert job.result.request_id == 2
+
+
+def test_deadline_header_reaches_engine_and_sheds():
+    """A relative X-LMRS-Deadline budget is anchored server-side and rides
+    the GenerationRequest into the engine; an already-expired budget comes
+    back finish_reason='shed' on the wire."""
+    captured: list[GenerationRequest] = []
+
+    class Capture(MockEngine):
+        def generate_batch(self, requests, on_result=None, on_tokens=None):
+            captured.extend(requests)
+            return super().generate_batch(requests, on_result=on_result,
+                                          on_tokens=on_tokens)
+
+    srv = EngineHTTPServer(Capture(), port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        import time as _t
+        body = json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                           "max_tokens": 16}).encode()
+        req = urllib.request.Request(
+            f"http://{srv.host}:{srv.port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-LMRS-Deadline": "30"}, method="POST")
+        t0 = _t.time()
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert out["choices"][0]["finish_reason"] == "stop"
+        assert captured and captured[-1].deadline_s is not None
+        assert 20.0 < captured[-1].deadline_s - t0 <= 31.0
+
+        # expired budget (body field form): shed, explicit and fast
+        req2 = urllib.request.Request(
+            f"http://{srv.host}:{srv.port}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "deadline_s": -1.0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req2, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert out["choices"][0]["finish_reason"] == "shed"
+        assert out["choices"][0]["message"]["content"] == ""
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.parametrize("bad", ["soonish", "nan", "inf", "-inf"])
+def test_invalid_deadline_is_400(server, bad):
+    """A garbage or non-finite deadline must be rejected, not silently
+    (mis)applied — a NaN budget sheds on one engine and runs unbounded on
+    another, the opposite of an explicit contract either way."""
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}/v1/chat/completions",
+        data=json.dumps({"messages": [{"role": "user", "content": "x"}],
+                         "deadline_s": bad}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+
+
+def test_anthropic_wire_reports_shed(server):
+    """/v1/messages must surface the deadline outcomes as stop_reason
+    extension values — collapsing a zero-work shed into 'max_tokens'
+    would be indistinguishable from a normal truncated completion."""
+    status, out = _post(server, "/v1/messages", {
+        "messages": [{"role": "user", "content": "late"}],
+        "deadline_s": -1.0, "max_tokens": 16})
+    assert status == 200
+    assert out["stop_reason"] == "shed"
+    assert out["content"][0]["text"] == ""
+
+
+def test_injected_client_disconnect_cancels_nonstream_request():
+    """The server.client_disconnect injection site drives the
+    disconnect->cancel propagation path without a socket teardown: the
+    poll reports the client gone, the batcher cancels through the engine
+    hook, and the request resolves as cancelled."""
+    from lmrs_tpu.testing import faults
+    from lmrs_tpu.testing.faults import FaultPlan
+
+    engine = MockEngine(latency_s=1.2)  # long enough for one 0.5s poll
+    srv = EngineHTTPServer(engine, port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        with faults.injected(FaultPlan(faults=[
+                {"site": "server.client_disconnect", "at": [1]}])):
+            status, out = _post(srv, "/v1/chat/completions", {
+                "messages": [{"role": "user", "content": "vanishing"}],
+                "max_tokens": 16})
+        assert status == 200  # the "gone" client still gets the response
+        assert out["choices"][0]["finish_reason"] == "cancelled"
+    finally:
+        srv.shutdown()
