@@ -369,6 +369,20 @@ def _scheduler_window(sched, before: dict) -> dict:
         },
         "ttft_ms": report["ttft_ms"],
         "decode_block_gap_ms": report["decode_block_gap_ms"],
+        # disaggregated handoff over the timed window: export/import
+        # counts and orphaned pages are zero on a colocated bench by
+        # construction — the block exists so MULTICHIP_* rounds that run
+        # the two-tier topology can track transfer overhead against this
+        # colocated baseline (capture/import latency percentiles included)
+        "handoff": {
+            "exports": m["handoff_exports"] - before["handoff_exports"],
+            "imports": m["handoff_imports"] - before["handoff_imports"],
+            "orphaned_pages": (m["handoff_orphaned_pages"]
+                               - before["handoff_orphaned_pages"]),
+            "pinned_pages": m["handoff_pinned_pages"],
+            "capture_ms": sched._h_handoff_capture.percentile_report(),
+            "import_ms": sched._h_handoff_import.percentile_report(),
+        },
         # shared-prefix KV cache over the timed reps: hit rate across
         # admissions and the prompt tokens whose prefill was skipped
         # entirely (the map preamble re-use win; engine/prefix_cache.py)

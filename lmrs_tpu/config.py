@@ -134,6 +134,20 @@ class EngineConfig:
     hosts: tuple[str, ...] = field(
         default_factory=lambda: tuple(
             h.strip() for h in _env("LMRS_HOSTS", "").split(",") if h.strip()))
+    # Disaggregated serving pools (serving/router.py + docs/SERVING.md):
+    # prefill-role and decode-role lmrs-serve hosts.  When BOTH are
+    # non-empty the router runs the two-tier handoff (admission to the
+    # prefill pool, KV-page ticket to the decode pool); either pool empty
+    # or fully degraded falls back to colocated operation over
+    # ``hosts``/the surviving pool.  Comma-separated in env.
+    prefill_hosts: tuple[str, ...] = field(
+        default_factory=lambda: tuple(
+            h.strip() for h in _env("LMRS_PREFILL_HOSTS", "").split(",")
+            if h.strip()))
+    decode_hosts: tuple[str, ...] = field(
+        default_factory=lambda: tuple(
+            h.strip() for h in _env("LMRS_DECODE_HOSTS", "").split(",")
+            if h.strip()))
     temperature: float = field(default_factory=lambda: _env("TEMPERATURE", 0.3, float))
     max_tokens: int = field(default_factory=lambda: _env("MAX_TOKENS", 1000, int))
     max_concurrent_requests: int = field(
@@ -216,6 +230,14 @@ class EngineConfig:
     # and router retries clip to the remaining budget.
     request_deadline_s: float = field(
         default_factory=lambda: _env("LMRS_REQUEST_DEADLINE", 0.0, float))
+    # Disaggregated handoff pin TTL (seconds): pages exported for a
+    # prefill→decode handoff stay pinned (ref-counted) until the decode
+    # side acks the import; a ticket never acked is orphan-swept after
+    # this long and its pages reclaimed (the crash-safety backstop for a
+    # dead decode pod or a lost ack — docs/SERVING.md ticket lifecycle).
+    # A request deadline tighter than the TTL clips it.
+    handoff_ttl_s: float = field(
+        default_factory=lambda: _env("LMRS_HANDOFF_TTL", 60.0, float))
 
     def __post_init__(self) -> None:
         # Reference DEFAULT_PROVIDER values name HTTP vendors; both map to
@@ -236,6 +258,11 @@ class EngineConfig:
             raise ValueError(f"request_deadline_s must be >= 0 "
                              f"(got {self.request_deadline_s}); 0 disables "
                              "deadlines")
+        if self.handoff_ttl_s <= 0:
+            raise ValueError(f"handoff_ttl_s must be > 0 "
+                             f"(got {self.handoff_ttl_s}): un-acked "
+                             "handoff pins need a finite orphan-sweep "
+                             "deadline or a dead decode pod leaks pages")
 
 
 @dataclass

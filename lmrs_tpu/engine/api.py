@@ -53,6 +53,18 @@ class GenerationRequest:
     # own clock at ingress, and the router re-derives the remaining budget
     # when forwarding — absolute wall-clock never crosses a host boundary.
     deadline_s: float | None = None
+    # Disaggregated prefill/decode handoff (docs/SERVING.md).
+    # ``handoff_export=True``: run prefill + first token only, then PIN
+    # the sequence's KV pages for export instead of freeing them — the
+    # result comes back ``finish_reason="handoff"`` and the pages stay
+    # ref-counted until ``release_handoff`` (decode-side ack) or the
+    # orphan sweep.  Engines without handoff support ignore the flag and
+    # run the request to completion (graceful colocated fallback).
+    # ``handoff_state``: an imported payload dict (in-process only, never
+    # serialized with the request) — the engine resumes decoding from the
+    # transferred KV pages + first token instead of prefilling.
+    handoff_export: bool = False
+    handoff_state: dict | None = None
 
 
 def remaining_budget(req: GenerationRequest,
@@ -76,10 +88,15 @@ class GenerationResult:
     text: str = ""
     prompt_tokens: int = 0
     completion_tokens: int = 0
-    # stop | length | error | cancelled | deadline | shed — the last two
-    # are deadline-lifecycle terminals (api.GenerationRequest.deadline_s):
-    # "deadline" expired in flight (partial text kept), "shed" rejected at
-    # admission before any engine work.  Engine-side neither sets
+    # stop | length | error | cancelled | deadline | shed | handoff —
+    # deadline/shed are deadline-lifecycle terminals
+    # (api.GenerationRequest.deadline_s): "deadline" expired in flight
+    # (partial text kept), "shed" rejected at admission before any engine
+    # work.  "handoff" is NOT client-terminal: the request stopped after
+    # its first token with KV pages pinned for export (handoff_export);
+    # only the serving layer ever sees it — it turns the result into a
+    # handoff ticket, and the decode pod's continuation is the real
+    # completion.  Engine-side neither sets
     # ``error`` (they are outcomes the caller asked for, not faults to
     # retry); the one exception is the executor's retry clip, which marks
     # a request that FAILED and then ran out of budget to retry with both
@@ -226,7 +243,8 @@ def make_engine(
     if engine_cfg.backend == "mock":
         from lmrs_tpu.engine.mock import MockEngine
 
-        return MockEngine(seed=engine_cfg.seed)
+        return MockEngine(seed=engine_cfg.seed,
+                          handoff_ttl_s=engine_cfg.handoff_ttl_s)
     if engine_cfg.backend == "jax":
         from lmrs_tpu.config import ModelConfig, model_preset
 
@@ -251,15 +269,20 @@ def make_engine(
     if engine_cfg.backend == "http":
         from lmrs_tpu.serving.router import RouterEngine
 
-        if not engine_cfg.hosts:
+        if not (engine_cfg.hosts or engine_cfg.prefill_hosts
+                or engine_cfg.decode_hosts):
             raise ValueError(
                 "backend='http' needs hosts (--hosts host:port,... or "
-                "LMRS_HOSTS): the addresses of running lmrs-serve processes")
+                "LMRS_HOSTS; role pools via LMRS_PREFILL_HOSTS/"
+                "LMRS_DECODE_HOSTS): the addresses of running lmrs-serve "
+                "processes")
         # The router's timeout is a per-recv SOCKET timeout, and a
         # non-streamed generation sends nothing until it completes — the
         # reference-derived REQUEST_TIMEOUT default (60 s) would time out
         # any long completion, error it, and mark healthy hosts dead.
         # Floor it at the router's own worst-case-generation default.
         return RouterEngine(list(engine_cfg.hosts),
-                            timeout_s=max(engine_cfg.request_timeout, 600.0))
+                            timeout_s=max(engine_cfg.request_timeout, 600.0),
+                            prefill_hosts=list(engine_cfg.prefill_hosts),
+                            decode_hosts=list(engine_cfg.decode_hosts))
     raise ValueError(f"unknown engine backend {engine_cfg.backend!r}")

@@ -225,6 +225,32 @@ class JaxEngine:
     def engine_metrics(self) -> dict:
         return self._scheduler.metrics_report() if self._scheduler else {}
 
+    # ---------------------------------------- disaggregated handoff hooks
+    # (optional Engine surface, same getattr convention as ``cancel``):
+    # the continuous scheduler implements the real page pin/export/import
+    # lifecycle; the static scheduler has no paged pool to export, so
+    # supports_handoff is False there and the serving layer ignores
+    # handoff flags (graceful colocated fallback).
+
+    @property
+    def supports_handoff(self) -> bool:
+        return self._scheduler is not None
+
+    def export_handoff(self, request_id: int) -> dict:
+        if self._scheduler is None:
+            raise KeyError(request_id)
+        return self._scheduler.export_handoff(request_id)
+
+    def release_handoff(self, request_id: int, orphaned: bool = False) -> int:
+        if self._scheduler is None:
+            return 0
+        return self._scheduler.release_handoff(request_id, orphaned=orphaned)
+
+    def sweep_handoffs(self, now: float | None = None) -> int:
+        if self._scheduler is None:
+            return 0
+        return self._scheduler.sweep_handoffs(now)
+
     def metrics_registry(self):
         """Optional Engine hook (same getattr convention as ``cancel``):
         the typed registry behind engine_metrics(), or None for the static

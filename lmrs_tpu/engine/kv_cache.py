@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -248,6 +249,112 @@ class PagedKVCache:
             if s is not None:
                 out[i, : len(s.pages)] = s.pages
         return out
+
+    # -------------------------------------------- sequence export / import
+
+    @property
+    def n_layers(self) -> int:
+        return self.k.shape[0] // self.num_pages
+
+    def _phys_ids(self, pages: list[int]) -> np.ndarray:
+        """Physical page ids of a logical page set, all layers: layer li's
+        copy of logical page p is physical page ``li * P + p`` (the
+        layer-flattened pool layout, class doc)."""
+        pg = np.asarray(pages, np.int64)
+        return (np.arange(self.n_layers)[:, None] * self.num_pages
+                + pg[None, :]).reshape(-1)
+
+    def export_sequence(self, seq: SequencePages, length: int) -> dict:
+        """Gather a sequence's page set into a host-side payload — the
+        transferable unit of the disaggregated prefill→decode handoff
+        (serving/handoff.py carries it over the wire).
+
+        The page-major ``[L*P, K, ps, hd]`` layout makes the page set a
+        contiguous unit: ONE gather over the flattened layer×page axis
+        pulls every layer's copy.  Only the pages covering ``length``
+        tokens are exported (a slot grown past the handoff point for
+        decode-block capacity exports its prompt prefix only); the final
+        page may be partial — ``kv_len`` in the payload masks the tail,
+        exactly as per-row lengths do in the decode kernels.  Works for
+        bf16 and int8-quantized pools alike (raw dtype bytes travel;
+        int8's per-slot scales are scheduler-owned and ride the payload
+        separately).  The sequence itself is untouched: the caller keeps
+        the pages pinned until the importer acks (scheduler pin class).
+        """
+        faults.fire("handoff.export")
+        n = self.pages_needed(max(1, length))
+        if n > len(seq.pages):
+            raise ValueError(
+                f"export of {length} tokens needs {n} pages; sequence "
+                f"holds {len(seq.pages)}")
+        phys = jnp.asarray(self._phys_ids(seq.pages[:n]))
+        L = self.n_layers
+        # one batched fetch: on a tunneled chip each device_get is a full
+        # host RTT, and this runs on the scheduler thread
+        k, v = (np.asarray(a)
+                for a in jax.device_get((self.k[phys], self.v[phys])))
+        kh, ps, hd = self.k.shape[1:]
+        return {
+            "version": 1,
+            "kv_len": int(length),
+            "n_pages": n,
+            "page_size": self.page_size,
+            "n_layers": L,
+            "n_kv_heads": int(kh),
+            "head_dim": int(hd),
+            "dtype": str(self.k.dtype),
+            "k": k.reshape(L, n, kh, ps, hd),
+            "v": v.reshape(L, n, kh, ps, hd),
+        }
+
+    def import_sequence(self, payload: dict) -> SequencePages:
+        """Scatter an exported page set into freshly allocated local pages
+        and return the live sequence (``length`` = the payload's kv_len).
+
+        The destination's free-list state is arbitrary — imported pages
+        land wherever the local allocator hands them out; the page table
+        indirection makes the physical ids irrelevant to attention.
+        Raises ``ValueError`` on an incompatible payload (pool geometry or
+        dtype mismatch — a stale ticket from a differently-configured pod
+        must be rejected, not silently mis-scattered) and ``OutOfPages``
+        under pool pressure (back-pressure: the importer retries, never
+        corrupts).  On any failure after allocation the pages are freed —
+        a failed import must not leak."""
+        faults.fire("handoff.import")
+        kh, ps, hd = (int(x) for x in self.k.shape[1:])
+        want = {"page_size": self.page_size, "n_layers": self.n_layers,
+                "n_kv_heads": kh, "head_dim": hd, "dtype": str(self.k.dtype)}
+        for key, val in want.items():
+            got = payload.get(key)
+            if got != val:
+                raise ValueError(
+                    f"incompatible handoff payload: {key}={got!r}, this "
+                    f"pool has {val!r}")
+        n = int(payload["n_pages"])
+        length = int(payload["kv_len"])
+        if not 0 < n <= self.max_pages_per_slot:
+            raise ValueError(f"bad handoff page count {n}")
+        if self.pages_needed(max(1, length)) != n:
+            raise ValueError(
+                f"handoff kv_len {length} does not cover {n} pages")
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        shape = (self.n_layers, n, kh, ps, hd)
+        if k.shape != shape or v.shape != shape:
+            raise ValueError(
+                f"handoff page data shape {k.shape} != expected {shape}")
+        pages = self.alloc_pages(n)
+        try:
+            phys = jnp.asarray(self._phys_ids(pages))
+            flat = (self.n_layers * n, kh, ps, hd)
+            self.k = self.k.at[phys].set(
+                jnp.asarray(k.reshape(flat), self.k.dtype))
+            self.v = self.v.at[phys].set(
+                jnp.asarray(v.reshape(flat), self.v.dtype))
+        except Exception:
+            self.allocator.free(pages)
+            raise
+        return SequencePages(pages=pages, length=length)
 
 
 def audit_allocator(allocator, num_pages: int,

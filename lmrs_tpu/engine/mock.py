@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import hashlib
 import re
+import threading
 import time
 
 from lmrs_tpu.data.tokenizer import ApproxTokenizer
@@ -31,15 +32,29 @@ class MockEngine:
     injection hook the reference lacks (SURVEY.md §5.3 "no fault injection").
     """
 
-    def __init__(self, seed: int = 0, latency_s: float = 0.0, fail_pattern: str | None = None):
+    # disaggregated handoff is supported: the mock's "KV state" is its
+    # deterministic completion text, pinned/transferred/resumed through
+    # the same ticket lifecycle the paged engines use (the no-device arm
+    # of the two-process topology gate)
+    supports_handoff = True
+
+    def __init__(self, seed: int = 0, latency_s: float = 0.0,
+                 fail_pattern: str | None = None,
+                 handoff_ttl_s: float = 60.0):
         self.seed = seed
         self.latency_s = latency_s
         self.fail_pattern = fail_pattern
+        self.handoff_ttl_s = handoff_ttl_s
         self._tok = ApproxTokenizer()
         # ids cancel() was called for — generation is instantaneous here, so
         # the hook only records (tests assert the server propagated a
         # disconnect) and flags ids not yet generated in this batch
         self.cancelled: set[int] = set()
+        # rid -> pinned handoff state (see _one); the lock mirrors the
+        # scheduler's pinned-export contract — handler threads release
+        # while generate_batch pins
+        self._pinned: dict[int, dict] = {}
+        self._pinned_lock = threading.Lock()
 
     def generate_batch(self, requests: list[GenerationRequest],
                        on_result=None, on_tokens=None) -> list[GenerationResult]:
@@ -91,6 +106,31 @@ class MockEngine:
     def engine_metrics(self) -> dict:
         return {}
 
+    # ---------------------------------------- disaggregated handoff hooks
+
+    def export_handoff(self, request_id: int) -> dict:
+        """Wire payload of a pinned mock handoff (KeyError when unknown /
+        already released — the ticket 410 path)."""
+        with self._pinned_lock:
+            return self._pinned[request_id]["payload"]
+
+    def release_handoff(self, request_id: int, orphaned: bool = False) -> int:
+        with self._pinned_lock:
+            return 1 if self._pinned.pop(request_id, None) else 0
+
+    def sweep_handoffs(self, now: float | None = None) -> int:
+        now = time.time() if now is None else now
+        with self._pinned_lock:
+            expired = [r for r, rec in self._pinned.items()
+                       if rec["deadline_t"] <= now]
+            for r in expired:
+                self._pinned.pop(r)
+        return len(expired)
+
+    def pinned_handoffs(self) -> dict[int, int]:
+        with self._pinned_lock:
+            return {r: 1 for r in self._pinned}
+
     def _one(self, req: GenerationRequest) -> GenerationResult:
         def expired() -> bool:
             return (req.deadline_s is not None
@@ -117,12 +157,66 @@ class MockEngine:
                 finish_reason="error",
                 error="mock: injected failure",
             )
+        if req.handoff_state is not None:
+            # disaggregated decode role: resume from the TRANSFERRED state
+            # — the payload's text is returned, never recomputed, so the
+            # result proves the handoff actually carried the prefill pod's
+            # state across (a recompute would mask a broken transfer)
+            # fault degrades per request (same contract as the jax arm:
+            # a marked import failure the router retries/falls back on,
+            # never a whole-wave error)
+            try:
+                faults.fire("handoff.import")
+            except Exception as e:  # noqa: BLE001 - injected fault
+                return GenerationResult(
+                    request_id=req.request_id, finish_reason="error",
+                    error=f"handoff import failed: {type(e).__name__}: {e}")
+            state = req.handoff_state
+            text = state["text"]
+            return GenerationResult(
+                request_id=req.request_id,
+                text=text,
+                prompt_tokens=int(state.get("prompt_tokens", 0)),
+                completion_tokens=self._tok.count(text),
+                finish_reason=str(state.get("finish_reason", "stop")),
+                stop_sequence=state.get("stop_sequence"),
+            )
         text, stop_hit = apply_stop_sequences(
             self._extractive_sketch(req.prompt), req.stop)
+        prompt_tokens = self._tok.count(req.prompt)
+        if req.handoff_export:
+            # prefill role: emit only the first "token" (up to the first
+            # whitespace) and pin the full completion as the transferable
+            # state; a completion that IS its first token returns as a
+            # normal terminal result — nothing left to hand off
+            cut = text.find(" ")
+            first = text if cut < 0 else text[:cut + 1]
+            if first != text:
+                try:
+                    faults.fire("handoff.export")
+                except Exception as e:  # noqa: BLE001 - injected fault
+                    return GenerationResult(
+                        request_id=req.request_id, finish_reason="error",
+                        error=f"handoff export failed: "
+                              f"{type(e).__name__}: {e}")
+                payload = {"text": text, "prompt_tokens": prompt_tokens,
+                           "stop_sequence": stop_hit,
+                           "finish_reason": "stop"}
+                with self._pinned_lock:
+                    self._pinned[req.request_id] = {
+                        "payload": payload,
+                        "deadline_t": time.time() + self.handoff_ttl_s}
+                return GenerationResult(
+                    request_id=req.request_id,
+                    text=first,
+                    prompt_tokens=prompt_tokens,
+                    completion_tokens=self._tok.count(first),
+                    finish_reason="handoff",
+                )
         return GenerationResult(
             request_id=req.request_id,
             text=text,
-            prompt_tokens=self._tok.count(req.prompt),
+            prompt_tokens=prompt_tokens,
             completion_tokens=self._tok.count(text),
             finish_reason="stop",
             stop_sequence=stop_hit,

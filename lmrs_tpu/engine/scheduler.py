@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -367,6 +368,46 @@ class ContinuousScheduler:
         # auditor bookkeeping: result records that OVERWROTE an existing
         # result (every submitted id must terminate exactly once)
         self._audit_double_finish = 0
+        # Disaggregated handoff (docs/SERVING.md): sequences whose pages
+        # are PINNED for export — prefill finished, first token sampled,
+        # payload captured host-side, waiting for the decode pod's ack.
+        # rid -> {seq, payload, deadline_t, t_pinned}.  The lock covers
+        # the dict AND the run-liveness flag: export/release run on HTTP
+        # handler threads while the scheduler loop pins and sweeps.  Like
+        # cancel(), off-thread releases never touch the allocator while a
+        # run is live — a released record is parked on _release_deferred
+        # and its pages freed by the scheduler thread at the next block
+        # boundary (the allocator and prefix-cache refcounts have no
+        # internal synchronization).  With no run live the free happens
+        # inline, under the lock, so a starting run (which flips
+        # _run_live under the same lock before its first allocation)
+        # can never overlap it.  audit() accounts both classes as
+        # pinned-for-export holders.
+        self._pinned: dict[int, dict] = {}
+        self._release_deferred: list[tuple[int, dict, bool]] = []
+        self._run_live = False
+        self._pinned_lock = threading.Lock()
+        self._c_handoff_exports = c("lmrs_handoff_exports_total",
+                                    "requests pinned for prefill→decode "
+                                    "handoff")
+        self._c_handoff_imports = c("lmrs_handoff_imports_total",
+                                    "sequences imported from a handoff "
+                                    "payload")
+        self._c_handoff_orphaned = c("lmrs_handoff_orphaned_pages_total",
+                                     "pinned pages reclaimed by the "
+                                     "orphan sweep (ticket never acked)",
+                                     "pages")
+        self._g_pinned_pages = g("lmrs_handoff_pinned_pages",
+                                 "KV pages currently pinned for export",
+                                 "pages")
+        self._h_handoff_capture = h("lmrs_handoff_capture_seconds",
+                                    help="pin-time host capture of an "
+                                         "exported page set",
+                                    unit="seconds")
+        self._h_handoff_import = h("lmrs_handoff_import_seconds",
+                                   help="device scatter of an imported "
+                                        "page set at admission",
+                                   unit="seconds")
 
     @property
     def metrics(self) -> dict:
@@ -393,6 +434,10 @@ class ContinuousScheduler:
             "prefix_tokens_reused": int(self._c_prefix_tokens.value),
             "group_occupancy_sum": self._h_group_occupancy.sum,
             "group_dispatches": int(self._h_group_occupancy.count),
+            "handoff_exports": int(self._c_handoff_exports.value),
+            "handoff_imports": int(self._c_handoff_imports.value),
+            "handoff_orphaned_pages": int(self._c_handoff_orphaned.value),
+            "handoff_pinned_pages": int(self._g_pinned_pages.value),
         }
 
     def metrics_registry(self) -> MetricsRegistry:
@@ -550,6 +595,11 @@ class ContinuousScheduler:
         tracked per request id, not per slot).
         """
         t_run = time.time()
+        # taken BEFORE the first allocator touch: an off-thread
+        # release_handoff freeing inline holds this lock, so it either
+        # completes before we flip the flag or sees it set and defers
+        with self._pinned_lock:
+            self._run_live = True
         # per-run tracer capture: the CLI/bench enable tracing before the
         # engine runs; a None tracer keeps every site a single branch
         tr = self._tr = get_tracer()
@@ -629,6 +679,16 @@ class ContinuousScheduler:
                 if not queue:
                     break
                 req, ids, max_new, n_prompt, prior, t0 = queue[0]
+                if req.handoff_state is not None:
+                    # disaggregated decode role: the head entry's KV pages
+                    # arrive by import, not prefill (the slot enters decode
+                    # phase directly).  False = page back-pressure: stop
+                    # admitting and wait, same as the prefill path below.
+                    if not self._admit_import(b, queue, slots, results,
+                                              fresh, kv_lens, last_tok,
+                                              active, temps, top_k, top_p):
+                        break
+                    continue
                 # Prefix-cache probe: clone the longest cached page prefix
                 # (ref-counted, read-only) and start prefill at the match
                 # boundary.  match() always leaves >= 1 prompt token to
@@ -747,6 +807,12 @@ class ContinuousScheduler:
                 if self._cancelled:
                     self._sweep_cancelled(queue, slots, results, active, fresh,
                                           kv_lens, last_tok)
+                # acked/orphaned handoff releases parked by handler/sweeper
+                # threads free here, on the scheduler thread (see
+                # release_handoff) — their pages rejoin the pool within
+                # one block of the ack
+                if self._release_deferred:
+                    self._drain_released()
                 # deadline expiry rides the same block-boundary cadence as
                 # the cancel sweep: an in-flight request expires within one
                 # decode block of its deadline
@@ -792,10 +858,17 @@ class ContinuousScheduler:
                         # admissions in the same run can hit immediately
                         self._cache_insert(st)
                         deferred.append((b, p, row))
-                if pending and (self.spec_k or not self.defer_tok0):
+                if pending and (self.spec_k or not self.defer_tok0
+                                or any(slots[b] is not None
+                                       and slots[b].req.handoff_export
+                                       for b, _, _ in deferred)):
                     # speculation seeds a host-built history row per admission —
                     # it needs tok0 values now, so it keeps the synchronous
-                    # fetch (also selectable via LMRS_DEFER_TOK0=0 for A/B runs)
+                    # fetch (also selectable via LMRS_DEFER_TOK0=0 for A/B runs).
+                    # Handoff-export slots force it too: their budget is 1, so
+                    # the sync fetch finishes (pins) them here and the prefill
+                    # pod never burns a decode-block dispatch on tokens the
+                    # handoff would trim anyway.
                     fetched = self._timed_get([t for t, _ in pending])
                     for (b, p, row) in deferred:
                         st = slots[b]
@@ -913,6 +986,34 @@ class ContinuousScheduler:
                             "slot %d page release failed in recovery", b)
                     slots[b] = None
             queue.clear()
+            # pinned-for-export KV content dies with the re-zeroed pool,
+            # so the records are dropped (next ticket fetch 410s → the
+            # router re-prefills) — but their PAGES must free through the
+            # allocator, which survives reallocate() (it only re-zeros
+            # the k/v buffers): clearing without close_sequence would
+            # leak refcount-held pages forever.  Freed BEFORE the prefix-
+            # cache clear: clear() skips nodes a live holder still shares,
+            # so a pinned seq released after it would strand a cache node
+            # pointing at discarded pool content.  Snapshot-and-clear is
+            # atomic under the pin lock, so a racing off-thread release
+            # (which pops/parks under the same lock) can never slip a
+            # record past the sweep.
+            with self._pinned_lock:
+                dropped = ([r["seq"] for r in self._pinned.values()]
+                           + [rec["seq"]
+                              for _, rec, _ in self._release_deferred])
+                self._pinned.clear()
+                self._release_deferred.clear()
+            for seq in dropped:
+                try:
+                    self.cache.close_sequence(seq)
+                except ValueError:
+                    logger.exception("pinned handoff page release failed "
+                                     "in recovery")
+            if dropped:
+                logger.warning("pool recovery dropped %d pinned handoffs",
+                               len(dropped))
+                self._update_pinned_gauge()
             if self._prefix_cache is not None:
                 self._prefix_cache.clear()
             self.cache.reallocate()
@@ -936,6 +1037,11 @@ class ContinuousScheduler:
             self._on_tokens = None
             self._streamed = {}
             self._cancelled.clear()
+            with self._pinned_lock:
+                self._run_live = False
+            # releases parked during the run free here, on the scheduler
+            # thread, so nothing stays deferred between runs
+            self._drain_released()
         return [results[r.request_id] for r in all_requests]
 
     def _sweep_cancelled(self, queue, slots, results, active, fresh,
@@ -1079,12 +1185,20 @@ class ContinuousScheduler:
         * radix-tree structure — edge labels, child keys, parent links,
           no double retention (prefix_cache.audit);
         * termination discipline — no request of any run on this scheduler
-          ever terminated more than once (_record_result bookkeeping).
+          ever terminated more than once (_record_result bookkeeping);
+        * pinned-for-export pages (disaggregated handoff) — sequences
+          pinned awaiting a decode-pod ack hold exactly one reference per
+          page, accounted like live sequences, so refcount balance and
+          page conservation hold ACROSS the handoff transaction.
 
         Between runs (the default) there are no live sequences; pass
         ``live_seqs`` to audit mid-run state from a callback."""
         holders: dict[int, int] = {}
-        for seq in live_seqs or ():
+        with self._pinned_lock:
+            pinned_seqs = ([r["seq"] for r in self._pinned.values()]
+                           + [rec["seq"]
+                              for _, rec, _ in self._release_deferred])
+        for seq in list(live_seqs or ()) + pinned_seqs:
             for p in seq.pages:
                 holders[p] = holders.get(p, 0) + 1
         violations: list[str] = []
@@ -1175,6 +1289,315 @@ class ContinuousScheduler:
             kv_lens[b] = 0
             last_tok[b] = 0
 
+    # ------------------------------------------- disaggregated handoff
+
+    def _orig_budget(self, req: GenerationRequest) -> int:
+        """The request's REAL token budget (before the handoff_export
+        clamp to 1 in _encode) — what the ticket forwards to the decode
+        pod, and the is-there-anything-left-to-hand-off test."""
+        return min(req.max_new_tokens, self.cfg.max_tokens,
+                   self.max_len - 1)
+
+    def _pin_handoff(self, b, slots, results, active, fresh, kv_lens,
+                     last_tok, gen, text) -> None:
+        """Finish a prefill-role slot as ``finish_reason="handoff"``: the
+        payload (page data + resume state) is captured host-side NOW, on
+        the scheduler thread — later exports then never touch the device,
+        so a handler-thread fetch cannot race a dispatch that donates the
+        pools.  The sequence's pages stay allocated (the pinned-for-export
+        class) until release_handoff (decode ack) or the orphan sweep.
+        Capture failure (injected ``handoff.export`` fault or a real
+        gather error) degrades to a marked per-request error — the router
+        re-prefills elsewhere; the pool stays clean."""
+        st = slots[b]
+        rid = st.req.request_id
+        now = time.time()
+        keep = self.cache.pages_needed(len(st.prompt_ids))
+        try:
+            t0 = time.time()
+            payload = self.cache.export_sequence(st.seq, len(st.prompt_ids))
+            if self._kv_quant:
+                # per-slot scales, frozen at prefill: the decode pod
+                # scatters them into ITS slot's scale rows at admission.
+                # One batched fetch — on a tunneled chip each device_get
+                # is a full host RTT the dispatch loop stalls on
+                ks, vs = self._timed_get((self.kscale[:, b],
+                                          self.vscale[:, b]))
+                payload["kscale"] = np.asarray(ks)
+                payload["vscale"] = np.asarray(vs)
+            self._h_handoff_capture.observe(time.time() - t0)
+        except Exception as e:  # noqa: BLE001 - degrade per request
+            logger.warning("handoff export capture failed for request %d",
+                           rid, exc_info=True)
+            self._record_result(results, GenerationResult(
+                request_id=rid, prompt_tokens=st.n_prompt,
+                finish_reason="error",
+                error=f"handoff export failed: {type(e).__name__}: {e}"))
+            if fresh is not None:
+                fresh.append(rid)
+            self.cache.close_sequence(st.seq)
+            slots[b] = None
+            active[b] = False
+            if kv_lens is not None:
+                kv_lens[b] = 0
+                last_tok[b] = 0
+            return
+        # resume state: exactly the tokens whose KV is exported, plus the
+        # sampled-but-not-yet-written first token the decode pod feeds
+        payload["tokens"] = [int(t) for t in st.prompt_ids]
+        payload["generated"] = [int(t) for t in gen]
+        payload["n_prompt"] = st.n_prompt
+        # budget-overshoot pages (decode-capacity growth past the prompt)
+        # are NOT part of the handoff — release them before pinning
+        if len(st.seq.pages) > keep:
+            self.cache.allocator.free(st.seq.pages[keep:])
+            st.seq.pages = st.seq.pages[:keep]
+        st.seq.length = len(st.prompt_ids)
+        rem = remaining_budget(st.req)
+        ttl = self.cfg.handoff_ttl_s
+        if rem is not None:
+            # deadline budgets forward through the ticket: pages pinned
+            # past the request's own deadline are already worthless
+            ttl = max(0.5, min(ttl, rem))
+        with self._pinned_lock:
+            self._pinned[rid] = {"seq": st.seq, "payload": payload,
+                                 "deadline_t": now + ttl, "t_pinned": now}
+        self._update_pinned_gauge()
+        self._c_handoff_exports.inc()
+        self._record_result(results, GenerationResult(
+            request_id=rid, text=text, prompt_tokens=st.n_prompt,
+            completion_tokens=len(gen), finish_reason="handoff",
+            device_seconds=now - st.t_start))
+        if self._tr:
+            tid = req_tid(rid)
+            if st.t_decode_start:
+                self._tr.complete("decode", st.t_decode_start, now, tid=tid,
+                                  args={"completion_tokens": len(gen)})
+            self._tr.instant("handoff_export", ts=now, tid=tid,
+                             args={"pages": len(st.seq.pages),
+                                   "kv_len": len(st.prompt_ids)})
+        if fresh is not None:
+            fresh.append(rid)
+        slots[b] = None
+        active[b] = False
+        if kv_lens is not None:
+            kv_lens[b] = 0
+            last_tok[b] = 0
+
+    def _update_pinned_gauge(self) -> None:
+        with self._pinned_lock:
+            total = sum(len(r["seq"].pages) for r in self._pinned.values())
+        self._g_pinned_pages.set(total)
+
+    def export_handoff(self, request_id: int) -> dict:
+        """Wire payload of a pinned export (serving-layer ticket fetch).
+        Reads the host-side copy captured at pin time — no device access,
+        so handler threads never race the dispatch loop — and is
+        repeatable: a retried transfer re-reads the same payload.  Raises
+        ``KeyError`` for unknown/released ids (the ticket 410 path)."""
+        with self._pinned_lock:
+            return self._pinned[request_id]["payload"]
+
+    def release_handoff(self, request_id: int, orphaned: bool = False) -> int:
+        """Release a pinned export's pages: the decode side acked (or,
+        with ``orphaned=True``, the ticket deadline expired un-acked and
+        the sweep is reclaiming).  Idempotent — unknown ids no-op, so a
+        duplicate ack can never double-free.  Returns pages released.
+
+        Callable from any thread.  While a run is live the actual free is
+        DEFERRED to the scheduler thread's next block boundary (the
+        allocator and prefix-cache refcounts are unsynchronized — only
+        the dispatch loop may touch them mid-run); idle, the free happens
+        inline under the pin lock, which a starting run must take before
+        its first allocation."""
+        with self._pinned_lock:
+            rec = self._pinned.pop(request_id, None)
+            if rec is None:
+                return 0
+            n = len(rec["seq"].pages)
+            if self._run_live:
+                self._release_deferred.append((request_id, rec, orphaned))
+            else:
+                self.cache.close_sequence(rec["seq"])
+        self._update_pinned_gauge()
+        if orphaned:
+            self._c_handoff_orphaned.inc(n)
+            logger.warning("handoff %d orphaned: %d pinned pages reclaimed",
+                           request_id, n)
+        if self._tr:
+            self._tr.instant("handoff_release", tid=req_tid(request_id),
+                             args={"pages": n, "orphaned": orphaned})
+        return n
+
+    def _drain_released(self) -> None:
+        """Free pages of releases parked while the run was live.  Runs on
+        the scheduler thread only (block boundaries + end of run).  The
+        frees happen UNDER the pin lock: the end-of-run drain executes
+        after _run_live flips False, when an HTTP ack can already free
+        inline — the shared lock serializes the two (the allocator has no
+        synchronization of its own)."""
+        with self._pinned_lock:
+            items, self._release_deferred = self._release_deferred, []
+            for rid, rec, _orphaned in items:
+                try:
+                    self.cache.close_sequence(rec["seq"])
+                except ValueError:
+                    logger.exception("deferred handoff release of request "
+                                     "%d failed", rid)
+
+    def sweep_handoffs(self, now: float | None = None) -> int:
+        """Reclaim pinned exports whose ticket deadline expired (the
+        orphan sweeper's engine half).  Returns pages released."""
+        now = time.time() if now is None else now
+        with self._pinned_lock:
+            expired = [rid for rid, r in self._pinned.items()
+                       if r["deadline_t"] <= now]
+        return sum(self.release_handoff(rid, orphaned=True)
+                   for rid in expired)
+
+    def pinned_handoffs(self) -> dict[int, int]:
+        """rid -> pinned page count snapshot (tests + metrics)."""
+        with self._pinned_lock:
+            return {rid: len(r["seq"].pages)
+                    for rid, r in self._pinned.items()}
+
+    def _admit_import(self, b, queue, slots, results, fresh, kv_lens,
+                      last_tok, active, temps, top_k, top_p) -> bool:
+        """Admit the queue head's IMPORTED sequence (disaggregated decode
+        role): scatter the transferred pages into the local pool and enter
+        the slot directly in decode phase — no prefill ever dispatches for
+        it.  Returns False on page back-pressure (the entry stays queued
+        and admission waits, exactly like the prefill path); a payload
+        failure (corrupt, incompatible pool geometry, token mismatch, or
+        an injected ``handoff.import`` fault) terminates the entry with a
+        MARKED error result — the router's re-prefill fallback owns the
+        retry, and the pool stays clean either way."""
+        req, ids, max_new, n_prompt, prior, t0 = queue[0]
+        state = req.handoff_state
+        try:
+            need = int(state.get("n_pages", 0) or 0)
+        except (TypeError, ValueError):
+            need = -1
+        if not 0 < need <= min(self.cache.max_pages_per_slot,
+                               self.cache.num_pages - 1):
+            # an unsatisfiable page claim must error-terminate, never wait:
+            # treating it as back-pressure would wedge the queue head
+            # forever and starve everything behind it
+            queue.popleft()
+            self._record_result(results, GenerationResult(
+                request_id=req.request_id, prompt_tokens=n_prompt,
+                finish_reason="error",
+                error=f"handoff import failed: page claim {need} exceeds "
+                      "this pool's capacity (geometry drift or corrupt "
+                      "ticket)"))
+            fresh.append(req.request_id)
+            return True
+        if need > self.cache.allocator.free_count:
+            if self._prefix_cache is not None:
+                self._prefix_cache.evict(
+                    need - self.cache.allocator.free_count)
+            if need > self.cache.allocator.free_count:
+                return False
+        queue.popleft()
+        t_imp = time.time()
+        try:
+            gen = [int(t) for t in state.get("generated", ())]
+            toks = [int(t) for t in state.get("tokens", ())]
+            kv_len = int(state.get("kv_len", -1))
+            if toks != list(ids):
+                # tokenizer/config drift between pods: the imported KV
+                # covers different token ids than this pod derives from
+                # the same prompt — resuming would be silent corruption
+                raise ValueError(
+                    f"token mismatch: payload covers {len(toks)} prompt "
+                    f"tokens, this pod encodes {len(ids)}"
+                    + ("" if len(toks) != len(ids)
+                       else " (same count, different ids)"))
+            if kv_len != len(ids):
+                raise ValueError(
+                    f"inconsistent payload: kv_len {kv_len} != "
+                    f"{len(ids)} prompt tokens")
+            if not gen:
+                raise ValueError("handoff state carries no resume token")
+            scales = None
+            if self._kv_quant:
+                # int8 pool: the exporter's per-slot scales are REQUIRED
+                # and shape-checked here, inside the marked-error guard —
+                # silently keeping the previous slot occupant's scales
+                # would dequantize the imported pages into garbage
+                want = ((int(self.kscale.shape[0]),)
+                        + tuple(int(s) for s in self.kscale.shape[2:]))
+                try:
+                    ks = np.asarray(state["kscale"], dtype=np.float32)
+                    vs = np.asarray(state["vscale"], dtype=np.float32)
+                except (KeyError, TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"int8 pool payload missing/bad scales: {e}") from e
+                if ks.shape != want or vs.shape != want:
+                    raise ValueError(
+                        f"scale shape {ks.shape}/{vs.shape} != pool's "
+                        f"{want}")
+                scales = (ks, vs)
+            seq = self.cache.import_sequence(state)
+            # consumed: if this slot is later PREEMPTED, its continuation
+            # entry (prompt + generated so far) must re-admit through the
+            # normal prefill path — routing it back through here would
+            # fail the token-mismatch guard against the original prompt
+            req.handoff_state = None
+        except OutOfPages:
+            queue.appendleft((req, ids, max_new, n_prompt, prior, t0))
+            return False
+        except Exception as e:  # noqa: BLE001 - degrade per request
+            logger.warning("handoff import failed for request %d",
+                           req.request_id, exc_info=True)
+            self._record_result(results, GenerationResult(
+                request_id=req.request_id, prompt_tokens=n_prompt,
+                finish_reason="error",
+                error=f"handoff import failed: {type(e).__name__}: {e}"))
+            fresh.append(req.request_id)
+            return True
+        now = time.time()
+        if req.deadline_s is not None:
+            self._h_deadline_remaining.observe(req.deadline_s - now)
+        st = _SlotState(req=req, prompt_ids=ids, max_new=max_new, seq=seq,
+                        t_start=now, n_prompt=n_prompt)
+        st.phase = "decode"
+        st.prefill_pos = len(ids)
+        st.kv_len = kv_len
+        st.generated = gen
+        st.t_admit = now
+        st.t_decode_start = now
+        slots[b] = st
+        kv_lens[b] = st.kv_len
+        last_tok[b] = gen[-1]
+        active[b] = True
+        temps[b] = req.temperature
+        top_k[b] = req.top_k
+        top_p[b] = min(max(req.top_p, 0.0), 1.0)
+        if scales is not None:
+            # the exporter's per-slot scales (validated above), scattered
+            # into THIS slot's rows — imported int8 pages dequantize with
+            # their own scales
+            self.kscale = self.kscale.at[:, b].set(jnp.asarray(scales[0]))
+            self.vscale = self.vscale.at[:, b].set(jnp.asarray(scales[1]))
+        self.seed_history(b, st)
+        self._c_handoff_imports.inc()
+        self._h_handoff_import.observe(time.time() - t_imp)
+        self._g_peak_pages.track_max(self.cache.num_pages - 1
+                                     - self.cache.allocator.free_count)
+        self._g_peak_slots.track_max(sum(s is not None for s in slots))
+        if self._tr:
+            self._tr.instant("handoff_import", ts=now,
+                             tid=req_tid(req.request_id),
+                             args={"slot": b, "kv_len": kv_len,
+                                   "pages": len(seq.pages)})
+        # stream the already-generated first token immediately (the slot
+        # cannot be finished here: the pin guard excluded EOS/stop/budget-
+        # complete first tokens from ever becoming handoffs)
+        self._maybe_finish(b, slots, results, active, fresh, kv_lens,
+                           last_tok)
+        return True
+
     # ------------------------------------------------------------ internals
 
     def _encode(self, req: GenerationRequest) -> tuple[list[int], int]:
@@ -1191,6 +1614,13 @@ class ContinuousScheduler:
         if len(ids) > limit:
             head, tail = limit // 2, limit - limit // 2
             ids = ids[:head] + ids[-tail:]
+        if req.handoff_export:
+            # prefill role: stop after the first token (the ticket carries
+            # the rest of the budget).  Clamped AFTER the truncation math —
+            # the prompt cut must be byte-identical to what a colocated run
+            # (or the decode pod re-encoding this prompt) produces, or the
+            # imported KV would disagree with the decode side's token ids.
+            max_new = 1
         return ids, max_new
 
     # ---------------------------------------------------- roofline probe
@@ -1573,6 +2003,18 @@ class ContinuousScheduler:
                 self._on_tokens(st.req.request_id, text[len(sent):frontier])
                 self._streamed[st.req.request_id] = text[:frontier]
         if finished:
+            if (st.req.handoff_export and not hit_eos and stop_hit is None
+                    and not st.prior
+                    and len(gen) < self._orig_budget(st.req)):
+                # prefill role: the request is NOT complete — its budget
+                # was clamped to 1 at encode; pin the pages for export
+                # instead of freeing them.  A first token that IS terminal
+                # (EOS, stop hit, or a genuine 1-token budget) takes the
+                # normal finish below: there is nothing left to hand off
+                # and the serving layer returns the completion directly.
+                self._pin_handoff(b, slots, results, active, fresh,
+                                  kv_lens, last_tok, gen, text)
+                return
             finish = "stop" if (hit_eos or stop_hit) else "length"
             self._finish_slot(b, slots, results, active, fresh, kv_lens,
                               last_tok, gen, text, stop_hit, finish)

@@ -45,6 +45,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="upper bound on any request's max_tokens")
     p.add_argument("--batch-window-ms", type=float, default=20.0,
                    help="micro-batching window for pooling concurrent requests")
+    p.add_argument("--role", default="both",
+                   choices=["prefill", "decode", "both"],
+                   help="disaggregated serving role: 'prefill' stops "
+                        "handoff-flagged requests after the first token "
+                        "and publishes a KV-page ticket, 'decode' imports "
+                        "tickets and continues, 'both' (default) serves "
+                        "colocated (docs/SERVING.md)")
+    p.add_argument("--handoff-ttl", type=float, default=None,
+                   help="seconds an un-acked handoff ticket pins its KV "
+                        "pages before the orphan sweep reclaims them "
+                        "(default: LMRS_HANDOFF_TTL or 60)")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
@@ -64,6 +75,11 @@ def main(argv: list[str] | None = None) -> int:
         quantize=args.quantize,
         kv_quantize=args.kv_quantize,
         max_tokens=args.max_tokens_cap,
+        # explicit flag wins over LMRS_HANDOFF_TTL; validated by the
+        # config's __post_init__ (a non-positive TTL would disable the
+        # orphan-sweep backstop)
+        **({"handoff_ttl_s": args.handoff_ttl}
+           if args.handoff_ttl is not None else {}),
     )
     mesh_cfg = parse_mesh(args.mesh) if args.mesh else None
     try:
@@ -79,6 +95,7 @@ def main(argv: list[str] | None = None) -> int:
             engine, host=args.host, port=args.port, model_name=args.model,
             max_tokens_cap=args.max_tokens_cap,
             batch_window_s=args.batch_window_ms / 1000.0,
+            role=args.role, handoff_ttl_s=engine_cfg.handoff_ttl_s,
         )
     except OSError as e:
         logger.error("cannot bind %s:%d: %s", args.host, args.port, e)

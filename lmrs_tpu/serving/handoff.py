@@ -1,0 +1,187 @@
+"""Crash-safe KV page handoff: wire codec + ticket lifecycle bookkeeping.
+
+The disaggregated serving tier (docs/SERVING.md) splits a request across
+two pods: a PREFILL pod writes the prompt's KV pages and samples the first
+token, a DECODE pod imports those pages and continues.  This module owns
+the pieces both sides share:
+
+* **payload codec** — ``encode_payload``/``decode_payload`` serialize the
+  ``PagedKVCache.export_sequence`` dict (numpy page data, bf16 or int8,
+  plus sampler/slot state) into one self-framing byte blob: a JSON header
+  with dtype/shape metadata followed by the raw array bytes.  stdlib +
+  numpy only — the serving runtime pulls in no pickle (payloads cross
+  trust boundaries) and no extra deps.
+
+* **TicketRegistry** — the prefill side's record of published handoffs.
+  A ticket is created when a request finishes with
+  ``finish_reason="handoff"`` (its pages stay PINNED in the engine), is
+  consumed exactly once by the decode side's ack, and expires at its
+  deadline — the orphan sweeper then releases the pinned pages.  At-most-
+  once: a consumed or expired ticket answers ``410``-style ``None`` to
+  every later fetch/ack, so a duplicate ack can never double-free.
+
+* **ImportLog** — the decode side's dedup set.  An ack lost on the wire
+  makes the router (or chaos) able to re-deliver a ticket this host has
+  already imported; the log rejects the duplicate idempotently instead of
+  double-importing (double pages, double decode, two results).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+
+import numpy as np
+
+__all__ = ["encode_payload", "decode_payload", "TicketRegistry", "ImportLog"]
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype from its string name, covering the ml_dtypes extensions
+    (bfloat16 et al.) numpy alone does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always present with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_payload(payload: dict) -> bytes:
+    """Serialize a handoff payload dict to one self-framing byte blob.
+
+    Layout: ``<8-byte big-endian header length><JSON header><raw bytes>``.
+    ndarray values are replaced in the header by ``{"__nd__": [dtype,
+    shape, offset, nbytes]}`` descriptors pointing into the raw section —
+    page data travels as raw dtype bytes (bf16/int8 exactly as stored),
+    never base64-in-JSON (a 33% tax on the hot transfer path)."""
+    header: dict = {}
+    blobs: list[bytes] = []
+    off = 0
+    for key, val in payload.items():
+        if isinstance(val, np.ndarray):
+            raw = np.ascontiguousarray(val).tobytes()
+            header[key] = {"__nd__": [str(val.dtype), list(val.shape),
+                                      off, len(raw)]}
+            blobs.append(raw)
+            off += len(raw)
+        else:
+            header[key] = val
+    head = json.dumps(header).encode("utf-8")
+    return len(head).to_bytes(8, "big") + head + b"".join(blobs)
+
+
+def decode_payload(data: bytes) -> dict:
+    """Inverse of :func:`encode_payload`.  Raises ``ValueError`` on a
+    truncated or malformed blob (a transfer fault mid-payload must surface
+    as a rejected import, never as silently-short page data)."""
+    if len(data) < 8:
+        raise ValueError("handoff payload truncated (no header frame)")
+    hlen = int.from_bytes(data[:8], "big")
+    if len(data) < 8 + hlen:
+        raise ValueError("handoff payload truncated (header incomplete)")
+    try:
+        header = json.loads(data[8:8 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"handoff payload header unparseable: {e}") from e
+    body = data[8 + hlen:]
+    out: dict = {}
+    for key, val in header.items():
+        if isinstance(val, dict) and "__nd__" in val:
+            dtype_name, shape, off, nbytes = val["__nd__"]
+            if off + nbytes > len(body):
+                raise ValueError(
+                    f"handoff payload truncated: array {key!r} needs "
+                    f"{off + nbytes} body bytes, have {len(body)}")
+            arr = np.frombuffer(body[off:off + nbytes],
+                                dtype=_np_dtype(dtype_name))
+            out[key] = arr.reshape(shape)
+        else:
+            out[key] = val
+    return out
+
+
+class TicketRegistry:
+    """Prefill-side ticket table: id -> (request id, deadline, consumed).
+
+    Thread-safe (HTTP handler threads create/fetch/ack concurrently; the
+    sweeper thread expires).  ``clock`` is injectable for tests."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tickets: dict[str, dict] = {}
+
+    def create(self, request_id: int, deadline_t: float) -> str:
+        tid = uuid.uuid4().hex
+        with self._lock:
+            self._tickets[tid] = {"rid": request_id, "deadline_t": deadline_t,
+                                  "consumed": False,
+                                  "created_t": self._clock()}
+        return tid
+
+    def lookup(self, ticket: str) -> dict | None:
+        """Live-ticket record (a copy), or None when unknown, consumed, or
+        expired — the fetch path's 410 condition."""
+        now = self._clock()
+        with self._lock:
+            rec = self._tickets.get(ticket)
+            if rec is None or rec["consumed"] or rec["deadline_t"] <= now:
+                return None
+            return dict(rec)
+
+    def consume(self, ticket: str) -> int | None:
+        """Ack: mark the ticket consumed exactly once and return its
+        request id; None for unknown/expired/already-consumed (duplicate
+        acks are idempotent rejections, never double-frees)."""
+        now = self._clock()
+        with self._lock:
+            rec = self._tickets.get(ticket)
+            if rec is None or rec["consumed"] or rec["deadline_t"] <= now:
+                return None
+            rec["consumed"] = True
+            return rec["rid"]
+
+    def sweep(self, now: float | None = None) -> list[tuple[str, int, bool]]:
+        """Drop expired and consumed-and-expired tickets; returns
+        ``[(ticket, rid, was_consumed)]`` — un-consumed entries are the
+        ORPHANS whose pinned pages the caller must release."""
+        now = self._clock() if now is None else now
+        out: list[tuple[str, int, bool]] = []
+        with self._lock:
+            for tid in [t for t, r in self._tickets.items()
+                        if r["deadline_t"] <= now]:
+                rec = self._tickets.pop(tid)
+                out.append((tid, rec["rid"], rec["consumed"]))
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(1 for r in self._tickets.values() if not r["consumed"])
+            return {"tickets": len(self._tickets), "unconsumed": live}
+
+
+class ImportLog:
+    """Decode-side dedup of imported ticket ids (bounded FIFO set)."""
+
+    def __init__(self, cap: int = 4096):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._seen: dict[str, None] = {}  # insertion-ordered
+
+    def seen(self, ticket: str) -> bool:
+        with self._lock:
+            return ticket in self._seen
+
+    def add(self, ticket: str) -> bool:
+        """Record an import; False when the ticket was already imported
+        here (the duplicate-rejection signal)."""
+        with self._lock:
+            if ticket in self._seen:
+                return False
+            self._seen[ticket] = None
+            while len(self._seen) > self._cap:
+                self._seen.pop(next(iter(self._seen)))
+            return True

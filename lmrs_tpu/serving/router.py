@@ -83,12 +83,17 @@ class _HostConnectError(ConnectionError):
 
 
 class _Host:
-    """One backend lmrs-serve process."""
+    """One backend lmrs-serve process.  ``role`` is the POOL it belongs to
+    ("prefill" | "decode" | "both") — pool membership is a routing policy;
+    every host can serve a full request (the colocated-fallback
+    invariant), prefill-role hosts just additionally mint handoff tickets
+    and decode-role hosts import them."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, role: str = "both"):
         u = urlsplit(url if "//" in url else f"http://{url}")
         self.netloc = u.netloc or u.path  # tolerate bare host:port
         self.url = f"http://{self.netloc}"
+        self.role = role
         self.healthy = True
         self.served = 0
         self.failed = 0
@@ -132,10 +137,30 @@ class RouterEngine:
 
     def __init__(self, hosts: list[str], timeout_s: float = 600.0,
                  probe_floor_s: float = 5.0, probe_jitter_s: float = 2.5,
-                 clock=time.monotonic):
-        if not hosts:
+                 clock=time.monotonic, prefill_hosts: list[str] = (),
+                 decode_hosts: list[str] = ()):
+        # Per-role pools (disaggregated serving, docs/SERVING.md): when
+        # BOTH the prefill and decode pools have members, requests run the
+        # two-tier handoff — admission to the prefill pool, KV-page ticket
+        # to the decode pool; a pool going empty or fully degraded falls
+        # the tier back to colocated operation over every full-capable
+        # host.  Plain deployments pass ``hosts`` only: one "both" pool,
+        # identical behavior to before.
+        self.hosts = ([_Host(h) for h in hosts]
+                      + [_Host(h, "prefill") for h in prefill_hosts]
+                      + [_Host(h, "decode") for h in decode_hosts])
+        if not self.hosts:
             raise ValueError("RouterEngine needs at least one backend host")
-        self.hosts = [_Host(h) for h in hosts]
+        self.pools: dict[str, list[_Host]] = {
+            role: [h for h in self.hosts if h.role == role]
+            for role in ("both", "prefill", "decode")}
+        # handoff accounting (Prometheus via prometheus_metrics).  _one
+        # runs concurrently on the dispatch pool, so increments go through
+        # _count (a bare += is a read-modify-write that loses updates)
+        self._handoffs = 0          # tickets successfully followed
+        self._handoff_retries = 0   # failed decode-leg attempts
+        self._handoff_fallbacks = 0  # disagg flows degraded to colocated
+        self._stats_lock = threading.Lock()
         # per-recv socket timeout: must exceed the worst-case SILENT wait —
         # a non-streamed generation sends nothing until it completes
         self.timeout_s = timeout_s
@@ -172,6 +197,11 @@ class RouterEngine:
         # pin every single-request wave (hierarchical reduce tails) onto
         # hosts[0] while the rest of the fleet idles
         self._rr_base = 0
+
+    def _count(self, attr: str) -> None:
+        """Increment a handoff counter atomically (dispatch-pool threads)."""
+        with self._stats_lock:
+            setattr(self, attr, getattr(self, attr) + 1)
 
     # ------------------------------------------------------------------ API
 
@@ -219,7 +249,7 @@ class RouterEngine:
     def engine_metrics(self) -> dict:
         per = []
         for h in self.hosts:
-            row = {"host": h.netloc, "healthy": h.healthy,
+            row = {"host": h.netloc, "role": h.role, "healthy": h.healthy,
                    "served": h.served, "failed": h.failed}
             conn = None
             try:
@@ -239,6 +269,12 @@ class RouterEngine:
             per.append(row)
         return {"hosts": len(self.hosts),
                 "healthy_hosts": sum(h.healthy for h in self.hosts),
+                "pools": {role: {"size": len(pool),
+                                 "healthy": sum(h.healthy for h in pool)}
+                          for role, pool in self.pools.items() if pool},
+                "handoff": {"handoffs": self._handoffs,
+                            "retries": self._handoff_retries,
+                            "fallbacks": self._handoff_fallbacks},
                 "per_host": per}
 
     def prometheus_metrics(self) -> str:
@@ -312,6 +348,31 @@ class RouterEngine:
                         "requests failed on this host").inc(h.failed)
             pages.append(add_label_to_exposition(
                 reg.render_prometheus(), "host", h.netloc))
+        # Per-role pool gauges (disaggregated serving).  Only pools with
+        # members are emitted, so a colocated deployment reports exactly
+        # one "both" pool — dashboards never fork on topology.
+        for role, pool in self.pools.items():
+            if not pool:
+                continue
+            reg = MetricsRegistry()
+            reg.gauge("lmrs_router_pool_size",
+                      "backend hosts in this role pool").set(len(pool))
+            reg.gauge("lmrs_router_pool_healthy",
+                      "healthy hosts in this role pool").set(
+                sum(h.healthy for h in pool))
+            pages.append(add_label_to_exposition(
+                reg.render_prometheus(), "pool", role))
+        hreg = MetricsRegistry()
+        hreg.counter("lmrs_handoff_total",
+                     "prefill→decode handoff tickets followed by the "
+                     "router").inc(self._handoffs)
+        hreg.counter("lmrs_handoff_retries_total",
+                     "failed decode-leg attempts (retried or degraded)"
+                     ).inc(self._handoff_retries)
+        hreg.counter("lmrs_handoff_fallbacks_total",
+                     "handoff flows degraded to colocated re-prefill"
+                     ).inc(self._handoff_fallbacks)
+        pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
 
     # ------------------------------------------------------------ internals
@@ -357,20 +418,57 @@ class RouterEngine:
             self._pool.submit(host.probe)
         return probed
 
-    def _targets(self, start: int) -> list[_Host]:
-        """Healthy hosts in round-robin order from ``start``; every host
-        when none is marked healthy (a transient fault must not brick the
-        fleet — same optimism as ReplicatedEngine)."""
-        n = len(self.hosts)
-        order = [self.hosts[(start + k) % n] for k in range(n)]
+    def _targets(self, start: int, role: str = "full") -> list[_Host]:
+        """Hosts eligible for ``role`` in round-robin order from
+        ``start``, healthy first — every eligible host when none is
+        marked healthy (a transient fault must not brick the fleet — same
+        optimism as ReplicatedEngine).
+
+        Pool-aware (disaggregated serving): role "prefill"/"decode" draws
+        from that pool, falling back to the "both" pool when the role
+        pool is empty; role "full" (colocated dispatch) draws from EVERY
+        host — pool membership is routing policy, not capability, so a
+        degraded tier still serves from whatever survives."""
+        if role == "full":
+            pool = self.hosts
+        else:
+            pool = self.pools.get(role) or self.pools["both"] or self.hosts
+        n = len(pool)
+        order = [pool[(start + k) % n] for k in range(n)]
         healthy = [h for h in order if h.healthy]
         return healthy or order
 
+    def _disagg_ready(self) -> bool:
+        """True while the two-tier handoff path is viable: both role
+        pools have members AND at least one healthy host each.  Anything
+        less falls the whole tier back to colocated operation (the
+        graceful-degradation contract, docs/SERVING.md)."""
+        if not (self.pools["prefill"] and self.pools["decode"]):
+            # no explicit split: nothing to disaggregate
+            return False
+        return (any(h.healthy for h in self.pools["prefill"])
+                and any(h.healthy for h in self.pools["decode"]))
+
     def _one(self, i: int, req: GenerationRequest, on_tokens,
              cancelled: set[int]) -> GenerationResult:
+        if self._disagg_ready():
+            res = self._one_disagg(i, req, on_tokens, cancelled)
+            if res is not None:
+                return res
+            # the two-tier flow degraded (no ticket, decode pool dark,
+            # ticket expired/consumed): RE-PREFILL colocated below — any
+            # full-capable host runs the whole request; the prefix cache
+            # on a previously-tried host makes the retry cheap
+            self._count("_handoff_fallbacks")
+            logger.warning("request %d: handoff degraded; re-prefilling "
+                           "colocated", req.request_id)
+        return self._one_colocated(i, req, on_tokens, cancelled)
+
+    def _one_colocated(self, i: int, req: GenerationRequest, on_tokens,
+                       cancelled: set[int]) -> GenerationResult:
         rid = req.request_id
         last_err = "no healthy backend"
-        for attempt, host in enumerate(self._targets(i)[:2]):
+        for attempt, host in enumerate(self._targets(i, "full")[:2]):
             if rid in cancelled:
                 return GenerationResult(request_id=rid,
                                         finish_reason="cancelled")
@@ -410,9 +508,184 @@ class RouterEngine:
         return GenerationResult(request_id=rid, finish_reason="error",
                                 error=last_err)
 
-    def _post(self, host: _Host, req: GenerationRequest, on_tokens,
-              streamed: list[int], cancelled: set[int]) -> GenerationResult:
+    def _one_disagg(self, i: int, req: GenerationRequest, on_tokens,
+                    cancelled: set[int]) -> GenerationResult | None:
+        """Two-tier dispatch: prefill pool mints a KV handoff ticket, the
+        decode pool follows it.  Returns None to fall back to colocated
+        re-prefill (no ticket obtainable, decode attempts exhausted, or
+        the ticket went stale) — EXCEPT once deltas have streamed, when a
+        failure must surface instead (a fallback would replay them).
+
+        At-most-once: the ticket is consumed by the first decode host
+        that acks; a failed decode attempt retries a sibling (fresh
+        import of the still-pinned pages), and a dead decode pod simply
+        never acks — the prefill pod's orphan sweep reclaims the pinned
+        pages at the ticket deadline while we re-prefill elsewhere."""
+        rid = req.request_id
+        # ---- stage 1: prefill + ticket ---------------------------------
+        ticket = None
+        for host in self._targets(i, "prefill")[:2]:
+            if rid in cancelled:
+                return GenerationResult(request_id=rid,
+                                        finish_reason="cancelled")
+            rem = remaining_budget(req)
+            if rem is not None and rem <= 0:
+                return GenerationResult(request_id=rid,
+                                        finish_reason="deadline")
+            try:
+                kind, out = self._post_prefill(host, req, cancelled)
+            except Exception as e:  # noqa: BLE001 - degrade per host
+                if rid in cancelled:
+                    return GenerationResult(request_id=rid,
+                                            finish_reason="cancelled")
+                host.failed += 1
+                if isinstance(e, _HostConnectError):
+                    host.healthy = False
+                logger.warning("prefill leg for %d failed on %s: %s: %s",
+                               rid, host.netloc, type(e).__name__, e)
+                continue
+            host.healthy = True
+            if kind == "result":
+                if out.finish_reason == "error":
+                    host.failed += 1
+                    continue  # next prefill host, then colocated fallback
+                # first token was terminal (EOS/stop/1-token budget) or a
+                # deadline outcome: the prefill response IS the completion
+                host.served += 1
+                if on_tokens is not None and out.text:
+                    on_tokens(rid, out.text)
+                return out
+            ticket = out  # {"ticket", "source", "first_text", ...}
+            host.served += 1  # a minted ticket IS a served prefill leg
+            break
+        if ticket is None:
+            return None  # no prefill pod could mint a ticket: fall back
+        self._count("_handoffs")
+        # ---- stage 2: decode follows the ticket ------------------------
+        extra = {"handoff": {"ticket": ticket["ticket"],
+                             "source": ticket["source"]}}
+        streamed = [0]
+        for host in self._targets(i + 1, "decode")[:2]:
+            if rid in cancelled:
+                return GenerationResult(request_id=rid,
+                                        finish_reason="cancelled")
+            rem = remaining_budget(req)
+            if rem is not None and rem <= 0:
+                # budget gone between legs: deadline contract keeps the
+                # partial text (docs/ROBUSTNESS.md) — the first token the
+                # prefill pod minted is real paid-for output, same as the
+                # colocated in-flight expiry path
+                first = str(ticket.get("first_text") or "")
+                if first and on_tokens is not None and not streamed[0]:
+                    on_tokens(rid, first)
+                return GenerationResult(
+                    request_id=rid, text=first,
+                    prompt_tokens=int(ticket.get("prompt_tokens", 0) or 0),
+                    completion_tokens=int(ticket.get("completion_tokens",
+                                                     0) or 0),
+                    finish_reason="deadline")
+            try:
+                res = self._post(host, req, on_tokens, streamed, cancelled,
+                                 body_extra=extra)
+            except Exception as e:  # noqa: BLE001 - degrade per host
+                if rid in cancelled:
+                    return GenerationResult(request_id=rid,
+                                            finish_reason="cancelled")
+                host.failed += 1
+                if isinstance(e, _HostConnectError):
+                    host.healthy = False
+                self._count("_handoff_retries")
+                logger.warning("decode leg for %d failed on %s: %s: %s",
+                               rid, host.netloc, type(e).__name__, e)
+                if streamed[0]:
+                    # deltas already forwarded: a retry or fallback would
+                    # replay them — surface the mid-stream failure
+                    return GenerationResult(
+                        request_id=rid, finish_reason="error",
+                        error=f"{host.netloc}: {type(e).__name__}: {e}")
+                continue
+            if res.finish_reason == "error":
+                # marked handoff failure (410 gone, duplicate, transfer
+                # fault, import failure): try a sibling decode host while
+                # the ticket may still be live, then fall back
+                host.failed += 1
+                self._count("_handoff_retries")
+                logger.warning("decode leg for %d rejected on %s: %s",
+                               rid, host.netloc, res.error)
+                if streamed[0]:
+                    return res
+                continue
+            host.served += 1
+            host.healthy = True
+            return res
+        return None if not streamed[0] else GenerationResult(
+            request_id=rid, finish_reason="error",
+            error="handoff decode attempts exhausted mid-stream")
+
+    def _post_prefill(self, host: _Host, req: GenerationRequest,
+                      cancelled: set[int]):
+        """POST the prefill leg (``handoff: true``, never streamed) and
+        parse either outcome: ``("ticket", desc)`` for a minted handoff
+        ticket (source filled in with the answering host), or
+        ``("result", GenerationResult)`` when the first token was already
+        terminal and the prefill response is the whole completion."""
         body = _request_body(req)
+        body["handoff"] = True
+        rid = req.request_id
+        timeout = self.timeout_s
+        rem = remaining_budget(req)
+        if rem is not None:
+            # same clip as _post: a wedged prefill pod must not hold a
+            # dispatch thread past the request's own deadline budget
+            timeout = max(1.0, min(timeout, rem + 5.0))
+        conn = host.connect(timeout)
+        with self._inflight_lock:
+            self._inflight[rid] = conn
+        try:
+            try:
+                conn.connect()
+            except OSError as e:
+                raise _HostConnectError(str(e)) from e
+            with self._inflight_lock:
+                self._inflight[rid] = conn.sock
+            conn.request("POST", "/v1/chat/completions",
+                         body=json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            if rid in cancelled:
+                raise ConnectionAbortedError("cancelled during connect")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return "result", GenerationResult(
+                    request_id=rid, finish_reason="error",
+                    error=self._error_message(resp))
+            data = json.loads(resp.read())
+            if "handoff" in data:
+                desc = dict(data["handoff"])
+                desc.setdefault("source", host.netloc)
+                return "ticket", desc
+            choice = data["choices"][0]
+            usage = data.get("usage") or {}
+            return "result", GenerationResult(
+                request_id=rid,
+                text=choice["message"]["content"],
+                prompt_tokens=int(usage.get("prompt_tokens", 0)),
+                completion_tokens=int(usage.get("completion_tokens", 0)),
+                finish_reason=choice.get("finish_reason") or "stop",
+            )
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(rid, None)
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _post(self, host: _Host, req: GenerationRequest, on_tokens,
+              streamed: list[int], cancelled: set[int],
+              body_extra: dict | None = None) -> GenerationResult:
+        body = _request_body(req)
+        if body_extra:
+            body.update(body_extra)
         if on_tokens is not None:
             body["stream"] = True
             body["stream_options"] = {"include_usage": True}

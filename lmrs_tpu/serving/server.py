@@ -27,6 +27,7 @@ web framework this image doesn't have.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import math
@@ -38,6 +39,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+from lmrs_tpu.serving.handoff import (ImportLog, TicketRegistry,
+                                      decode_payload, encode_payload)
 from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.serving")
@@ -364,12 +367,45 @@ class EngineHTTPServer:
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8000,
                  model_name: str = "lmrs-tpu", max_tokens_cap: int = 4096,
-                 batch_window_s: float = 0.02):
+                 batch_window_s: float = 0.02, role: str = "both",
+                 handoff_ttl_s: float = 60.0):
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown serving role {role!r}; "
+                             "want prefill|decode|both")
         self.engine = engine
         self.model_name = model_name
         self.max_tokens_cap = max_tokens_cap
         self.batcher = _Batcher(engine, window_s=batch_window_s)
         self.started = time.time()
+        # Disaggregated serving (docs/SERVING.md): the ROLE is a policy,
+        # not a capability — a prefill-role server short-circuits only
+        # requests that carry the handoff flag (plain requests still run
+        # to completion, which is what makes the router's colocated
+        # fallback graceful), and a decode-role server refuses to mint
+        # tickets but serves everything else.
+        self.role = role
+        self.handoff_ttl_s = handoff_ttl_s
+        self.handoff = TicketRegistry()       # prefill side: live tickets
+        self._imported = ImportLog()          # decode side: dedup
+        from lmrs_tpu.obs import MetricsRegistry
+        self._handoff_reg = MetricsRegistry()
+        hc, hh = self._handoff_reg.counter, self._handoff_reg.histogram
+        self._c_tickets = hc("lmrs_handoff_tickets_total",
+                             "handoff tickets published (prefill side)")
+        self._c_acks = hc("lmrs_handoff_acks_total",
+                          "handoff acks accepted (prefill side)")
+        self._c_dup_rejects = hc("lmrs_handoff_duplicate_rejects_total",
+                                 "duplicate/stale imports rejected "
+                                 "idempotently (decode side)")
+        self._c_ack_failures = hc("lmrs_handoff_ack_failures_total",
+                                  "acks lost after retries (pages left to "
+                                  "the prefill orphan sweep)")
+        self._h_transfer = hh("lmrs_handoff_transfer_seconds",
+                              help="payload fetch prefill→decode",
+                              unit="seconds")
+        self._sweep_stop = threading.Event()
+        self._sweeper = threading.Thread(target=self._sweep_loop, daemon=True)
+        self._sweeper.start()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -400,8 +436,10 @@ class EngineHTTPServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._send(200, {"status": "ok",
+                    self._send(200, {"status": "ok", "role": outer.role,
                                      "uptime_s": round(time.time() - outer.started, 1)})
+                elif self.path.startswith("/v1/handoff/"):
+                    self._get_handoff(self.path.split("/")[3])
                 elif self.path == "/v1/models":
                     self._send(200, {"object": "list", "data": [
                         {"id": outer.model_name, "object": "model",
@@ -421,6 +459,7 @@ class EngineHTTPServer:
                         "engine": outer.engine.engine_metrics(),
                         "http_batches": outer.batcher.batches_run,
                         "http_requests": outer.batcher.requests_served,
+                        "handoff": outer.handoff_stats(),
                     })
                 else:
                     self._send(404, {"error": {"message": f"no route {self.path}"}})
@@ -484,7 +523,95 @@ class EngineHTTPServer:
                 req.deadline_s = time.time() + budget
                 return True
 
+            # -------------------------------------- disaggregated handoff
+
+            def _get_handoff(self, ticket: str) -> None:
+                """Serve a pinned page-set payload to the pulling decode
+                pod.  Unknown / expired / consumed tickets are 410 Gone —
+                the decode side then reports a handoff error and the
+                router re-prefills (at-most-once: a consumed ticket can
+                never be served again)."""
+                rec = outer.handoff.lookup(ticket)
+                export = getattr(outer.engine, "export_handoff", None)
+                if rec is None or export is None:
+                    self._send(410, {"error": {
+                        "message": f"handoff ticket {ticket} gone "
+                                   "(expired, consumed, or unknown)",
+                        "type": "handoff_error"}})
+                    return
+                try:
+                    data = encode_payload(export(rec["rid"]))
+                except KeyError:
+                    # pinned pages already swept (engine-side TTL)
+                    self._send(410, {"error": {
+                        "message": f"handoff ticket {ticket} gone "
+                                   "(pages reclaimed)",
+                        "type": "handoff_error"}})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _ack_handoff(self, ticket: str) -> None:
+                """Consume a ticket exactly once and release its pinned
+                pages.  Duplicate/late acks answer 410 and free nothing
+                (release is idempotent engine-side too)."""
+                rid = outer.handoff.consume(ticket)
+                if rid is None:
+                    self._send(410, {"error": {
+                        "message": f"handoff ticket {ticket} not ackable "
+                                   "(expired, consumed, or unknown)",
+                        "type": "handoff_error"}})
+                    return
+                release = getattr(outer.engine, "release_handoff", None)
+                pages = release(rid) if release is not None else 0
+                outer._c_acks.inc()
+                self._send(200, {"status": "acked", "pages_released": pages})
+
+            def _apply_handoff(self, req: GenerationRequest,
+                               body: dict) -> bool:
+                """Wire the body's ``handoff`` field onto the request.
+                ``true`` asks for a prefill-role export (ignored — i.e.
+                colocated full generation — when this server's role or
+                engine cannot honor it, or when the client streams);
+                a descriptor object asks for a decode-role import (the
+                payload is pulled from the source pod and acked here).
+                Returns False after answering an error response."""
+                h = body.get("handoff")
+                if h in (None, False):
+                    return True
+                supported = getattr(outer.engine, "supports_handoff", False)
+                if h is True:
+                    if (outer.role != "decode" and supported
+                            and not body.get("stream")):
+                        req.handoff_export = True
+                    return True
+                if not isinstance(h, dict):
+                    self._send(400, {"error": {
+                        "message": "handoff must be true or a ticket "
+                                   "descriptor object",
+                        "type": "handoff_error"}})
+                    return False
+                if outer.role == "prefill" or not supported:
+                    self._send(409, {"error": {
+                        "message": "this host does not import handoffs "
+                                   f"(role={outer.role})",
+                        "type": "handoff_error"}})
+                    return False
+                payload, err = outer._fetch_handoff(h)
+                if err is not None:
+                    self._send(err[0], err[1])
+                    return False
+                req.handoff_state = payload
+                return True
+
             def do_POST(self):
+                if (self.path.startswith("/v1/handoff/")
+                        and self.path.endswith("/ack")):
+                    self._ack_handoff(self.path.split("/")[3])
+                    return
                 body = self._read_json()
                 if body is None:
                     self._send(400, {"error": {"message": "invalid JSON body"}})
@@ -493,6 +620,8 @@ class EngineHTTPServer:
                     if self.path == "/v1/chat/completions":
                         req = _chat_to_request(body, outer.max_tokens_cap)
                         if not self._apply_deadline(req, body):
+                            return
+                        if not self._apply_handoff(req, body):
                             return
                         if body.get("stream"):
                             self._stream_openai(
@@ -505,13 +634,18 @@ class EngineHTTPServer:
                         # and a disconnect can race normal completion — a
                         # dead socket just raises, swallowed below
                         try:
-                            self._respond_openai(body, res)
+                            if res.finish_reason == "handoff":
+                                self._respond_ticket(res)
+                            else:
+                                self._respond_openai(body, res)
                         except OSError:
                             logger.debug("client gone before response write")
                         return
                     elif self.path == "/v1/messages":
                         req = _messages_to_request(body, outer.max_tokens_cap)
                         if not self._apply_deadline(req, body):
+                            return
+                        if not self._apply_handoff(req, body):
                             return
                         if body.get("stream"):
                             self._stream_anthropic(
@@ -520,7 +654,10 @@ class EngineHTTPServer:
                         res = outer.batcher.submit(
                             req, poll_disconnect=self._client_gone)
                         try:
-                            self._respond_anthropic(body, res)
+                            if res.finish_reason == "handoff":
+                                self._respond_ticket(res)
+                            else:
+                                self._respond_anthropic(body, res)
                         except OSError:
                             logger.debug("client gone before response write")
                         return
@@ -529,6 +666,27 @@ class EngineHTTPServer:
                 except Exception as e:
                     logger.exception("request handling failed")
                     self._send(500, {"error": {"message": str(e)}})
+
+            def _respond_ticket(self, res: GenerationResult) -> None:
+                """Publish a handoff ticket for a prefill-role completion:
+                the request stopped after its first token with pages
+                pinned; the ticket is what the router follows to the
+                decode pool.  Never reaches plain clients — only requests
+                that ASKED for handoff can produce finish_reason='handoff'."""
+                ttl = outer.handoff_ttl_s
+                tid = outer.handoff.create(res.request_id,
+                                           time.time() + ttl)
+                outer._c_tickets.inc()
+                self._send(200, {
+                    "object": "handoff.ticket",
+                    "handoff": {
+                        "ticket": tid,
+                        "first_text": res.text,
+                        "prompt_tokens": res.prompt_tokens,
+                        "completion_tokens": res.completion_tokens,
+                        "expires_in_s": ttl,
+                    },
+                })
 
             # ------------------------------------------------ SSE streaming
 
@@ -704,6 +862,140 @@ class EngineHTTPServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address[:2]
 
+    # ------------------------------------------------ handoff plumbing
+
+    def _fetch_handoff(self, desc: dict):
+        """Pull a handoff payload from its source pod, dedup against
+        tickets already imported here, and ack the import.  Returns
+        ``(payload, None)`` or ``(None, (status, error_body))`` — every
+        failure is a MARKED handoff error the router can act on (retry a
+        sibling decode host or re-prefill), never an empty success."""
+        tid, source = desc.get("ticket"), desc.get("source")
+        if not tid or not source:
+            return None, (400, {"error": {
+                "message": "handoff descriptor needs ticket + source",
+                "type": "handoff_error"}})
+        if self._imported.seen(tid):
+            self._c_dup_rejects.inc()
+            return None, (409, {"error": {
+                "message": f"duplicate handoff import of ticket {tid} "
+                           "(already imported on this host)",
+                "type": "handoff_error"}})
+        t0 = time.time()
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(source, timeout=30.0)
+            conn.request("GET", f"/v1/handoff/{tid}")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None, (502, {"error": {
+                    "message": f"handoff payload fetch from {source} "
+                               f"failed: HTTP {resp.status}",
+                    "type": "handoff_error"}})
+            chunks = []
+            first = True
+            while True:
+                chunk = resp.read(1 << 16)
+                if first:
+                    # injection site: a transfer fault MID-PAYLOAD — one
+                    # occurrence per import (plans count imports, not
+                    # chunks), fired after the first body read so part of
+                    # the page data has genuinely arrived; decode_payload
+                    # rejects the truncation and the import is a marked
+                    # failure
+                    first = False
+                    faults.fire("handoff.transfer", OSError)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+            payload = decode_payload(b"".join(chunks))
+        except Exception as e:  # noqa: BLE001 - marked handoff failure
+            return None, (502, {"error": {
+                "message": f"handoff transfer from {source} failed: "
+                           f"{type(e).__name__}: {e}",
+                "type": "handoff_error"}})
+        finally:
+            if conn is not None:
+                conn.close()
+        self._h_transfer.observe(time.time() - t0)
+        if not self._imported.add(tid):  # raced a concurrent duplicate
+            self._c_dup_rejects.inc()
+            return None, (409, {"error": {
+                "message": f"duplicate handoff import of ticket {tid}",
+                "type": "handoff_error"}})
+        self._send_ack(tid, source)
+        return payload, None
+
+    def _send_ack(self, tid: str, source: str) -> bool:
+        """Ack an import so the prefill pod releases its pinned pages.
+        Best-effort with one retry: a LOST ack is not a failure of the
+        request — the prefill side's orphan sweep reclaims the pages at
+        the ticket deadline (the crash-safety backstop this design leans
+        on), and the dedup log here keeps a re-delivered ticket from
+        double-importing."""
+        for attempt in range(2):
+            conn = None
+            try:
+                # injection site: the ack vanishes on the wire — pages
+                # stay pinned on the prefill pod until the orphan sweep
+                faults.fire("handoff.ack", OSError)
+                conn = http.client.HTTPConnection(source, timeout=5.0)
+                conn.request("POST", f"/v1/handoff/{tid}/ack")
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    return True
+                logger.warning("handoff ack for %s rejected: HTTP %d",
+                               tid, resp.status)
+                return False  # 410 = consumed/expired: retrying won't help
+            except Exception as e:  # noqa: BLE001 - retried once
+                logger.warning("handoff ack for %s failed (attempt %d): "
+                               "%s: %s", tid, attempt + 1,
+                               type(e).__name__, e)
+            finally:
+                if conn is not None:
+                    conn.close()
+            time.sleep(0.05 * (attempt + 1))
+        self._c_ack_failures.inc()
+        logger.warning("handoff ack for %s lost; prefill pages will be "
+                       "orphan-swept at the ticket deadline", tid)
+        return False
+
+    def sweep_handoffs(self, now: float | None = None) -> int:
+        """One orphan-sweep pass (the background sweeper's body; callable
+        directly with an explicit ``now`` from tests).  Expired un-acked
+        tickets release their pinned pages as orphans; the engine-side
+        TTL sweep backstops pins whose ticket was never minted."""
+        released = 0
+        release = getattr(self.engine, "release_handoff", None)
+        for tid, rid, consumed in self.handoff.sweep(now):
+            if not consumed and release is not None:
+                released += release(rid, orphaned=True)
+                logger.warning("handoff ticket %s expired un-acked; "
+                               "pinned pages reclaimed", tid)
+        sweep = getattr(self.engine, "sweep_handoffs", None)
+        if sweep is not None:
+            released += sweep(now)
+        return released
+
+    def _sweep_loop(self) -> None:
+        interval = max(0.5, self.handoff_ttl_s / 4.0)
+        while not self._sweep_stop.wait(interval):
+            try:
+                self.sweep_handoffs()
+            except Exception:  # noqa: BLE001 - the sweeper must survive
+                logger.exception("handoff orphan sweep failed")
+
+    def handoff_stats(self) -> dict:
+        return {
+            "role": self.role,
+            **self.handoff.stats(),
+            "tickets_published": int(self._c_tickets.value),
+            "acks": int(self._c_acks.value),
+            "duplicate_rejects": int(self._c_dup_rejects.value),
+            "ack_failures": int(self._c_ack_failures.value),
+        }
+
     def prometheus_text(self) -> str:
         """Prometheus exposition for ``GET /metrics`` with ``Accept:
         text/plain``: the engine's typed registry (optional Engine hooks —
@@ -735,6 +1027,7 @@ class EngineHTTPServer:
         g = http_reg.gauge("lmrs_uptime_seconds", "server uptime", "seconds")
         g.set(time.time() - self.started)
         parts.append(http_reg.render_prometheus())
+        parts.append(self._handoff_reg.render_prometheus())
         return merge_expositions(parts)
 
     def serve_forever(self) -> None:
@@ -748,6 +1041,7 @@ class EngineHTTPServer:
         return t
 
     def shutdown(self) -> None:
+        self._sweep_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
         self.batcher.shutdown()

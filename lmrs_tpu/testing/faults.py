@@ -27,6 +27,16 @@ site                        effect when fired
                             client gone — the cancel propagation path
 ``prefix_cache.insert``     exception inside radix-tree adoption — the
                             caching-is-an-optimization degrade path
+``handoff.export``          page-set capture at pin time fails — the
+                            request errors marked, the router re-prefills
+``handoff.transfer``        the prefill→decode payload read dies
+                            MID-PAYLOAD — truncation rejected, marked
+                            import failure, re-prefill fallback
+``handoff.import``          the decode-side page scatter (or mock state
+                            resume) fails — marked error, pool clean
+``handoff.ack``             the import ack vanishes on the wire — pages
+                            stay pinned until the orphan sweep; the
+                            dedup log rejects a re-delivered ticket
 =========================== =============================================
 
 Determinism: every site keeps an occurrence counter, and probabilistic

@@ -317,6 +317,176 @@ def test_deadline_mock_sheds_expired():
     assert res.finish_reason == "shed" and res.text == ""
 
 
+# ------------------------------------------- disaggregated handoff chaos
+
+
+@pytest.fixture(scope="module")
+def disagg_cluster():
+    """In-process prefill-role + decode-role EngineHTTPServers over REAL
+    jax continuous schedulers, behind a pool-aware router — the AUDITED
+    arm of the handoff chaos scenarios: every scenario ends with
+    ``scheduler.audit()`` clean on both pods (pinned-for-export pages
+    accounted, zero leaks, refcounts balanced) after the orphan sweep.
+    The cross-process mock arm lives in tests/test_handoff.py."""
+    from lmrs_tpu.serving.router import RouterEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    cfg = EngineConfig(backend="jax", scheduler="continuous", max_tokens=64,
+                       max_batch_slots=2, seed=0, decode_block=4,
+                       page_size=16, num_pages=48, handoff_ttl_s=30.0)
+    pre_eng = JaxEngine(cfg, chaos_model())
+    dec_eng = JaxEngine(cfg, chaos_model())
+    pre = EngineHTTPServer(pre_eng, port=0, role="prefill",
+                           handoff_ttl_s=30.0)
+    dec = EngineHTTPServer(dec_eng, port=0, role="decode",
+                           handoff_ttl_s=30.0)
+    pre.start_background()
+    dec.start_background()
+    router = RouterEngine([], prefill_hosts=[f"127.0.0.1:{pre.port}"],
+                          decode_hosts=[f"127.0.0.1:{dec.port}"])
+    # colocated greedy baseline over the SAME weights, computed with the
+    # fault plane disarmed (also proves a prefill-role pod serves plain
+    # requests to completion — the colocated-fallback invariant)
+    colo = RouterEngine([f"127.0.0.1:{pre.port}"])
+    assert faults.active() is None
+    baseline = colo.generate_batch([_handoff_req(0)])[0]
+    assert baseline.error is None and baseline.completion_tokens > 1
+    yield pre, dec, router, baseline.text
+    for r in (router, colo):
+        r.shutdown()
+    for s in (pre, dec):
+        s.shutdown()
+    pre_eng.shutdown()
+    dec_eng.shutdown()
+
+
+def _handoff_req(rid: int) -> GenerationRequest:
+    return GenerationRequest(
+        prompt="chaos handoff probe alpha bravo charlie delta echo",
+        request_id=rid, temperature=0.0, max_new_tokens=10)
+
+
+def _settle_and_audit(pre, dec) -> None:
+    """Close a scenario: orphan-sweep far past every ticket deadline,
+    then require both pods' auditors clean — no pinned-page leaks, page
+    conservation and refcounts balanced across the transaction."""
+    pre.sweep_handoffs(now=time.time() + 3600.0)
+    dec.sweep_handoffs(now=time.time() + 3600.0)
+    assert pre.engine._scheduler.pinned_handoffs() == {}
+    assert pre.engine._scheduler.audit() == []
+    assert dec.engine._scheduler.audit() == []
+
+
+def test_chaos_handoff_baseline_disagg_token_identical(disagg_cluster):
+    """Fault-free two-tier flow on the jax pods: token-identical to the
+    colocated baseline, pin released by the ack, auditors clean."""
+    pre, dec, router, want = disagg_cluster
+    res = router.generate_batch([_handoff_req(1)])[0]
+    assert res.error is None and res.text == want
+    assert router._handoffs >= 1
+    assert pre.engine._scheduler.pinned_handoffs() == {}  # acked
+    _settle_and_audit(pre, dec)
+
+
+def test_chaos_handoff_transfer_fault_mid_payload(disagg_cluster):
+    """Transfer dies mid-payload: marked import failure, router re-prefills
+    colocated, request completes identically; the un-acked ticket's pages
+    come back through the orphan sweep."""
+    pre, dec, router, want = disagg_cluster
+    orphaned_before = pre.engine._scheduler.metrics["handoff_orphaned_pages"]
+    fallbacks = router._handoff_fallbacks
+    with faults.injected(FaultPlan(seed=41, faults=[
+            {"site": "handoff.transfer", "at": [1], "max_fires": 1}])):
+        res = router.generate_batch([_handoff_req(2)])[0]
+    assert res.error is None and res.text == want
+    assert router._handoff_fallbacks == fallbacks + 1
+    assert pre.engine._scheduler.pinned_handoffs() != {}  # never acked
+    _settle_and_audit(pre, dec)
+    assert (pre.engine._scheduler.metrics["handoff_orphaned_pages"]
+            > orphaned_before)
+
+
+def test_chaos_handoff_decode_pod_down_after_export(disagg_cluster):
+    """The decode pod dies between export and import (connect fault on
+    the decode leg — occurrence 2: the prefill leg was 1): the router
+    re-prefills on a surviving host and the request completes; the
+    pinned pages orphan-sweep."""
+    pre, dec, router, want = disagg_cluster
+    fallbacks = router._handoff_fallbacks
+    with faults.injected(FaultPlan(seed=43, faults=[
+            {"site": "router.connect", "at": [2], "max_fires": 1}])):
+        res = router.generate_batch([_handoff_req(3)])[0]
+    assert res.error is None and res.text == want
+    assert router._handoff_fallbacks == fallbacks + 1
+    _settle_and_audit(pre, dec)
+    # the connect fault marked the decode host down; let the next wave's
+    # probe re-admit it so later scenarios still disaggregate
+    for h in router.hosts:
+        h.healthy = True
+
+
+def test_chaos_handoff_ack_lost_duplicate_import(disagg_cluster):
+    """Both ack attempts vanish: the request still completes (acks are
+    best-effort; the orphan sweep is the backstop), the pages stay pinned,
+    and RE-DELIVERING the same ticket to the decode pod is idempotently
+    rejected (409) instead of double-importing."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    pre, dec, router, want = disagg_cluster
+    with faults.injected(FaultPlan(seed=47, faults=[
+            {"site": "handoff.ack", "every": 1, "max_fires": 2}])):
+        res = router.generate_batch([_handoff_req(4)])[0]
+    assert res.error is None and res.text == want
+    pinned = pre.engine._scheduler.pinned_handoffs()
+    assert pinned, "lost ack must leave the export pinned"
+    # the live (un-consumed) ticket: re-deliver it to the decode pod
+    tid = next(t for t, r in pre.handoff._tickets.items()
+               if not r["consumed"])
+    body = _json.dumps({
+        "messages": [{"role": "user", "content": _handoff_req(4).prompt}],
+        "max_tokens": 10, "temperature": 0.0,
+        "handoff": {"ticket": tid,
+                    "source": f"127.0.0.1:{pre.port}"}}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{dec.port}/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 409
+    _settle_and_audit(pre, dec)
+
+
+def test_chaos_handoff_ticket_expiry_orphan_sweep(disagg_cluster):
+    """A ticket published but never followed (the router died between
+    legs): the orphan sweep reclaims the pinned pages at the deadline and
+    later fetches answer 410 Gone."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    pre, dec, _router, _want = disagg_cluster
+    body = _json.dumps({
+        "messages": [{"role": "user", "content": _handoff_req(5).prompt}],
+        "max_tokens": 10, "temperature": 0.0, "handoff": True}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{pre.port}/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        data = _json.loads(r.read())
+    assert data["object"] == "handoff.ticket"
+    tid = data["handoff"]["ticket"]
+    assert pre.engine._scheduler.pinned_handoffs()
+    released = pre.sweep_handoffs(now=time.time() + 3600.0)
+    assert released >= 1
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{pre.port}/v1/handoff/{tid}", timeout=10)
+    assert ei.value.code == 410
+    _settle_and_audit(pre, dec)
+
+
 # ------------------------------------------------- auditor negative cases
 
 
