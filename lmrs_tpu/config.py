@@ -291,6 +291,33 @@ class ReduceConfig:
 
 
 @dataclass
+class JobsConfig:
+    """Durable-job knobs (lmrs_tpu/jobs/: write-ahead journal + async job
+    API — docs/ROBUSTNESS.md job-durability section).
+
+    ``jobs_dir`` empty = the job API is disabled (lmrs-serve answers 501;
+    batch pipeline runs are unaffected).  ``max_failed_chunk_fraction``
+    is the degraded-completion policy: a job whose failed-chunk fraction
+    stays at or under it finishes ``status="degraded"`` with the
+    per-chunk ``degraded_reason``s attached instead of all-or-nothing
+    failure; above it the job is ``status="failed"`` (the summary —
+    degrade-and-continue output — is still attached either way).
+    """
+
+    jobs_dir: str = field(default_factory=lambda: _env("LMRS_JOBS_DIR", ""))
+    max_failed_chunk_fraction: float = field(
+        default_factory=lambda: _env("LMRS_JOBS_DEGRADED_FRACTION", 0.2,
+                                     float))
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.max_failed_chunk_fraction <= 1.0:
+            raise ValueError(
+                f"max_failed_chunk_fraction must be in [0, 1] "
+                f"(got {self.max_failed_chunk_fraction}); 0 = any failed "
+                "chunk fails the job, 1 = always finish degraded")
+
+
+@dataclass
 class PipelineConfig:
     """Top-level config: one object wires the whole pipeline."""
 
@@ -300,6 +327,7 @@ class PipelineConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     reduce: ReduceConfig = field(default_factory=ReduceConfig)
+    jobs: JobsConfig = field(default_factory=JobsConfig)
 
     def replace(self, **kw: Any) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

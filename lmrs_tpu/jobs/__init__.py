@@ -1,0 +1,28 @@
+"""Durable summarization jobs: write-ahead journal + crash-safe resume.
+
+``journal`` — CRC-framed, fsync'd JSONL WAL (torn-tail-tolerant replay,
+content-addressed job ids / reduce-node keys, config fingerprints).
+``manager`` — ``JobManager``: queued execution, per-chunk + per-node
+journaling, startup recovery, degraded completion, ``lmrs_jobs_*``
+metrics.  Serving surface: ``POST/GET/DELETE /v1/jobs`` on lmrs-serve
+(serving/server.py) with sticky router forwarding (serving/router.py).
+See docs/ROBUSTNESS.md § Durable jobs.
+"""
+
+from lmrs_tpu.jobs.journal import (
+    Journal,
+    canonical_json,
+    chunk_key,
+    config_fingerprint,
+    job_id_for,
+    node_key,
+    rebuild_state,
+    replay,
+)
+from lmrs_tpu.jobs.manager import Job, JobManager, TERMINAL_STATES
+
+__all__ = [
+    "Journal", "canonical_json", "chunk_key", "config_fingerprint",
+    "job_id_for", "node_key", "rebuild_state", "replay",
+    "Job", "JobManager", "TERMINAL_STATES",
+]

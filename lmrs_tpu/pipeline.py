@@ -43,6 +43,49 @@ from lmrs_tpu.utils.timing import StageTimer, format_duration
 logger = logging.getLogger("lmrs.pipeline")
 
 
+def prepare_segments(config: PipelineConfig,
+                     transcript_data: dict[str, Any]) -> tuple[int, list]:
+    """Stages 1-2 (limit → preprocess), shared by the batch pipeline and
+    the durable-job path (jobs/manager.py): the job token-identity
+    contract depends on both paths preparing segments IDENTICALLY, so
+    there is exactly one implementation.  Returns
+    ``(n_input_segments, processed_segments)``."""
+    segments = transcript_data.get("segments", [])
+    if config.data.limit_segments:
+        segments = segments[: config.data.limit_segments]
+    processed = preprocess_transcript(
+        segments,
+        merge_same_speaker=config.data.merge_same_speaker,
+        time_interval_seconds=config.data.time_interval_seconds,
+        max_segment_duration=config.data.max_segment_duration,
+        preserve_timestamps=config.data.preserve_timestamps,
+    )
+    return len(segments), processed
+
+
+def build_chunker(config: PipelineConfig, engine: Any = None,
+                  max_tokens_per_chunk: int | None = None
+                  ) -> TranscriptChunker:
+    """The one place a chunker is built from config, shared with the
+    durable-job path.  With an ``engine``, a default ("approx") chunk
+    tokenizer upgrades to the serving model's tokenizer (SURVEY.md §7.4
+    item 4: token-count authority is the serving model); pass
+    ``engine=None`` for purely config-deterministic chunking (the job
+    journal's chunk-identity keys depend on it)."""
+    tokenizer = config.chunk.tokenizer
+    if tokenizer == "approx" and engine is not None:
+        engine_tok = getattr(engine, "tokenizer", None)
+        if engine_tok is not None:
+            tokenizer = engine_tok
+    return TranscriptChunker(
+        max_tokens_per_chunk=(max_tokens_per_chunk
+                              or config.chunk.max_tokens_per_chunk),
+        overlap_tokens=config.chunk.overlap_tokens,
+        context_tokens=config.chunk.context_tokens,
+        tokenizer=tokenizer,
+    )
+
+
 class TranscriptSummarizer:
     """End-to-end map-reduce transcript summarizer.
 
@@ -98,22 +141,7 @@ class TranscriptSummarizer:
     @property
     def chunker(self) -> TranscriptChunker:
         if self._chunker is None:
-            # Token-count authority is the SERVING MODEL's tokenizer
-            # (SURVEY.md §7.4 item 4): when the chunker tokenizer is left at
-            # its default and the engine has a real tokenizer, use that one —
-            # otherwise chunk budgets (approx ~4 chars/tok) and engine limits
-            # (e.g. byte-level) disagree by ~4x and chunks get truncated.
-            tokenizer = self.config.chunk.tokenizer
-            if tokenizer == "approx":
-                engine_tok = getattr(self.executor.engine, "tokenizer", None)
-                if engine_tok is not None:
-                    tokenizer = engine_tok
-            self._chunker = TranscriptChunker(
-                max_tokens_per_chunk=self.config.chunk.max_tokens_per_chunk,
-                overlap_tokens=self.config.chunk.overlap_tokens,
-                context_tokens=self.config.chunk.context_tokens,
-                tokenizer=tokenizer,
-            )
+            self._chunker = build_chunker(self.config, self.executor.engine)
         return self._chunker
 
     @property
@@ -126,23 +154,38 @@ class TranscriptSummarizer:
 
     # ------------------------------------------------------------------ API
 
+    def _map_fingerprint(self, map_prompt: str, sys_prompt: str | None,
+                         summary_type: str) -> str:
+        """Hash of the (prompt, model, chunking) surface that determines
+        what a chunk summary MEANS — stamped into ``--save-chunks`` dumps
+        and validated on ``resume_from`` (jobs/journal.py applies the same
+        idea to job journals): rehydrating summaries produced under a
+        different map prompt or model would silently mix stale content
+        into a fresh run."""
+        from lmrs_tpu.jobs.journal import config_fingerprint
+
+        e, c = self.config.engine, self.config.chunk
+        return config_fingerprint(
+            map_prompt=map_prompt,
+            system_prompt=sys_prompt or "",
+            summary_type=summary_type,
+            backend=e.backend, model=e.model, temperature=e.temperature,
+            max_tokens=e.max_tokens, seed=e.seed,
+            max_tokens_per_chunk=c.max_tokens_per_chunk,
+            overlap_tokens=c.overlap_tokens,
+            context_tokens=c.context_tokens,
+            tokenizer=str(c.tokenizer))
+
     def _prep(self, transcript_data: dict[str, Any], timer: StageTimer):
-        """Shared stages 1-3: limit → preprocess → chunk.
+        """Shared stages 1-3: limit → preprocess → chunk
+        (``prepare_segments`` — one implementation with the job path).
         Returns (n_input_segments, processed_segments, chunks)."""
-        segments = transcript_data.get("segments", [])
-        if self.config.data.limit_segments:
-            segments = segments[: self.config.data.limit_segments]
         with timer.stage("preprocess"):
-            processed = preprocess_transcript(
-                segments,
-                merge_same_speaker=self.config.data.merge_same_speaker,
-                time_interval_seconds=self.config.data.time_interval_seconds,
-                max_segment_duration=self.config.data.max_segment_duration,
-                preserve_timestamps=self.config.data.preserve_timestamps,
-            )
+            n_input, processed = prepare_segments(self.config,
+                                                  transcript_data)
         with timer.stage("chunk"):
             chunks = self.chunker.chunk_transcript(processed)
-        return len(segments), processed, chunks
+        return n_input, processed, chunks
 
     def summarize(
         self,
@@ -168,11 +211,13 @@ class TranscriptSummarizer:
 
         map_prompt = resolve_map_prompt(prompt_template, prompt_file)
         sys_prompt = resolve_system_prompt(system_prompt, system_prompt_file)
+        fingerprint = self._map_fingerprint(map_prompt, sys_prompt, summary_type)
 
         resumed = 0
         todo = chunks
         if resume_from:
-            resumed_chunks, todo = _load_resume(resume_from, chunks)
+            resumed_chunks, todo = _load_resume(resume_from, chunks,
+                                                fingerprint=fingerprint)
             resumed = len(resumed_chunks)
 
         reduce_prompt = resolve_reduce_prompt(aggregator_prompt, aggregator_prompt_file)
@@ -192,7 +237,8 @@ class TranscriptSummarizer:
             # path's between-stage dump: an interrupt during the reduce
             # tail must still leave a resumable artifact
             on_map_complete = (
-                (lambda cs: _dump_chunks(save_chunks, list(cs)))
+                (lambda cs: _dump_chunks(save_chunks, list(cs),
+                                         fingerprint=fingerprint))
                 if save_chunks else None)
             agg = smr.run(chunks, map_prompt, summary_type, sys_prompt,
                           reduce_prompt, metadata,
@@ -208,7 +254,8 @@ class TranscriptSummarizer:
                                                  sys_prompt)
             processed_chunks = sorted(chunks, key=lambda c: c.chunk_index)
             if save_chunks:
-                _dump_chunks(save_chunks, processed_chunks)
+                _dump_chunks(save_chunks, processed_chunks,
+                             fingerprint=fingerprint)
             with timer.stage("reduce"):
                 agg = self.aggregator.aggregate(processed_chunks, reduce_prompt,
                                                 metadata)
@@ -247,6 +294,7 @@ class TranscriptSummarizer:
         aggregator_prompt: str | None = None,
         aggregator_prompt_file: str | None = None,
         summary_type: str = "summary",
+        resume_from: list[str | None] | None = None,
     ) -> list[dict[str, Any]]:
         """Summarize several transcripts through ONE pooled map queue
         (BASELINE config #5: multi-transcript batching).
@@ -255,6 +303,12 @@ class TranscriptSummarizer:
         one transcript's decode tail overlaps the next one's prefill instead
         of draining between transcripts; each transcript then gets its own
         reduce tree and stats dict (same shape as ``summarize``'s).
+
+        ``resume_from`` aligns with ``transcripts``: entry i (None = no
+        resume) names a prior ``--save-chunks`` dump for transcript i,
+        fingerprint-validated like the single-transcript path; only
+        un-resumed chunks enter the pooled map queue, and each result's
+        ``num_resumed_chunks`` reports its transcript's REAL count.
         """
         timer = StageTimer(profile=self.profile)
         t_start = time.time()
@@ -264,13 +318,32 @@ class TranscriptSummarizer:
 
         prepped = [self._prep(data, timer) for data in transcripts]
 
+        resumed_counts = [0] * len(prepped)
+        if resume_from:
+            if len(resume_from) != len(transcripts):
+                raise ValueError(
+                    f"resume_from has {len(resume_from)} entries for "
+                    f"{len(transcripts)} transcripts (use None for "
+                    "transcripts without a dump)")
+            fingerprint = self._map_fingerprint(map_prompt, sys_prompt,
+                                                summary_type)
+            for i, (path, (_n, _p, chunks)) in enumerate(
+                    zip(resume_from, prepped)):
+                if path:
+                    resumed_chunks, _todo = _load_resume(
+                        path, chunks, fingerprint=fingerprint)
+                    resumed_counts[i] = len(resumed_chunks)
+
         with timer.stage("map"):
             self.executor.process_chunk_groups(
-                [chunks for _, _, chunks in prepped], map_prompt, summary_type,
-                sys_prompt)
+                # only un-resumed chunks enter the pooled queue (rehydrated
+                # summaries must not be recomputed — or overwritten)
+                [[c for c in chunks if c.summary is None]
+                 for _, _, chunks in prepped],
+                map_prompt, summary_type, sys_prompt)
 
         out = []
-        for n_input, processed, chunks in prepped:
+        for i, (n_input, processed, chunks) in enumerate(prepped):
             ordered = sorted(chunks, key=lambda c: c.chunk_index)
             duration = get_transcript_duration(processed)
             speakers = extract_speakers(processed)
@@ -286,7 +359,7 @@ class TranscriptSummarizer:
                 "num_input_segments": n_input,
                 "num_segments": len(processed),
                 "num_chunks": len(ordered),
-                "num_resumed_chunks": 0,
+                "num_resumed_chunks": resumed_counts[i],
                 "transcript_duration": duration,
                 "transcript_duration_str": format_duration(duration),
                 "speakers": speakers,
@@ -320,10 +393,15 @@ class TranscriptSummarizer:
 # ---------------------------------------------------------------- artifacts
 
 
-def _dump_chunks(path: str, chunks: list[Chunk]) -> None:
-    """Intermediate chunk-summary dump (main.py:178-201; README.md:145-158)."""
+def _dump_chunks(path: str, chunks: list[Chunk],
+                 fingerprint: str | None = None) -> None:
+    """Intermediate chunk-summary dump (main.py:178-201; README.md:145-158).
+    ``fingerprint`` (the map-surface hash, ``_map_fingerprint``) is stamped
+    into the payload so a later ``resume_from`` can refuse summaries
+    produced under a different prompt/model/chunking surface."""
     payload = {
         "timestamp": time.time(),
+        "fingerprint": fingerprint,
         "chunks": [
             {
                 "chunk_index": c.chunk_index,
@@ -343,13 +421,29 @@ def _dump_chunks(path: str, chunks: list[Chunk]) -> None:
         logger.error("could not save chunks to %s: %s", path, e)
 
 
-def _load_resume(path: str, chunks: list[Chunk]) -> tuple[list[Chunk], list[Chunk]]:
+def _load_resume(path: str, chunks: list[Chunk],
+                 fingerprint: str | None = None) -> tuple[list[Chunk], list[Chunk]]:
     """Rehydrate summaries from a prior --save-chunks dump; returns
-    (resumed, still_todo).  Chunks match on (chunk_index, start, end)."""
+    (resumed, still_todo).  Chunks match on (chunk_index, start, end).
+
+    When both the dump and the caller carry a config/prompt fingerprint
+    and they disagree, NOTHING is rehydrated (warn + drop): the dump was
+    produced under a different map prompt / model / chunking surface, and
+    mixing its summaries into this run would silently corrupt the final
+    summary.  Dumps predating the fingerprint field still load (their
+    chunk-identity match is the only guard, as before)."""
     try:
         payload = json.loads(Path(path).read_text(encoding="utf-8"))
     except (OSError, json.JSONDecodeError) as e:
         logger.error("could not resume from %s: %s", path, e)
+        return [], chunks
+    saved_fp = payload.get("fingerprint")
+    if fingerprint and saved_fp and saved_fp != fingerprint:
+        logger.warning(
+            "resume dump %s was produced under config/prompt fingerprint %s "
+            "!= this run's %s; dropping its summaries (a different map "
+            "prompt, model, or chunking surface would mix stale content "
+            "into this run)", path, saved_fp, fingerprint)
         return [], chunks
     saved = {
         (d["chunk_index"], round(d["start_time"], 3), round(d["end_time"], 3)): d

@@ -53,6 +53,7 @@ class ResultAggregator:
         self.executor = executor
         self.config = config or ReduceConfig()
         self.tokenizer = get_tokenizer(tokenizer) if isinstance(tokenizer, str) else tokenizer
+        self._wave_errors = 0  # error-marker nodes, reset per aggregate()
 
     # ------------------------------------------------------------------ API
 
@@ -61,14 +62,27 @@ class ResultAggregator:
         processed_chunks: Sequence[Chunk],
         prompt_template: str | None = None,
         metadata: dict[str, Any] | None = None,
+        node_cache: Any | None = None,
     ) -> dict[str, Any]:
         """Reduce chunk summaries to one final summary.
 
         Mirrors ``ResultAggregator.aggregate`` (result_aggregator.py:55-109):
         time-tags each summary, then picks single-pass vs hierarchical by
         total token count against ``max_tokens_per_batch``.
+
+        ``node_cache`` is the crash-safe resume hook (lmrs_tpu/jobs/):
+        an object with ``lookup(node_id, summaries, template, metadata)
+        -> str | None`` and ``record(node_id, summaries, template,
+        metadata, text)``.  Every reduce-tree node gets a DETERMINISTIC
+        id (``L<level>.B<batch>`` / ``final``) and is offered to the
+        cache before the engine runs it; chunking and the tree shape are
+        deterministic in (transcript, config), so a resumed run
+        recomputes the same node inputs and lands exactly on the
+        journaled nodes — a crash mid-reduce resumes at the tree node it
+        died at, not at the start of the stage.
         """
         t0 = time.time()
+        self._wave_errors = 0
         chunks = sorted(processed_chunks, key=lambda c: c.chunk_index)
         summaries = [
             f"[Time: {format_timestamp(c.start_time)} - {format_timestamp(c.end_time)}]\n"
@@ -84,11 +98,13 @@ class ResultAggregator:
             len(summaries), total_tokens, "hierarchical" if hierarchical else "single-pass",
         )
         if hierarchical:
-            summary, levels = self._hierarchical(summaries, prompt_template, metadata)
+            summary, levels = self._hierarchical(summaries, prompt_template,
+                                                 metadata, node_cache)
         else:
             t_level = time.time()
             summary = self._reduce_once(
-                summaries, prompt_template or DEFAULT_REDUCE_PROMPT, metadata
+                summaries, prompt_template or DEFAULT_REDUCE_PROMPT, metadata,
+                node_cache, node_id="final",
             )
             self._trace_level(1, 1, t_level)
             levels = 1
@@ -98,6 +114,12 @@ class ResultAggregator:
             "hierarchical": hierarchical,
             "levels": levels,
             "aggregation_time": time.time() - t0,
+            # degrade-and-continue accounting: reduce nodes that fell back
+            # to error markers this call, and whether the FINAL summary is
+            # itself one (this class owns the marker format — consumers
+            # branch on these instead of string-matching)
+            "reduce_errors": self._wave_errors,
+            "final_error": summary.startswith("[Error aggregating summaries:"),
         }
 
     # ------------------------------------------------------------ internals
@@ -136,38 +158,66 @@ class ResultAggregator:
 
     def _reduce_wave(
         self,
-        jobs: list[tuple[list[str], str, dict[str, Any] | None]],
+        jobs: list[tuple[str, list[str], str, dict[str, Any] | None]],
+        node_cache: Any | None = None,
     ) -> list[str]:
         """Run one level's reduce calls as a SINGLE engine wave — the
         reference fans batches out concurrently (asyncio.create_task +
         gather, result_aggregator.py:326-342); here they fill the batch
-        slots together instead of serializing one round trip per batch."""
+        slots together instead of serializing one round trip per batch.
+
+        ``jobs`` entries are ``(node_id, summaries, template, metadata)``.
+        With a ``node_cache``, journaled nodes are answered from the cache
+        and only the misses form the engine wave; freshly computed nodes
+        are recorded as they land (error-marker results are NOT recorded —
+        a resumed run must retry them, not rehydrate the failure)."""
+        out: list[str | None] = [None] * len(jobs)
+        misses: list[int] = []
+        for i, (node_id, summaries, template, metadata) in enumerate(jobs):
+            if node_cache is not None:
+                text = node_cache.lookup(node_id, summaries, template, metadata)
+                if text is not None:
+                    out[i] = text
+                    continue
+            misses.append(i)
         requests = [
-            self._build_request(summaries, template, metadata, request_id=i)
-            for i, (summaries, template, metadata) in enumerate(jobs)
+            self._build_request(jobs[i][1], jobs[i][2], jobs[i][3],
+                                request_id=k)
+            for k, i in enumerate(misses)
         ]
-        results = self.executor.run_requests(requests)
-        # degrade to an error string, never raise
-        # (result_aggregator.py:256-259,284-286)
-        return [
-            res.text if degraded_reason(res) is None
-            else f"[Error aggregating summaries: {degraded_reason(res)}]"
-            for res in results
-        ]
+        results = self.executor.run_requests(requests) if requests else []
+        for i, res in zip(misses, results):
+            node_id, summaries, template, metadata = jobs[i]
+            reason = degraded_reason(res)
+            # degrade to an error string, never raise
+            # (result_aggregator.py:256-259,284-286)
+            if reason is None:
+                out[i] = res.text
+                if node_cache is not None:
+                    node_cache.record(node_id, summaries, template, metadata,
+                                      res.text)
+            else:
+                out[i] = f"[Error aggregating summaries: {reason}]"
+                self._wave_errors += 1
+        return out  # type: ignore[return-value]
 
     def _reduce_once(
         self,
         summaries: list[str],
         template: str,
         metadata: dict[str, Any] | None,
+        node_cache: Any | None = None,
+        node_id: str = "final",
     ) -> str:
-        return self._reduce_wave([(summaries, template, metadata)])[0]
+        return self._reduce_wave([(node_id, summaries, template, metadata)],
+                                 node_cache)[0]
 
     def _hierarchical(
         self,
         summaries: list[str],
         prompt_template: str | None,
         metadata: dict[str, Any] | None,
+        node_cache: Any | None = None,
     ) -> tuple[str, int]:
         """Recursive batch tree (reference _hierarchical_aggregation,
         result_aggregator.py:288-355, generalized past two levels)."""
@@ -196,16 +246,18 @@ class ResultAggregator:
                     {"batch": f"{i + 1}/{n}", "position": f"{lo:.0f}%-{hi:.0f}% of the transcript"}
                 )
                 jobs.append(
-                    (batch, prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, batch_meta)
+                    (f"L{level}.B{i}", batch,
+                     prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, batch_meta)
                 )
             t_level = time.time()
-            current = self._reduce_wave(jobs)
+            current = self._reduce_wave(jobs, node_cache)
             self._trace_level(level, len(batches), t_level)
         if len(current) == 1:
             return current[0], level
         t_final = time.time()
         final = self._reduce_once(
-            current, prompt_template or DEFAULT_FINAL_REDUCE_PROMPT, metadata
+            current, prompt_template or DEFAULT_FINAL_REDUCE_PROMPT, metadata,
+            node_cache, node_id=f"L{level + 1}.final",
         )
         self._trace_level(level + 1, 1, t_final)
         return final, level + 1

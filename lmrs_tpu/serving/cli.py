@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds an un-acked handoff ticket pins its KV "
                         "pages before the orphan sweep reclaims them "
                         "(default: LMRS_HANDOFF_TTL or 60)")
+    p.add_argument("--jobs-dir", default=None,
+                   help="enable the durable async job API (POST/GET/DELETE "
+                        "/v1/jobs): write-ahead journals live here and "
+                        "interrupted jobs resume on startup (default: "
+                        "LMRS_JOBS_DIR; unset disables — 501)")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
@@ -91,11 +96,17 @@ def main(argv: list[str] | None = None) -> int:
     from lmrs_tpu.serving.server import EngineHTTPServer
 
     try:
+        from lmrs_tpu.config import PipelineConfig
+
         server = EngineHTTPServer(
             engine, host=args.host, port=args.port, model_name=args.model,
             max_tokens_cap=args.max_tokens_cap,
             batch_window_s=args.batch_window_ms / 1000.0,
             role=args.role, handoff_ttl_s=engine_cfg.handoff_ttl_s,
+            jobs_dir=args.jobs_dir,
+            # the job fingerprint must reflect the SERVED model/config,
+            # not PipelineConfig defaults
+            pipeline_config=PipelineConfig(engine=engine_cfg),
         )
     except OSError as e:
         logger.error("cannot bind %s:%d: %s", args.host, args.port, e)

@@ -36,6 +36,7 @@ Design choices:
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
 import logging
@@ -161,6 +162,17 @@ class RouterEngine:
         self._handoff_retries = 0   # failed decode-leg attempts
         self._handoff_fallbacks = 0  # disagg flows degraded to colocated
         self._stats_lock = threading.Lock()
+        # Durable-job forwarding (docs/ROBUSTNESS.md § Durable jobs): the
+        # front server calls job_request() for /v1/jobs traffic; jobs
+        # stick to the backend whose journal holds them.  The map is a
+        # CACHE, not the truth — a router restart rebuilds it by scanning
+        # the fleet on the first GET/DELETE of an unknown id — so it is
+        # bounded (oldest-pinned evicted; an evicted id just re-scans),
+        # same pattern as the handoff ImportLog.
+        self._job_hosts: dict[str, str] = {}   # job id -> netloc
+        self._job_hosts_max = 4096
+        self._job_lock = threading.Lock()
+        self._jobs_forwarded = 0
         # per-recv socket timeout: must exceed the worst-case SILENT wait —
         # a non-streamed generation sends nothing until it completes
         self.timeout_s = timeout_s
@@ -372,8 +384,150 @@ class RouterEngine:
         hreg.counter("lmrs_handoff_fallbacks_total",
                      "handoff flows degraded to colocated re-prefill"
                      ).inc(self._handoff_fallbacks)
+        hreg.counter("lmrs_router_jobs_forwarded_total",
+                     "durable-job API calls forwarded to backends"
+                     ).inc(self._jobs_forwarded)
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
+
+    # ------------------------------------------------------- job forwarding
+
+    def job_request(self, method: str, path: str,
+                    body: dict | None) -> tuple[int, dict]:
+        """Forward one /v1/jobs call to the backend fleet (the front
+        server's ``_job_http`` delegates here when it has no local
+        JobManager).  Placement is STICKY: a submit hashes its transcript
+        onto the host ring — so a duplicate POST (client retry after a
+        crash) lands on the same backend and converges on the same
+        content-addressed journal — and the returned job id pins follow-up
+        GET/DELETE traffic to that host.  Unknown ids scan the fleet
+        (rebuilding the stickiness cache after a router restart: the
+        journals live with the backends, not here)."""
+        with self._stats_lock:
+            self._jobs_forwarded += 1
+        if method == "POST":
+            digest = int(hashlib.sha256(
+                json.dumps(body or {}, sort_keys=True).encode()
+            ).hexdigest(), 16)
+            ring = sorted(self.hosts, key=lambda h: h.netloc)
+            start = digest % len(ring)
+            last: tuple[int, dict] = (503, {"error": {
+                "message": "no backend accepted the job",
+                "type": "job_error"}})
+            for k in range(len(ring)):
+                host = ring[(start + k) % len(ring)]
+                if not host.healthy and k < len(ring) - 1:
+                    continue  # same optimism as _targets: try someone
+                try:
+                    status, payload = self._job_call(host, method, path, body)
+                except Exception as e:  # noqa: BLE001 - next host
+                    host.failed += 1
+                    last = (502, {"error": {
+                        "message": f"{host.netloc}: {type(e).__name__}: {e}",
+                        "type": "job_error"}})
+                    continue
+                if status == 501:  # backend has no jobs_dir: keep looking
+                    last = (status, payload)
+                    continue
+                jid = payload.get("id") if isinstance(payload, dict) else None
+                if jid:
+                    self._pin_job(jid, host.netloc)
+                return status, payload
+            return last
+        # Fleet scans run CONCURRENTLY on the dispatch pool: sequential
+        # probing would hold the HTTP handler thread one connect timeout
+        # per partitioned host (connect HANGS rather than refuses there);
+        # gathered, the whole scan is bounded by the slowest single host.
+        if method == "GET" and path.rstrip("/") == "/v1/jobs":
+            futures = [self._pool.submit(self._job_call_safe, h, method,
+                                         path, None)
+                       for h in self.hosts]
+            data: list = []
+            errors = 0
+            for host, fut in zip(self.hosts, futures):
+                status, payload = fut.result()
+                if status == 200:
+                    for doc in payload.get("data", []):
+                        if doc.get("id"):
+                            self._pin_job(doc["id"], host.netloc)
+                        data.append(doc)
+                elif status == 502:
+                    errors += 1
+            return 200, {"object": "list", "data": data,
+                         "hosts_unreachable": errors}
+        # GET/DELETE /v1/jobs/<id>: sticky host alone first (the common
+        # case pays no fleet cost), then a concurrent fleet scan —
+        # rebuilding stickiness after a router restart
+        jid = path.split("/v1/jobs/", 1)[-1].strip("/")
+        with self._job_lock:
+            pinned = self._job_hosts.get(jid)
+        if pinned is not None:
+            host = next((h for h in self.hosts if h.netloc == pinned), None)
+            if host is not None:
+                status, payload = self._job_call_safe(host, method, path,
+                                                      None)
+                if status not in (404, 501, 502):
+                    return status, payload
+        ordered = sorted(self.hosts,
+                         key=lambda h: (not h.healthy, h.netloc))
+        futures = [self._pool.submit(self._job_call_safe, h, method, path,
+                                     None)
+                   for h in ordered]
+        results = [f.result() for f in futures]
+        last = (404, {"error": {"message": f"no job {jid} on any backend",
+                                "type": "job_error"}})
+        for host, (status, payload) in zip(ordered, results):
+            if status in (404, 501):
+                continue
+            if status == 502:
+                last = (status, payload)
+                continue
+            self._pin_job(jid, host.netloc)
+            return status, payload
+        return last
+
+    def _job_call_safe(self, host: _Host, method: str, path: str,
+                       body: dict | None) -> tuple[int, dict]:
+        """_job_call with exceptions folded into a 502 tuple (scan legs
+        run on the pool; a raise there would surface at .result())."""
+        try:
+            return self._job_call(host, method, path, body)
+        except Exception as e:  # noqa: BLE001 - aggregate what answers
+            return 502, {"error": {
+                "message": f"{host.netloc}: {type(e).__name__}: {e}",
+                "type": "job_error"}}
+
+    def _pin_job(self, jid: str, netloc: str) -> None:
+        """Record job->host stickiness, bounded: oldest pins evict past
+        ``_job_hosts_max`` (an evicted id just pays one fleet re-scan)."""
+        with self._job_lock:
+            self._job_hosts[jid] = netloc
+            while len(self._job_hosts) > self._job_hosts_max:
+                self._job_hosts.pop(next(iter(self._job_hosts)))
+
+    def _job_call(self, host: _Host, method: str, path: str,
+                  body: dict | None) -> tuple[int, dict]:
+        """One forwarded job call.  A bare connection on purpose (like
+        probes): the control plane must not consume the request path's
+        ``router.connect`` fault occurrences — chaos plans stay replayable.
+        Short fixed timeout: job calls are control-plane (submit returns
+        immediately, GET is a status read) — a sequential fleet scan must
+        not hold an HTTP handler thread 30 s per partitioned host."""
+        conn = http.client.HTTPConnection(host.netloc, timeout=10.0)
+        try:
+            conn.request(method, path,
+                         body=None if body is None else json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                payload = json.loads(raw) if raw else {}
+            except ValueError:
+                payload = {"error": {"message": raw.decode("utf-8",
+                                                           "replace")[:200]}}
+            return resp.status, payload
+        finally:
+            conn.close()
 
     # ------------------------------------------------------------ internals
 

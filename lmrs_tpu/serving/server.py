@@ -47,9 +47,11 @@ logger = logging.getLogger("lmrs.serving")
 
 
 class _Job:
-    __slots__ = ("request", "result", "event", "deltas", "rid", "cancelled")
+    __slots__ = ("request", "result", "event", "deltas", "rid", "cancelled",
+                 "done_cb")
 
-    def __init__(self, request: GenerationRequest, stream: bool = False):
+    def __init__(self, request: GenerationRequest, stream: bool = False,
+                 done_cb=None):
         self.request = request
         self.result: GenerationResult | None = None
         self.event = threading.Event()
@@ -60,6 +62,14 @@ class _Job:
             queue.Queue() if stream else None)
         self.rid: int | None = None  # wave-relative id, set by the dispatcher
         self.cancelled = False  # set by _Batcher.cancel (handler threads)
+        # fired right after ``event`` (completion fan-in: _BatcherEngine
+        # waits on ONE shared event for a whole set of jobs)
+        self.done_cb = done_cb
+
+    def done(self) -> None:
+        self.event.set()
+        if self.done_cb is not None:
+            self.done_cb()
 
 
 class _Batcher:
@@ -134,8 +144,30 @@ class _Batcher:
                 job.result = GenerationResult(
                     request_id=job.rid, finish_reason="error",
                     error="server shutting down")
-                job.event.set()
+                job.done()
                 job.deltas.put(None)
+                return job
+            self.queue.put(job)
+        return job
+
+    def submit_job(self, request: GenerationRequest,
+                   done_cb=None) -> _Job:
+        """Enqueue WITHOUT blocking and return the job — no delta stream;
+        the caller waits on ``job.event`` and reads ``job.result``.  The
+        durable-job facade (:class:`_BatcherEngine`) uses this to pool a
+        JobManager's chunk/reduce requests into the SAME engine waves as
+        interactive traffic instead of calling the raw engine concurrently.
+        ``done_cb`` (set before enqueue — no completion can race past it)
+        fires on completion, letting that caller wait on one shared event
+        for a whole request set."""
+        job = _Job(request, done_cb=done_cb)
+        with self._close_lock:
+            self._assign_rid(job)
+            if self.closed:
+                job.result = GenerationResult(
+                    request_id=job.rid, finish_reason="error",
+                    error="server shutting down")
+                job.done()
                 return job
             self.queue.put(job)
         return job
@@ -211,7 +243,7 @@ class _Batcher:
             job.result = GenerationResult(
                 request_id=rid, finish_reason="error",
                 error="server shutting down")
-            job.event.set()
+            job.done()
             if job.deltas is not None:
                 job.deltas.put(None)
 
@@ -230,7 +262,7 @@ class _Batcher:
         for job in skipped:
             job.result = GenerationResult(request_id=job.rid,
                                           finish_reason="cancelled")
-            job.event.set()
+            job.done()
             if job.deltas is not None:
                 job.deltas.put(None)
         if not jobs:
@@ -268,9 +300,97 @@ class _Batcher:
                 job.rid, GenerationResult(request_id=job.rid,
                                           finish_reason="error",
                                           error="engine returned no result"))
-            job.event.set()
+            job.done()
             if job.deltas is not None:  # sentinel strictly after result
                 job.deltas.put(None)
+
+
+class _BatcherEngine:
+    """Engine facade routing the JobManager's requests through the server's
+    micro-batcher (``_Batcher.submit_job``), so durable-job chunk/reduce
+    work pools into the same engine waves as interactive HTTP traffic —
+    and never calls the raw engine concurrently with the dispatcher (raw
+    engines do not accept concurrent ``generate_batch``).
+
+    Streaming granularity: the batcher completes jobs per engine WAVE, so
+    ``on_result`` deliveries (and therefore journal appends) advance at
+    wave boundaries here; the direct pipeline path (JobManager over a raw
+    continuous-scheduler engine) journals per request.  Either way the
+    WAL advances inside the run, not at end-of-map."""
+
+    schedules_internally = True  # the batcher admission-controls
+
+    def __init__(self, batcher: _Batcher):
+        self._batcher = batcher
+        self._inflight: dict[int, _Job] = {}  # caller rid -> batcher job
+        self._lock = threading.Lock()
+
+    def generate_batch(self, requests: list[GenerationRequest],
+                       on_result=None,
+                       on_tokens=None) -> list[GenerationResult]:
+        import dataclasses
+
+        # one shared completion signal for the whole call: any finishing
+        # job sets it (done_cb rides the enqueue, so no completion can
+        # race past the hookup) and the streaming loop wakes exactly then
+        wake = threading.Event()
+
+        def submit_one(req: GenerationRequest) -> _Job:
+            # the batcher reassigns request_id at enqueue — submit a COPY
+            # so the caller's id survives for result normalization
+            job = self._batcher.submit_job(dataclasses.replace(req),
+                                           done_cb=wake.set)
+            with self._lock:
+                self._inflight[req.request_id] = job
+            return job
+
+        def finish(req: GenerationRequest, job: _Job) -> GenerationResult:
+            with self._lock:
+                self._inflight.pop(req.request_id, None)
+            return dataclasses.replace(job.result,
+                                       request_id=req.request_id)
+
+        if on_result is None:
+            jobs = [(r, submit_one(r)) for r in requests]
+            for _, job in jobs:
+                job.event.wait()
+            return [finish(r, j) for r, j in jobs]
+        # streaming: deliver each result as its batcher job completes
+        # (completion order), collecting retry submissions into the run
+        pending = list(requests)
+        live: list[tuple[GenerationRequest, _Job]] = []
+        results: list[GenerationResult] = []
+
+        def submit(more: list[GenerationRequest]) -> None:
+            pending.extend(more)
+
+        while pending or live:
+            while pending:
+                req = pending.pop(0)
+                live.append((req, submit_one(req)))
+            idx = next((k for k, (_r, j) in enumerate(live)
+                        if j.event.is_set()), None)
+            if idx is None:
+                # clear-then-rescan: a completion between the scan above
+                # and this wait already set ``wake``, so the wait returns
+                # immediately and the next scan finds it
+                wake.wait()
+                wake.clear()
+                continue
+            req, job = live.pop(idx)
+            res = finish(req, job)
+            results.append(res)
+            on_result(res, submit)
+        return results
+
+    def cancel(self, request_id: int) -> None:
+        with self._lock:
+            job = self._inflight.get(request_id)
+        if job is not None:
+            self._batcher.cancel(job)
+
+    def shutdown(self) -> None:  # the server owns the real engine
+        pass
 
 
 def _anthropic_stop_reason(res: GenerationResult) -> str:
@@ -368,7 +488,8 @@ class EngineHTTPServer:
     def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8000,
                  model_name: str = "lmrs-tpu", max_tokens_cap: int = 4096,
                  batch_window_s: float = 0.02, role: str = "both",
-                 handoff_ttl_s: float = 60.0):
+                 handoff_ttl_s: float = 60.0, jobs_dir: str | None = None,
+                 pipeline_config=None):
         if role not in ("prefill", "decode", "both"):
             raise ValueError(f"unknown serving role {role!r}; "
                              "want prefill|decode|both")
@@ -377,6 +498,29 @@ class EngineHTTPServer:
         self.max_tokens_cap = max_tokens_cap
         self.batcher = _Batcher(engine, window_s=batch_window_s)
         self.started = time.time()
+        # Durable async jobs (docs/ROBUSTNESS.md § Durable jobs): with a
+        # jobs_dir, POST/GET/DELETE /v1/jobs run a journaled JobManager
+        # whose engine traffic rides the micro-batcher; interrupted
+        # journals found in the directory re-queue at startup, so a job
+        # survives a server crash/restart.  jobs_dir=None falls back to
+        # LMRS_JOBS_DIR (JobsConfig); empty disables the API (501 — or
+        # forwarding, when the engine is a router with job_request).
+        self.jobs = None
+        if jobs_dir is None or pipeline_config is not None:
+            from lmrs_tpu.config import PipelineConfig
+
+            pipeline_config = pipeline_config or PipelineConfig()
+            if jobs_dir is None:
+                jobs_dir = pipeline_config.jobs.jobs_dir
+        if jobs_dir:
+            from lmrs_tpu.jobs.manager import JobManager
+
+            self.jobs = JobManager(_BatcherEngine(self.batcher), jobs_dir,
+                                   config=pipeline_config)
+            recovered = self.jobs.recover()
+            if recovered:
+                logger.info("job recovery: %d interrupted job(s) re-queued "
+                            "from %s", recovered, jobs_dir)
         # Disaggregated serving (docs/SERVING.md): the ROLE is a policy,
         # not a capability — a prefill-role server short-circuits only
         # requests that carry the handoff flag (plain requests still run
@@ -440,6 +584,10 @@ class EngineHTTPServer:
                                      "uptime_s": round(time.time() - outer.started, 1)})
                 elif self.path.startswith("/v1/handoff/"):
                     self._get_handoff(self.path.split("/")[3])
+                elif (self.path == "/v1/jobs"
+                        or self.path.startswith("/v1/jobs/")):
+                    code, payload = outer._job_http("GET", self.path, None)
+                    self._send(code, payload)
                 elif self.path == "/v1/models":
                     self._send(200, {"object": "list", "data": [
                         {"id": outer.model_name, "object": "model",
@@ -455,12 +603,15 @@ class EngineHTTPServer:
                             200, outer.prometheus_text(),
                             "text/plain; version=0.0.4; charset=utf-8")
                         return
-                    self._send(200, {
+                    payload = {
                         "engine": outer.engine.engine_metrics(),
                         "http_batches": outer.batcher.batches_run,
                         "http_requests": outer.batcher.requests_served,
                         "handoff": outer.handoff_stats(),
-                    })
+                    }
+                    if outer.jobs is not None:
+                        payload["jobs"] = outer.jobs.stats()
+                    self._send(200, payload)
                 else:
                     self._send(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -607,6 +758,13 @@ class EngineHTTPServer:
                 req.handoff_state = payload
                 return True
 
+            def do_DELETE(self):
+                if self.path.startswith("/v1/jobs/"):
+                    code, payload = outer._job_http("DELETE", self.path, None)
+                    self._send(code, payload)
+                else:
+                    self._send(404, {"error": {"message": f"no route {self.path}"}})
+
             def do_POST(self):
                 if (self.path.startswith("/v1/handoff/")
                         and self.path.endswith("/ack")):
@@ -615,6 +773,10 @@ class EngineHTTPServer:
                 body = self._read_json()
                 if body is None:
                     self._send(400, {"error": {"message": "invalid JSON body"}})
+                    return
+                if self.path == "/v1/jobs":
+                    code, payload = outer._job_http("POST", self.path, body)
+                    self._send(code, payload)
                     return
                 try:
                     if self.path == "/v1/chat/completions":
@@ -862,6 +1024,64 @@ class EngineHTTPServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address[:2]
 
+    # ------------------------------------------------ durable-job plumbing
+
+    def _job_http(self, method: str, path: str, body: dict | None):
+        """The /v1/jobs surface: returns ``(status, payload)``.
+
+        Local-first: a configured JobManager answers here.  Without one,
+        an engine exposing ``job_request`` (RouterEngine) forwards to the
+        backend fleet — jobs live next to the engine that runs them, so
+        their journals survive that host's restarts.  Neither → 501."""
+        if self.jobs is None:
+            forward = getattr(self.engine, "job_request", None)
+            if forward is not None:
+                try:
+                    return forward(method, path, body)
+                except Exception as e:  # noqa: BLE001 - marked, never a 500 crash
+                    logger.exception("job forward failed")
+                    return 502, {"error": {
+                        "message": f"job forward failed: "
+                                   f"{type(e).__name__}: {e}",
+                        "type": "job_error"}}
+            return 501, {"error": {
+                "message": "job API disabled on this host; start lmrs-serve "
+                           "with --jobs-dir (or LMRS_JOBS_DIR)",
+                "type": "job_error"}}
+        if method == "POST":
+            transcript = (body or {}).get("transcript")
+            if not isinstance(transcript, dict) or not isinstance(
+                    transcript.get("segments"), list):
+                return 400, {"error": {
+                    "message": "body needs transcript.segments (a transcript "
+                               "JSON object), plus optional params",
+                    "type": "job_error"}}
+            try:
+                job = self.jobs.submit(transcript,
+                                       (body or {}).get("params"))
+            except ValueError as e:  # unknown/malformed param values
+                return 400, {"error": {"message": str(e),
+                                       "type": "job_error"}}
+            except Exception as e:  # noqa: BLE001 - e.g. jobs_dir disk full:
+                # a 5xx body, never a dropped connection
+                logger.exception("job submit failed")
+                return 500, {"error": {
+                    "message": f"job submit failed: {type(e).__name__}: {e}",
+                    "type": "job_error"}}
+            return 200, self.jobs.status_doc(job)
+        if method == "GET" and path == "/v1/jobs":
+            return 200, {"object": "list",
+                         "data": [self.jobs.status_doc(j)
+                                  for j in self.jobs.jobs()]}
+        jid = path.split("/v1/jobs/", 1)[-1].strip("/")
+        job = self.jobs.get(jid)
+        if job is None:
+            return 404, {"error": {"message": f"no job {jid}",
+                                   "type": "job_error"}}
+        if method == "DELETE":
+            job = self.jobs.cancel(jid) or job
+        return 200, self.jobs.status_doc(job)
+
     # ------------------------------------------------ handoff plumbing
 
     def _fetch_handoff(self, desc: dict):
@@ -1028,6 +1248,8 @@ class EngineHTTPServer:
         g.set(time.time() - self.started)
         parts.append(http_reg.render_prometheus())
         parts.append(self._handoff_reg.render_prometheus())
+        if self.jobs is not None:  # lmrs_jobs_* (docs/OBSERVABILITY.md)
+            parts.append(self.jobs.registry.render_prometheus())
         return merge_expositions(parts)
 
     def serve_forever(self) -> None:
@@ -1044,6 +1266,10 @@ class EngineHTTPServer:
         self._sweep_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self.jobs is not None:
+            # before the batcher: the job worker's in-flight requests must
+            # drain (or fast-fail) through a still-open dispatch queue
+            self.jobs.shutdown()
         self.batcher.shutdown()
 
 

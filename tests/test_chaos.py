@@ -565,3 +565,262 @@ def test_audit_reports_double_finish(jax_engine):
     finally:
         sched._audit_double_finish = 0
     assert sched.audit() == []
+
+
+# ------------------------------------------------- durable-job SIGKILL chaos
+# ISSUE 7 acceptance: a job SIGKILL'd mid-map and mid-reduce resumes from
+# the write-ahead journal to a greedy final summary token-identical to an
+# uninterrupted run, with scheduler.audit() clean — plus the torn-tail and
+# duplicate-replay crash-window variants.  The child process
+# (tests/_job_worker.py) runs one durable job; the parent paces its
+# journal with a journal.append stall plan, watches the WAL grow, and
+# kills at the exact unit of work under test.
+#
+# Two arms, two halves of the contract:
+#
+# * MOCK — deterministic, batch-invariant text: the strict token-identity
+#   assertions live here, for kills mid-map and mid-reduce plus the
+#   torn-tail / duplicate-replay variants.
+# * JAX  — the real continuous scheduler: resume-correctness and
+#   ``scheduler.audit()`` clean after every kill-resume.  The chaos
+#   geometry runs CONTENT-FREE random-init weights, whose near-uniform
+#   logits make greedy argmax knife-edge sensitive to engine history
+#   (slot/free-list order shifts prefill numerics by ulps) — ANY
+#   recompute on a differently-warmed engine is not bit-stable on this
+#   model (a real checkpoint's logit margins dwarf the ulp noise; the
+#   mock arm carries the identity contract for resumes that recompute).
+#   The kill-before-done scenario — root node durable, terminal record
+#   not — recomputes NOTHING, so it asserts strict token identity on
+#   the real engine: the journal alone carries the complete result.
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(__file__))
+import _job_worker as jw  # noqa: E402 - shared parent/child job configs
+
+from lmrs_tpu.jobs import journal as jl  # noqa: E402
+from lmrs_tpu.jobs.manager import JobManager  # noqa: E402
+
+
+def _run_uninterrupted(backend: str, tmp_dir, engine=None):
+    """One uninterrupted durable job: the token-identity reference."""
+    eng = engine or jw.build_engine(backend)
+    jm = JobManager(eng, tmp_dir, config=jw.job_pipeline_config(backend),
+                    start_worker=False)
+    job = jm.submit(jw.job_transcript())
+    jm.run_job(job)
+    jm.shutdown()
+    assert job.status == "done", job.error
+    if engine is None and backend == "jax":
+        assert eng._scheduler.audit() == []
+        eng.shutdown()
+    return job
+
+
+@pytest.fixture(scope="module")
+def mock_job_baseline(tmp_path_factory):
+    d = tmp_path_factory.mktemp("job_chaos_mock_ref")
+    job = _run_uninterrupted("mock", d, engine=jw.build_engine("mock"))
+    assert job.n_chunks >= 4 and job.reduce_nodes_done >= 3
+    return {"jid": job.job_id, "n_chunks": job.n_chunks,
+            "n_nodes": job.reduce_nodes_done,
+            "summary": job.result["summary"]}
+
+
+@pytest.fixture(scope="module")
+def jax_job_baseline(tmp_path_factory):
+    d = tmp_path_factory.mktemp("job_chaos_jax_ref")
+    job = _run_uninterrupted("jax", d)
+    assert job.n_chunks >= 4 and job.reduce_nodes_done >= 3
+    return {"jid": job.job_id, "n_chunks": job.n_chunks,
+            "n_nodes": job.reduce_nodes_done,
+            "summary": job.result["summary"]}
+
+
+def _spawn_job_child(tmp_path, backend: str, rec_type: str, n: int,
+                     stall_s: float = 0.4) -> Path:
+    """Run one durable job in its own OS process, SIGKILL it once >= n
+    records of rec_type are durably framed, and return the jobs dir.
+    The stall plan paces appends so the kill window between records is
+    wide and machine-speed independent (stalls never change WHAT is
+    written, only when)."""
+    jobs_dir = Path(tmp_path) / "jobs"
+    jobs_dir.mkdir()
+    spec = Path(tmp_path) / "spec.json"
+    spec.write_text(json.dumps({"jobs_dir": str(jobs_dir),
+                                "backend": backend,
+                                "transcript": jw.job_transcript()}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LMRS_FAULT_PLAN=json.dumps({"faults": [
+                   {"site": "journal.append", "every": 1,
+                    "action": "stall", "stall_s": stall_s}]}))
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "_job_worker.py"),
+         str(spec)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    wal = None
+    try:
+        deadline = time.time() + 240  # child compile included (cold cache)
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("job child exited before the kill: "
+                                   + proc.stderr.read().decode()[-2000:])
+            wal = next(iter(jobs_dir.glob("*.wal")), None)
+            if wal is not None:
+                recs, _ = jl.replay(wal)
+                if sum(1 for r in recs if r.get("type") == rec_type) >= n:
+                    break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError(f"never saw {n} {rec_type} record(s)")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    state = jl.rebuild_state(jl.replay(wal)[0])
+    assert state["done"] is None, "kill raced past completion — widen stall"
+    return jobs_dir
+
+
+def _resume(jobs_dir, baseline, backend: str):
+    """Recover + rerun; assert the durable-job contract (auditor clean on
+    the jax arm; token identity asserted by each caller per arm)."""
+    eng = jw.build_engine(backend)
+    jm = JobManager(eng, jobs_dir, config=jw.job_pipeline_config(backend),
+                    start_worker=False)
+    assert jm.recover() == 1
+    job = jm.get(baseline["jid"])
+    assert job is not None and job.recovered
+    jm.run_job(job)
+    jm.shutdown()
+    assert job.status == "done", job.error
+    if backend == "jax":
+        assert eng._scheduler.audit() == []
+        eng.shutdown()
+    return job
+
+
+@pytest.fixture(scope="module")
+def mock_killed_mid_map(mock_job_baseline, tmp_path_factory):
+    """ONE mock child killed mid-map, >= 2 chunk summaries journaled; the
+    plain / torn-tail / duplicate-replay scenarios each resume their own
+    COPY, so one subprocess serves three crash-window variants."""
+    d = tmp_path_factory.mktemp("job_chaos_kill_mock")
+    jobs_dir = _spawn_job_child(d, "mock", "chunk_done", 2, stall_s=0.3)
+    state = jl.rebuild_state(jl.replay(next(jobs_dir.glob("*.wal")))[0])
+    assert len(state["chunks"]) < mock_job_baseline["n_chunks"], \
+        "kill landed after map completed — widen stall"
+    return jobs_dir
+
+
+def test_chaos_job_sigkill_mid_map_token_identical(mock_job_baseline,
+                                                   mock_killed_mid_map,
+                                                   tmp_path):
+    """SIGKILL mid-map: journaled chunk summaries rehydrate instead of
+    recomputing and the resumed greedy summary is token-identical."""
+    d = tmp_path / "resume"
+    shutil.copytree(mock_killed_mid_map, d)
+    job = _resume(d, mock_job_baseline, "mock")
+    assert 2 <= job.resumed_chunks < mock_job_baseline["n_chunks"]
+    assert job.result["num_resumed_chunks"] == job.resumed_chunks
+    assert job.result["summary"] == mock_job_baseline["summary"]
+
+
+def test_chaos_job_sigkill_mid_reduce_token_identical(mock_job_baseline,
+                                                      tmp_path):
+    """SIGKILL mid-reduce (every chunk + >= 1 reduce node journaled): the
+    resumed run answers the journaled nodes from their content-addressed
+    keys — it resumes at the exact tree node, not the stage start — and
+    the final summary is token-identical."""
+    jobs_dir = _spawn_job_child(tmp_path, "mock", "reduce_node_done", 1,
+                                stall_s=0.3)
+    job = _resume(jobs_dir, mock_job_baseline, "mock")
+    assert job.resumed_chunks == mock_job_baseline["n_chunks"]
+    assert job.reduce_nodes_reused >= 1
+    assert job.result["summary"] == mock_job_baseline["summary"]
+
+
+def test_chaos_job_torn_tail_resume(mock_job_baseline, mock_killed_mid_map,
+                                    tmp_path):
+    """The SIGKILL additionally tears the final append (half a frame, no
+    newline): replay drops exactly the torn record and the resume still
+    lands token-identical."""
+    d = tmp_path / "resume"
+    shutil.copytree(mock_killed_mid_map, d)
+    wal = next(d.glob("*.wal"))
+    with open(wal, "ab") as fh:
+        fh.write(b'deadbeef {"type":"chunk_done","chunk_in')
+    _recs, meta = jl.replay(wal)
+    assert meta["torn"] is True
+    job = _resume(d, mock_job_baseline, "mock")
+    assert job.resumed_chunks >= 2
+    assert job.result["summary"] == mock_job_baseline["summary"]
+
+
+def test_chaos_job_duplicate_replay_resume(mock_job_baseline,
+                                           mock_killed_mid_map, tmp_path):
+    """Every surviving record appended twice (a crash window re-append):
+    rebuild is idempotent, so the duplicates neither double-count resumed
+    work nor perturb the token-identical summary."""
+    d = tmp_path / "resume"
+    shutil.copytree(mock_killed_mid_map, d)
+    wal = next(d.glob("*.wal"))
+    lines = wal.read_bytes().split(b"\n")[:-1]
+    wal.write_bytes(b"\n".join(lines + lines) + b"\n")
+    doubled = jl.rebuild_state(jl.replay(wal)[0])
+    # byte-identical state vs the un-duplicated journal
+    orig = next(mock_killed_mid_map.glob("*.wal"))
+    assert (jl.canonical_json(jl.rebuild_state(jl.replay(orig)[0]))
+            == jl.canonical_json(doubled))
+    job = _resume(d, mock_job_baseline, "mock")
+    # duplicates rehydrate exactly once, never double-count
+    assert job.resumed_chunks == len(doubled["chunks"])
+    assert job.result["summary"] == mock_job_baseline["summary"]
+
+
+def test_chaos_job_jax_sigkill_mid_map_audited(jax_job_baseline, tmp_path):
+    """SIGKILL mid-map on the REAL engine: recovery re-queues, journaled
+    chunks rehydrate, the resumed run completes with the page/refcount
+    auditor clean.  (Token identity for partial-wave recomputes is the
+    mock arm's assertion — content-free random-init logits are knife-edge
+    under wave recomposition; see the section comment.)"""
+    jobs_dir = _spawn_job_child(tmp_path, "jax", "chunk_done", 2)
+    job = _resume(jobs_dir, jax_job_baseline, "jax")
+    assert job.resumed_chunks >= 2
+    assert job.result["num_resumed_chunks"] == job.resumed_chunks
+
+
+def test_chaos_job_jax_sigkill_mid_reduce_audited(jax_job_baseline, tmp_path):
+    """SIGKILL mid-reduce on the REAL engine (every chunk + >= 1 reduce
+    node journaled): the resumed run answers the journaled nodes from
+    their content-addressed keys, completes, and the page/refcount
+    auditor is clean.  (Identity for the partially recomputed tree is the
+    mock arm's assertion — see the section comment.)"""
+    jobs_dir = _spawn_job_child(tmp_path, "jax", "reduce_node_done", 1)
+    job = _resume(jobs_dir, jax_job_baseline, "jax")
+    assert job.resumed_chunks == jax_job_baseline["n_chunks"]
+    assert job.reduce_nodes_reused >= 1
+
+
+def test_chaos_job_jax_sigkill_before_done_token_identical(jax_job_baseline,
+                                                           tmp_path):
+    """SIGKILL in the last crash window of a job's life: the root reduce
+    node is durable but the terminal ``job_done`` record is not.
+    Finalization is then PURE journal replay — zero recompute — so strict
+    token identity holds even on the knife-edge chaos weights, proving
+    the journal alone carries the complete result on the real engine."""
+    jobs_dir = _spawn_job_child(tmp_path, "jax", "reduce_node_done",
+                                jax_job_baseline["n_nodes"])
+    job = _resume(jobs_dir, jax_job_baseline, "jax")
+    assert job.resumed_chunks == jax_job_baseline["n_chunks"]
+    assert job.reduce_nodes_reused == jax_job_baseline["n_nodes"]
+    assert job.result["summary"] == jax_job_baseline["summary"]

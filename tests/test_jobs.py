@@ -1,0 +1,904 @@
+"""Durable summarization jobs: WAL journal, crash-safe resume, async API.
+
+The job-durability contract (docs/ROBUSTNESS.md § Durable jobs):
+
+* the journal is CRC-framed, fsync'd, torn-tail tolerant, and its replay
+  is idempotent — the same journal replayed any number of times yields
+  byte-identical job state;
+* a job resumes at the exact unit of work it died at: journaled chunk
+  summaries rehydrate instead of recomputing, journaled reduce-tree
+  nodes answer their content-addressed keys instead of re-running, and
+  the resumed greedy final summary is token-identical to an
+  uninterrupted run;
+* the serving tier exposes it as POST/GET/DELETE /v1/jobs, surviving a
+  server restart (SIGKILL'd server process included), with router
+  forwarding for fleet deployments;
+* journal I/O faults DEGRADE durability, never the job; a recovery fault
+  degrades per job, never the startup.
+
+The SIGKILL-mid-map / mid-reduce / torn-tail / duplicate-replay chaos
+scenarios live in tests/test_chaos.py (the tier-1 chaos gate); this file
+owns the journal units, manager semantics, and the HTTP surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import _job_worker as jw  # noqa: E402 - shared parent/child job configs
+from conftest import free_port  # noqa: E402
+
+from lmrs_tpu.config import JobsConfig, PipelineConfig  # noqa: E402
+from lmrs_tpu.engine.mock import MockEngine  # noqa: E402
+from lmrs_tpu.jobs import journal as jl  # noqa: E402
+from lmrs_tpu.jobs.manager import JobManager  # noqa: E402
+from lmrs_tpu.testing import faults  # noqa: E402
+from lmrs_tpu.testing.faults import FaultPlan  # noqa: E402
+
+
+# ------------------------------------------------------------ journal units
+
+
+def test_journal_roundtrip(tmp_path):
+    j = jl.Journal(tmp_path / "a.wal")
+    recs = [{"type": "job_header", "job_id": "j1", "fingerprint": "f"},
+            {"type": "chunk_done", "chunk_index": 0, "start_time": 0.0,
+             "end_time": 1.0, "summary": "s0", "error": None},
+            {"type": "job_done", "status": "done"}]
+    for r in recs:
+        assert j.append(r) is True
+    j.close()
+    out, meta = jl.replay(tmp_path / "a.wal")
+    assert out == recs
+    assert meta == {"records": 3, "dropped": 0, "torn": False,
+                    "corrupt": False}
+    assert j.stats() == {"appends": 3, "append_failures": 0,
+                         "fsync_failures": 0, "degraded": False}
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    """A crash mid-append leaves a partial final line: replay drops it
+    (meta['torn']) and keeps everything before it."""
+    p = tmp_path / "t.wal"
+    j = jl.Journal(p)
+    j.append({"type": "chunk_done", "chunk_index": 0, "start_time": 0.0,
+              "end_time": 1.0, "summary": "s"})
+    j.append({"type": "chunk_done", "chunk_index": 1, "start_time": 1.0,
+              "end_time": 2.0, "summary": "t"})
+    j.close()
+    with open(p, "ab") as fh:  # torn: half a frame, no newline
+        fh.write(b'deadbeef {"type":"chunk_do')
+    out, meta = jl.replay(p)
+    assert len(out) == 2 and out[1]["summary"] == "t"
+    assert meta["torn"] is True and meta["dropped"] == 1
+    assert meta["corrupt"] is False
+
+
+def test_journal_midfile_corruption_drops_suffix(tmp_path):
+    """Damage BEFORE the tail is not a torn append — everything after the
+    bad record is untrusted and dropped."""
+    p = tmp_path / "c.wal"
+    j = jl.Journal(p)
+    for i in range(4):
+        j.append({"type": "chunk_done", "chunk_index": i, "start_time": 0.0,
+                  "end_time": 1.0, "summary": f"s{i}"})
+    j.close()
+    lines = p.read_bytes().split(b"\n")
+    lines[1] = lines[1][:12] + b"X" + lines[1][13:]  # flip a payload byte
+    p.write_bytes(b"\n".join(lines))
+    out, meta = jl.replay(p)
+    assert [r["chunk_index"] for r in out] == [0]
+    assert meta["corrupt"] is True and meta["dropped"] == 3
+    assert meta["torn"] is False
+
+
+def test_journal_replay_determinism_and_duplicate_idempotence(tmp_path):
+    """Satellite: the same journal replayed twice yields byte-identical
+    state, and duplicated records (a crash window re-appending) change
+    nothing — rebuild keys by content identity."""
+    p = tmp_path / "d.wal"
+    j = jl.Journal(p)
+    recs = [{"type": "job_header", "job_id": "j", "fingerprint": "f"},
+            {"type": "chunk_done", "chunk_index": 0, "start_time": 0.0,
+             "end_time": 1.5, "summary": "alpha"},
+            {"type": "reduce_node_done", "node_id": "L1.B0", "key": "k0",
+             "text": "node"}]
+    for r in recs:
+        j.append(r)
+    once = jl.canonical_json(jl.rebuild_state(jl.replay(p)[0]))
+    twice = jl.canonical_json(jl.rebuild_state(jl.replay(p)[0]))
+    assert once == twice  # byte-identical replay
+    for r in recs:  # duplicate every record (idempotent rebuild)
+        j.append(r)
+    j.close()
+    doubled = jl.canonical_json(jl.rebuild_state(jl.replay(p)[0]))
+    assert doubled == once
+
+
+def test_journal_unknown_record_types_ignored(tmp_path):
+    p = tmp_path / "u.wal"
+    j = jl.Journal(p)
+    j.append({"type": "job_header", "job_id": "j", "fingerprint": "f"})
+    j.append({"type": "from_the_future", "payload": 1})
+    j.close()
+    state = jl.rebuild_state(jl.replay(p)[0])
+    assert state["header"] is not None
+    assert state["chunks"] == {} and state["nodes"] == {}
+
+
+def test_journal_append_and_fsync_faults_degrade(tmp_path):
+    """journal.append / journal.fsync fault sites: the append reports
+    non-durable (False) and flags degradation, but never raises — journal
+    I/O failure must not kill the job whose progress it records."""
+    j = jl.Journal(tmp_path / "f.wal")
+    with faults.injected(FaultPlan(faults=[
+            {"site": "journal.append", "at": [2], "max_fires": 1},
+            {"site": "journal.fsync", "at": [2], "max_fires": 1}])):
+        assert j.append({"type": "chunk_done", "chunk_index": 0,
+                         "start_time": 0.0, "end_time": 1.0}) is True
+        # occurrence 2: the append itself fails — record dropped
+        assert j.append({"type": "chunk_done", "chunk_index": 1,
+                         "start_time": 0.0, "end_time": 1.0}) is False
+        # append occurrence 3 lands, fsync occurrence 2 fails — written
+        # but not durable
+        assert j.append({"type": "chunk_done", "chunk_index": 2,
+                         "start_time": 0.0, "end_time": 1.0}) is False
+        assert j.append({"type": "chunk_done", "chunk_index": 3,
+                         "start_time": 0.0, "end_time": 1.0}) is True
+    j.close()
+    s = j.stats()
+    assert s["degraded"] and s["append_failures"] == 1
+    assert s["fsync_failures"] == 1
+    out, _ = jl.replay(j.path)
+    assert [r["chunk_index"] for r in out] == [0, 2, 3]
+
+
+def test_content_addressing():
+    """Job ids key on (transcript, fingerprint); fingerprints key on the
+    prompt/model surface — a different map prompt is a DIFFERENT job."""
+    t1 = {"segments": [{"start": 0, "end": 1, "text": "a"}]}
+    t2 = {"segments": [{"start": 0, "end": 1, "text": "b"}]}
+    fa = jl.config_fingerprint(map_prompt="A", model="m")
+    fb = jl.config_fingerprint(map_prompt="B", model="m")
+    assert fa != fb
+    assert jl.job_id_for(t1, fa) == jl.job_id_for(t1, fa)
+    assert jl.job_id_for(t1, fa) != jl.job_id_for(t2, fa)
+    assert jl.job_id_for(t1, fa) != jl.job_id_for(t1, fb)
+    # node keys: content-addressed on exactly the inputs that shape the
+    # prompt
+    assert jl.node_key(["s1", "s2"], "T", {"m": 1}) == \
+        jl.node_key(["s1", "s2"], "T", {"m": 1})
+    assert jl.node_key(["s1", "s2"], "T", {"m": 1}) != \
+        jl.node_key(["s2", "s1"], "T", {"m": 1})
+
+
+# -------------------------------------------------------- manager semantics
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """One uninterrupted mock job: the token-identity reference every
+    resume test compares against, plus its WAL for prefix surgery."""
+    d = tmp_path_factory.mktemp("jobs_baseline")
+    jm = JobManager(jw.build_engine("mock"), d,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    job = jm.submit(jw.job_transcript())
+    jm.run_job(job)
+    assert job.status == "done" and job.n_chunks >= 5
+    assert job.reduce_nodes_done >= 3  # hierarchical: mid-reduce is real
+    lines = job.wal_path.read_bytes().split(b"\n")[:-1]
+    jm.shutdown()
+    return {"dir": d, "jid": job.job_id, "summary": job.result["summary"],
+            "n_chunks": job.n_chunks, "lines": lines,
+            "result": job.result}
+
+
+def _wal_lines_by_type(lines: list[bytes]) -> dict[str, list[bytes]]:
+    by_type: dict[str, list[bytes]] = {}
+    for raw in lines:
+        rec = json.loads(raw[9:])
+        by_type.setdefault(rec["type"], []).append(raw)
+    return by_type
+
+
+def _interrupted_dir(baseline, tmp_path, n_chunks: int,
+                     n_nodes: int = 0) -> Path:
+    """A jobs dir that looks exactly like a crash left it: the request
+    file plus a WAL prefix (header, the first n_chunks chunk records, the
+    first n_nodes reduce records) — no terminal record."""
+    by = _wal_lines_by_type(baseline["lines"])
+    keep = (by["job_header"] + by["chunk_done"][:n_chunks]
+            + by["reduce_node_done"][:n_nodes])
+    d = tmp_path / "resume"
+    d.mkdir()
+    jid = baseline["jid"]
+    (d / f"{jid}.req.json").write_bytes(
+        (baseline["dir"] / f"{jid}.req.json").read_bytes())
+    (d / f"{jid}.wal").write_bytes(b"\n".join(keep) + b"\n")
+    return d
+
+
+def test_resume_mid_map_token_identical(baseline, tmp_path):
+    """Crash after 3 journaled chunk summaries: recovery re-queues the
+    job, the 3 chunks rehydrate (not recompute), and the final summary is
+    token-identical to the uninterrupted run."""
+    d = _interrupted_dir(baseline, tmp_path, n_chunks=3)
+    jm = JobManager(jw.build_engine("mock"), d,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    assert jm.recover() == 1
+    job = jm.get(baseline["jid"])
+    assert job.status == "queued" and job.recovered
+    jm.run_job(job)
+    assert job.status == "done"
+    assert job.resumed_chunks == 3
+    assert job.result["num_resumed_chunks"] == 3
+    assert job.result["summary"] == baseline["summary"]
+    # the map stage really skipped the journaled chunks
+    assert job.result["total_requests"] < baseline["result"]["total_requests"]
+    jm.shutdown()
+
+
+def test_resume_mid_reduce_reuses_exact_tree_nodes(baseline, tmp_path):
+    """Crash mid-reduce: every chunk and the first 3 reduce nodes are
+    journaled.  The resumed run answers those nodes from the journal
+    (content-addressed keys — the exact-tree-node resume contract) and
+    recomputes only the rest, landing on the identical final summary."""
+    d = _interrupted_dir(baseline, tmp_path,
+                        n_chunks=baseline["n_chunks"], n_nodes=3)
+    jm = JobManager(jw.build_engine("mock"), d,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    assert jm.recover() == 1
+    job = jm.get(baseline["jid"])
+    jm.run_job(job)
+    assert job.status == "done"
+    assert job.resumed_chunks == baseline["n_chunks"]
+    assert job.reduce_nodes_reused == 3
+    assert job.result["summary"] == baseline["summary"]
+    # only the un-journaled reduce nodes hit the engine
+    assert (job.result["total_requests"]
+            == baseline["result"]["reduce_levels"] * 0
+            + len(_wal_lines_by_type(baseline["lines"])["reduce_node_done"])
+            - 3)
+    jm.shutdown()
+
+
+def test_resume_duplicate_replay_no_recompute(baseline, tmp_path):
+    """Every record journaled twice (crash-window re-append): the rebuild
+    is idempotent, so the resumed run rehydrates everything exactly once
+    and issues ZERO engine requests — and still reports the identical
+    summary."""
+    by = _wal_lines_by_type(baseline["lines"])
+    work = by["job_header"] + by["chunk_done"] + by["reduce_node_done"]
+    d = tmp_path / "dup"
+    d.mkdir()
+    jid = baseline["jid"]
+    (d / f"{jid}.req.json").write_bytes(
+        (baseline["dir"] / f"{jid}.req.json").read_bytes())
+    (d / f"{jid}.wal").write_bytes(b"\n".join(work + work) + b"\n")
+    jm = JobManager(jw.build_engine("mock"), d,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    assert jm.recover() == 1
+    job = jm.get(jid)
+    jm.run_job(job)
+    assert job.status == "done"
+    assert job.result["summary"] == baseline["summary"]
+    assert job.result["total_requests"] == 0  # nothing recomputed
+    jm.shutdown()
+
+
+def test_recover_fingerprint_mismatch_sets_journal_aside(baseline, tmp_path):
+    """Satellite contract at the job tier: a journal written under a
+    different prompt/model surface must NOT rehydrate.  Restarting under a
+    changed config recomputes the fingerprint, the gate fires, the stale
+    WAL is set aside, and the job re-runs from scratch."""
+    d = _interrupted_dir(baseline, tmp_path, n_chunks=3)
+    cfg = jw.job_pipeline_config("mock")
+    cfg = cfg.replace(engine=type(cfg.engine)(
+        backend="mock", temperature=0.0, seed=0, max_tokens=47,
+        retry_delay=0.0))  # max_tokens differs -> different fingerprint
+    jm = JobManager(jw.build_engine("mock"), d, config=cfg,
+                    start_worker=False)
+    assert jm.recover() == 1
+    job = jm.get(baseline["jid"])
+    jm.run_job(job)
+    assert job.status == "done"
+    assert job.resumed_chunks == 0  # nothing rehydrated
+    assert (d / f"{baseline['jid']}.wal.stale").exists()
+    # the fresh journal carries the NEW fingerprint
+    state = jl.rebuild_state(jl.replay(job.wal_path)[0])
+    assert state["header"]["fingerprint"] == job.fingerprint
+    jm.shutdown()
+
+
+def test_duplicate_submit_converges(tmp_path):
+    """Content-addressed submits: the same (transcript, params) twice is
+    ONE job; a different params surface is another."""
+    jm = JobManager(jw.build_engine("mock"), tmp_path,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    t = jw.job_transcript(n=8)
+    a = jm.submit(t)
+    b = jm.submit(t)
+    assert a is b
+    c = jm.submit(t, {"summary_type": "minutes"})
+    assert c.job_id != a.job_id
+    with pytest.raises(ValueError, match="unknown job param"):
+        jm.submit(t, {"tempreature": 1.0})
+    jm.shutdown()
+
+
+def test_resubmit_after_failure_retries_on_same_journal(tmp_path):
+    """A failed job's terminal record must not block an explicit retry:
+    resubmitting re-queues on the SAME journal, supersedes the stale
+    job_done, and the retry resumes whatever succeeded before."""
+    t = jw.job_transcript(n=8)
+    jm = JobManager(MockEngine(seed=0, fail_pattern="roadmap"), tmp_path,
+                    config=jw.job_pipeline_config("mock"),
+                    jobs_config=JobsConfig(max_failed_chunk_fraction=0.0),
+                    start_worker=False)
+    job = jm.submit(t)
+    jm.run_job(job)
+    assert job.status == "failed" and job.chunks_failed > 0
+    ok_chunks = job.chunks_done - job.chunks_failed
+    # retry on an engine that no longer fails
+    jm2 = JobManager(jw.build_engine("mock"), tmp_path,
+                     config=jw.job_pipeline_config("mock"),
+                     start_worker=False)
+    assert jm2.recover() == 0  # failed is terminal at startup
+    retry = jm2.submit(t)
+    assert retry.status == "queued"  # explicit resubmit = retry
+    jm2.run_job(retry)
+    assert retry.status == "done"
+    assert retry.resumed_chunks == ok_chunks  # successes rehydrated
+    assert retry.error is None and retry.result["summary"]
+    # the superseding terminal record wins on the next restart
+    jm3 = JobManager(jw.build_engine("mock"), tmp_path,
+                     config=jw.job_pipeline_config("mock"),
+                     start_worker=False)
+    jm3.recover()
+    assert jm3.get(retry.job_id).status == "done"
+    for m in (jm, jm2, jm3):
+        m.shutdown()
+
+
+def _marked_transcript() -> dict:
+    """jw.job_transcript with ONE segment carrying the mock fail marker —
+    lands in exactly one chunk (the degraded-threshold scenarios need a
+    failed-chunk fraction of exactly 1/n_chunks)."""
+    t = jw.job_transcript()
+    t["segments"][2]["text"] = "This segment says XXFAILXX loudly."
+    return t
+
+
+def test_degraded_completion_under_threshold(tmp_path):
+    """Satellite: failed-chunk fraction within policy finishes
+    status='degraded' with per-chunk degraded_reasons attached — not
+    all-or-nothing failure."""
+    cfg = jw.job_pipeline_config("mock")
+    cfg = cfg.replace(chunk=type(cfg.chunk)(
+        max_tokens_per_chunk=150, overlap_tokens=0, context_tokens=0))
+    jm = JobManager(MockEngine(seed=0, fail_pattern="XXFAILXX"), tmp_path,
+                    config=cfg,
+                    jobs_config=JobsConfig(max_failed_chunk_fraction=0.34),
+                    start_worker=False)
+    job = jm.submit(_marked_transcript())
+    jm.run_job(job)
+    assert job.status == "degraded"
+    assert job.chunks_failed == 1
+    assert len(job.degraded_reasons) == 1
+    assert "injected failure" in job.degraded_reasons[0]["degraded_reason"]
+    assert job.result["summary"]  # degrade-and-continue output attached
+    doc = jm.status_doc(job)
+    assert doc["status"] == "degraded" and doc["degraded_reasons"]
+    # the degraded terminal state survives a restart
+    jm2 = JobManager(jw.build_engine("mock"), tmp_path, config=cfg,
+                     start_worker=False)
+    assert jm2.recover() == 0
+    assert jm2.get(job.job_id).status == "degraded"
+    jm.shutdown(), jm2.shutdown()
+
+
+def test_degraded_completion_over_threshold_fails(tmp_path):
+    """The other side of the policy line: the same single failed chunk
+    with a zero-tolerance threshold is a FAILED job (reasons still
+    attached for triage)."""
+    cfg = jw.job_pipeline_config("mock")
+    cfg = cfg.replace(chunk=type(cfg.chunk)(
+        max_tokens_per_chunk=150, overlap_tokens=0, context_tokens=0))
+    jm = JobManager(MockEngine(seed=0, fail_pattern="XXFAILXX"), tmp_path,
+                    config=cfg,
+                    jobs_config=JobsConfig(max_failed_chunk_fraction=0.0),
+                    start_worker=False)
+    job = jm.submit(_marked_transcript())
+    jm.run_job(job)
+    assert job.status == "failed"
+    assert job.chunks_failed == 1 and job.degraded_reasons
+    jm.shutdown()
+
+
+def test_jobs_config_validates_fraction():
+    with pytest.raises(ValueError, match="max_failed_chunk_fraction"):
+        JobsConfig(max_failed_chunk_fraction=1.5)
+
+
+def test_cancel_running_job_then_retry(tmp_path):
+    """DELETE semantics: a running job cancels (journaled terminal), its
+    in-flight chunks are chased through the executor's cancel hooks; a
+    later resubmit retries on the same journal."""
+    t = jw.job_transcript()
+    jm = JobManager(MockEngine(seed=0, latency_s=0.15), tmp_path,
+                    config=jw.job_pipeline_config("mock"))  # real worker
+    job = jm.submit(t)
+    deadline = time.time() + 30
+    while job.status != "running" and time.time() < deadline:
+        time.sleep(0.01)
+    assert job.status == "running"
+    jm.cancel(job.job_id)
+    assert job.done_ev.wait(30)
+    assert job.status == "cancelled"
+    state = jl.rebuild_state(jl.replay(job.wal_path)[0])
+    assert state["done"]["status"] == "cancelled"  # survives restart
+    jm.shutdown()
+    # retry: instantaneous engine, same journal
+    jm2 = JobManager(jw.build_engine("mock"), tmp_path,
+                     config=jw.job_pipeline_config("mock"),
+                     start_worker=False)
+    assert jm2.recover() == 0  # cancelled is terminal at startup
+    retry = jm2.submit(t)
+    jm2.run_job(retry)
+    assert retry.status == "done" and retry.result["summary"]
+    jm2.shutdown()
+
+
+def test_recover_fault_degrades_per_job(baseline, tmp_path):
+    """jobs.recover fault site: the faulted job is registered failed (the
+    interruption stays visible), the OTHER interrupted job still
+    recovers and completes."""
+    d = _interrupted_dir(baseline, tmp_path, n_chunks=2)
+    # a second interrupted job: different transcript, fresh journal
+    jm0 = JobManager(jw.build_engine("mock"), d,
+                     config=jw.job_pipeline_config("mock"),
+                     start_worker=False)
+    other = jm0.submit(jw.job_transcript(n=8, seed=5))
+    jm0.shutdown()  # header journaled, never run -> interrupted
+    with faults.injected(FaultPlan(faults=[
+            {"site": "jobs.recover", "at": [1], "max_fires": 1}])):
+        jm = JobManager(jw.build_engine("mock"), d,
+                        config=jw.job_pipeline_config("mock"),
+                        start_worker=False)
+        assert jm.recover() == 1  # one failed, one re-queued
+    statuses = {j.job_id: j.status for j in jm.jobs()}
+    assert sorted(statuses.values()) == ["failed", "queued"]
+    failed_id = next(k for k, v in statuses.items() if v == "failed")
+    assert "recovery failed" in jm.get(failed_id).error
+    runnable = jm.get(next(k for k, v in statuses.items() if v == "queued"))
+    jm.run_job(runnable)
+    assert runnable.status == "done"
+    assert other.job_id in statuses
+    jm.shutdown()
+
+
+def test_journal_append_after_partial_tail_repairs(tmp_path):
+    """Appending over a file that ends mid-line (a torn tail, or bytes a
+    failed append left behind) must not merge two records into one
+    corrupt mid-file line — that would make replay drop every record
+    AFTER it, records already acknowledged durable.  The (re)open
+    truncates the partial tail first."""
+    wal = tmp_path / "x.wal"
+    j = jl.Journal(wal)
+    assert j.append({"type": "chunk_done", "chunk_index": 1})
+    j.close()
+    with open(wal, "ab") as fh:
+        fh.write(b'deadbeef {"type":"chunk_done","chunk_in')  # no newline
+    j2 = jl.Journal(wal)
+    assert j2.append({"type": "chunk_done", "chunk_index": 2})
+    j2.close()
+    recs, meta = jl.replay(wal)
+    assert meta["corrupt"] is False and meta["torn"] is False
+    assert [r["chunk_index"] for r in recs] == [1, 2]
+
+
+def test_resubmit_queued_job_supersedes_pending_cancel(tmp_path):
+    """DELETE on a QUEUED job then an identical re-POST: the resubmit is
+    acknowledged "queued" and must actually run — the pending cancel is
+    superseded, not silently honored at dequeue."""
+    jm = JobManager(jw.build_engine("mock"), tmp_path,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    t = jw.job_transcript(n=8)
+    job = jm.submit(t)
+    jm.cancel(job.job_id)
+    assert job.status == "queued" and job.cancel_ev.is_set()
+    again = jm.submit(t)
+    assert again is job and not job.cancel_ev.is_set()
+    jm.run_job(job)
+    assert job.status == "done"
+    jm.shutdown()
+
+
+def test_resubmit_running_job_mid_cancel_requeues(tmp_path):
+    """The same race against a RUNNING job: DELETE starts the unwind,
+    an identical POST lands before the cancelled finish — the job must
+    re-queue and run to completion once the cancel lands, not leave the
+    acknowledged submit swallowed."""
+    t = jw.job_transcript()
+    jm = JobManager(MockEngine(seed=0, latency_s=0.15), tmp_path,
+                    config=jw.job_pipeline_config("mock"))  # real worker
+    job = jm.submit(t)
+    deadline = time.time() + 30
+    while job.status != "running" and time.time() < deadline:
+        time.sleep(0.01)
+    assert job.status == "running"
+    jm.cancel(job.job_id)
+    again = jm.submit(t)
+    assert again is job and job.resubmit_pending
+    deadline = time.time() + 60
+    while job.status != "done" and time.time() < deadline:
+        time.sleep(0.02)
+    assert job.status == "done" and job.result["summary"]
+    jm.shutdown()
+
+
+def test_resubmit_after_failed_recovery_heals(baseline, tmp_path):
+    """A job registered by a FAILED recovery carries params={} and
+    fingerprint=""; an explicit resubmit with the real (transcript,
+    params) must heal both — re-queueing on the SAME journal instead of
+    running default params and stale-siding its own progress."""
+    d = _interrupted_dir(baseline, tmp_path, n_chunks=3)
+    with faults.injected(FaultPlan(faults=[
+            {"site": "jobs.recover", "at": [1], "max_fires": 1}])):
+        jm = JobManager(jw.build_engine("mock"), d,
+                        config=jw.job_pipeline_config("mock"),
+                        start_worker=False)
+        assert jm.recover() == 0
+    job = jm.get(baseline["jid"])
+    assert job.status == "failed" and job.fingerprint == ""
+    retry = jm.submit(jw.job_transcript())
+    assert retry is job and retry.status == "queued"
+    assert retry.fingerprint != ""
+    jm.run_job(retry)
+    assert retry.status == "done"
+    assert retry.resumed_chunks == 3  # the journal was NOT stale-sided
+    assert retry.result["summary"] == baseline["summary"]
+    assert not Path(str(job.wal_path) + ".stale").exists()
+    jm.shutdown()
+
+
+def test_reduce_error_final_marker_fails_job(tmp_path):
+    """Every reduce node degrading to an error marker must not journal a
+    terminal "done" around a garbage summary: the job is FAILED (and
+    therefore retryable), with the reduce degradation in the reasons."""
+    jm = JobManager(MockEngine(seed=0, fail_pattern="SUMMARY 1:"), tmp_path,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    job = jm.submit(jw.job_transcript())
+    jm.run_job(job)
+    assert job.status == "failed"
+    assert job.chunks_failed == 0  # the map was clean; the REDUCE broke
+    assert job.result["reduce_errors"] >= 1
+    assert any(r.get("node") == "reduce" for r in job.degraded_reasons)
+    jm.shutdown()
+
+
+def test_reduce_error_mid_tree_degrades_job(tmp_path):
+    """One mid-tree reduce node erroring (its marker folded into a
+    successful final summary) is a DEGRADED completion, not "done": the
+    content is partially corrupted and the status must say so."""
+    jm = JobManager(MockEngine(seed=0, fail_pattern="batch: 1/"), tmp_path,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    job = jm.submit(jw.job_transcript())
+    jm.run_job(job)
+    assert job.status == "degraded"
+    assert job.result["reduce_errors"] >= 1
+    assert job.result["summary"]
+    assert not job.result["summary"].startswith("[Error aggregating")
+    jm.shutdown()
+
+
+def test_graceful_shutdown_withholds_shutdown_induced_terminal(baseline,
+                                                               tmp_path):
+    """A GRACEFUL server restart mid-job must resume like a SIGKILL does:
+    shutdown fast-fails the job's in-flight engine requests, and
+    journaling that failure as terminal would leave the replacement
+    server refusing to resume.  The terminal record is withheld when the
+    manager is stopping; the replacement recovers, resumes the journaled
+    chunks, and lands the baseline summary."""
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    wal = tmp_path / f"{baseline['jid']}.wal"
+    with faults.injected(FaultPlan(faults=[
+            {"site": "journal.append", "every": 1, "action": "stall",
+             "stall_s": 1.0}])):
+        srv = EngineHTTPServer(jw.build_engine("mock"), port=0,
+                               batch_window_s=0.01, jobs_dir=str(tmp_path),
+                               pipeline_config=jw.job_pipeline_config("mock"))
+        srv.start_background()
+        _http("POST", f"http://{srv.host}:{srv.port}/v1/jobs",
+              {"transcript": jw.job_transcript()})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if wal.exists() and sum(
+                    1 for r in jl.replay(wal)[0]
+                    if r["type"] == jl.REC_CHUNK) >= 2:
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError("never saw 2 journaled chunks")
+        srv.shutdown()  # graceful: joins the worker 5s, then closes engine
+        srv.jobs._worker.join(60)  # let the orphaned run wind down fully
+        assert not srv.jobs._worker.is_alive()
+    state = jl.rebuild_state(jl.replay(wal)[0])
+    assert state["done"] is None, \
+        "graceful shutdown journaled a terminal record — not resumable"
+    assert len(state["chunks"]) >= 2
+    srv2 = EngineHTTPServer(jw.build_engine("mock"), port=0,
+                            batch_window_s=0.01, jobs_dir=str(tmp_path),
+                            pipeline_config=jw.job_pipeline_config("mock"))
+    srv2.start_background()
+    try:
+        doc = _poll_job(f"http://{srv2.host}:{srv2.port}",
+                        baseline["jid"])
+        assert doc["status"] == "done" and doc["recovered"]
+        assert doc["progress"]["num_resumed_chunks"] >= 2
+        assert doc["result"]["summary"] == baseline["summary"]
+    finally:
+        srv2.shutdown()
+
+
+# ----------------------------------------------------------- HTTP surface
+
+
+def _http(method: str, url: str, body: dict | None = None,
+          timeout: float = 30.0):
+    req = urllib.request.Request(
+        url, data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _poll_job(base: str, jid: str, deadline_s: float = 60.0) -> dict:
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        _, doc = _http("GET", f"{base}/v1/jobs/{jid}")
+        if doc["status"] in ("done", "degraded", "failed", "cancelled"):
+            return doc
+        time.sleep(0.05)
+    raise TimeoutError(f"job {jid} never terminal")
+
+
+@pytest.fixture
+def job_server(tmp_path):
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(jw.build_engine("mock"), port=0,
+                           batch_window_s=0.01, jobs_dir=str(tmp_path),
+                           pipeline_config=jw.job_pipeline_config("mock"))
+    srv.start_background()
+    yield srv, f"http://{srv.host}:{srv.port}", tmp_path
+    srv.shutdown()
+
+
+def test_job_api_lifecycle(job_server):
+    srv, base, _d = job_server
+    status, doc = _http("POST", f"{base}/v1/jobs",
+                        {"transcript": jw.job_transcript()})
+    assert status == 200 and doc["object"] == "job"
+    jid = doc["id"]
+    assert doc["status"] in ("queued", "running")
+    final = _poll_job(base, jid)
+    assert final["status"] == "done"
+    assert final["result"]["summary"]
+    assert final["progress"]["chunks_done"] == final["progress"]["num_chunks"]
+    # duplicate POST converges on the same job (content-addressed)
+    _, doc2 = _http("POST", f"{base}/v1/jobs",
+                    {"transcript": jw.job_transcript()})
+    assert doc2["id"] == jid and doc2["status"] == "done"
+    # list + stats surfaces
+    _, lst = _http("GET", f"{base}/v1/jobs")
+    assert [d["id"] for d in lst["data"]] == [jid]
+    _, metrics = _http("GET", f"{base}/metrics")
+    assert metrics["jobs"]["by_status"].get("done") == 1
+    # Prometheus exposition carries the lmrs_jobs_* family
+    req = urllib.request.Request(f"{base}/metrics",
+                                 headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        text = r.read().decode()
+    assert "lmrs_jobs_submitted_total 1" in text
+    assert "lmrs_jobs_completed_total 1" in text
+    assert "lmrs_jobs_journal_appends_total" in text
+    # DELETE on a terminal job: terminal states stick
+    status, doc3 = _http("DELETE", f"{base}/v1/jobs/{jid}")
+    assert status == 200 and doc3["status"] == "done"
+
+
+def test_job_api_validation(job_server):
+    _srv, base, _d = job_server
+    for bad in ({}, {"transcript": "not a dict"},
+                {"transcript": {"segments": "nope"}}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http("POST", f"{base}/v1/jobs", bad)
+        assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _http("POST", f"{base}/v1/jobs",
+              {"transcript": jw.job_transcript(n=6),
+               "params": {"no_such_knob": 1}})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _http("GET", f"{base}/v1/jobs/job-doesnotexist")
+    assert e.value.code == 404
+
+
+def test_job_api_disabled_is_501():
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(MockEngine(), port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http("POST", f"http://{srv.host}:{srv.port}/v1/jobs",
+                  {"transcript": jw.job_transcript(n=6)})
+        assert e.value.code == 501
+    finally:
+        srv.shutdown()
+
+
+def test_job_api_survives_server_sigkill(tmp_path):
+    """The acceptance scenario at the HTTP tier: POST a job to a real
+    lmrs-serve process, SIGKILL the process mid-map (journal paced by an
+    append-stall plan), start a replacement server over the same jobs
+    dir, and read back a token-identical summary with recovered=true and
+    real resumed-chunk counts."""
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    jobs_dir = tmp_path / "jobs"
+    jobs_dir.mkdir()
+    # uninterrupted reference, same config, separate dir
+    ref_dir = tmp_path / "ref"
+    jm = JobManager(jw.build_engine("mock"), ref_dir,
+                    config=jw.job_pipeline_config("mock"),
+                    start_worker=False)
+    ref = jm.submit(jw.job_transcript())
+    jm.run_job(ref)
+    assert ref.status == "done"
+    jm.shutdown()
+
+    port = free_port()
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"mode": "serve", "port": port,
+                                "jobs_dir": str(jobs_dir)}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LMRS_FAULT_PLAN=json.dumps({"faults": [
+                   {"site": "journal.append", "every": 1,
+                    "action": "stall", "stall_s": 0.15}]}))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "_job_worker.py"), str(spec)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            if proc.poll() is not None:
+                raise RuntimeError("server died: "
+                                   + proc.stderr.read().decode()[-2000:])
+            try:
+                with urllib.request.urlopen(f"{base}/healthz", timeout=2):
+                    break
+            except OSError:
+                time.sleep(0.1)
+        status, doc = _http("POST", f"{base}/v1/jobs",
+                            {"transcript": jw.job_transcript()})
+        assert status == 200
+        jid = doc["id"]
+        assert jid == ref.job_id  # content-addressed across processes
+        wal = jobs_dir / f"{jid}.wal"
+        # kill mid-map: >=2 chunk records journaled, job not done
+        chunks_seen = _wait_for_wal(wal, "chunk_done", 2)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        state = jl.rebuild_state(jl.replay(wal)[0])
+        assert state["done"] is None, "kill landed after completion"
+        assert len(state["chunks"]) >= 2
+        del chunks_seen
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # replacement server over the same jobs dir recovers + finishes
+    srv = EngineHTTPServer(jw.build_engine("mock"), port=0,
+                           batch_window_s=0.01, jobs_dir=str(jobs_dir),
+                           pipeline_config=jw.job_pipeline_config("mock"))
+    srv.start_background()
+    try:
+        base2 = f"http://{srv.host}:{srv.port}"
+        final = _poll_job(base2, ref.job_id)
+        assert final["status"] == "done"
+        assert final["recovered"] is True
+        assert final["progress"]["num_resumed_chunks"] >= 2
+        assert final["result"]["summary"] == ref.result["summary"]
+    finally:
+        srv.shutdown()
+
+
+def _wait_for_wal(wal: Path, rec_type: str, n: int,
+                  deadline_s: float = 120.0) -> int:
+    """Poll a journal until >= n records of rec_type are durably framed."""
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        if wal.exists():
+            recs, _ = jl.replay(wal)
+            have = sum(1 for r in recs if r.get("type") == rec_type)
+            if have >= n:
+                return have
+        time.sleep(0.02)
+    raise TimeoutError(f"never saw {n} {rec_type} records in {wal}")
+
+
+def test_router_forwards_job_api(tmp_path):
+    """Fleet deployments: the front router-backed server has no local
+    JobManager — /v1/jobs forwards to the backend that owns the journal,
+    sticky by job id, and unknown ids scan the fleet."""
+    from lmrs_tpu.serving.router import RouterEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    backend = EngineHTTPServer(jw.build_engine("mock"), port=0,
+                               batch_window_s=0.01,
+                               jobs_dir=str(tmp_path / "b1"),
+                               pipeline_config=jw.job_pipeline_config("mock"))
+    backend.start_background()
+    router = RouterEngine([f"127.0.0.1:{backend.port}"])
+    front = EngineHTTPServer(router, port=0, batch_window_s=0.01)
+    front.start_background()
+    try:
+        base = f"http://{front.host}:{front.port}"
+        status, doc = _http("POST", f"{base}/v1/jobs",
+                            {"transcript": jw.job_transcript()})
+        assert status == 200
+        jid = doc["id"]
+        final = _poll_job(base, jid)
+        assert final["status"] == "done" and final["result"]["summary"]
+        _, lst = _http("GET", f"{base}/v1/jobs")
+        assert [d["id"] for d in lst["data"]] == [jid]
+        assert lst["hosts_unreachable"] == 0
+        # stickiness cache rebuilt after a router restart: a fresh router
+        # resolves the id by scanning the fleet
+        router2 = RouterEngine([f"127.0.0.1:{backend.port}"])
+        front2 = EngineHTTPServer(router2, port=0, batch_window_s=0.01)
+        front2.start_background()
+        try:
+            _, doc2 = _http("GET",
+                            f"http://{front2.host}:{front2.port}/v1/jobs/{jid}")
+            assert doc2["status"] == "done"
+        finally:
+            front2.shutdown()
+            router2.shutdown()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http("GET", f"{base}/v1/jobs/job-missing")
+        assert e.value.code == 404
+        # forwarding is counted on the router's exposition
+        req = urllib.request.Request(f"{base}/metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            text = r.read().decode()
+        assert "lmrs_router_jobs_forwarded_total" in text
+    finally:
+        front.shutdown()
+        router.shutdown()
+        backend.shutdown()

@@ -179,3 +179,68 @@ def test_summarize_many_pools_map_requests():
     assert results[0]["num_input_segments"] == 40
     assert results[1]["num_input_segments"] == 25
     assert results[0]["total_requests"] == results[1]["total_requests"]
+
+
+def test_resume_fingerprint_mismatch_drops_stale_summaries(transcript, tmp_path):
+    """ISSUE 7 satellite: a --save-chunks dump produced under a different
+    map prompt / model surface must NOT rehydrate — _load_resume compares
+    the dump's config/prompt fingerprint and drops everything on
+    mismatch (warn + drop), instead of silently mixing stale summaries
+    into the fresh run."""
+    dump = tmp_path / "chunks.json"
+    cfg = _cfg()
+    stats1 = TranscriptSummarizer(cfg).summarize(transcript,
+                                                 save_chunks=str(dump))
+    payload = json.loads(dump.read_text())
+    assert payload["fingerprint"]  # dumps are stamped now
+
+    # same config, DIFFERENT map prompt -> different fingerprint
+    stats2 = TranscriptSummarizer(cfg).summarize(
+        transcript, resume_from=str(dump),
+        prompt_template="Changed prompt {transcript}")
+    assert stats2["num_resumed_chunks"] == 0
+    assert stats2["total_requests"] >= stats1["total_requests"]
+
+    # a dump predating the fingerprint field still loads (chunk-identity
+    # match stays the only guard, as before)
+    payload.pop("fingerprint")
+    dump.write_text(json.dumps(payload))
+    stats3 = TranscriptSummarizer(cfg).summarize(transcript,
+                                                 resume_from=str(dump))
+    assert stats3["num_resumed_chunks"] == stats1["num_chunks"]
+
+
+def test_summarize_many_threads_real_resume_counts(tmp_path):
+    """ISSUE 7 satellite: summarize_many no longer hardcodes
+    num_resumed_chunks=0 — resume_from aligns per transcript, rehydrated
+    chunks skip the pooled map queue, and each stats dict reports its
+    transcript's real count."""
+    from lmrs_tpu.config import ChunkConfig, EngineConfig, PipelineConfig
+    from lmrs_tpu.pipeline import TranscriptSummarizer
+
+    def transcript(n, tag):
+        return {"segments": [
+            {"start": i * 2.0, "end": i * 2.0 + 1.5,
+             "text": f"{tag} segment {i} talks about item {i % 7}.",
+             "speaker": f"SPEAKER_0{i % 2}"}
+            for i in range(n)]}
+
+    cfg = PipelineConfig(
+        engine=EngineConfig(backend="mock", retry_delay=0.0),
+        chunk=ChunkConfig(max_tokens_per_chunk=200, tokenizer="approx"))
+    a, b = transcript(40, "alpha"), transcript(25, "beta")
+    dump = tmp_path / "alpha.json"
+    ref = TranscriptSummarizer(cfg).summarize(a, save_chunks=str(dump))
+    assert ref["num_chunks"] > 1
+
+    s = TranscriptSummarizer(cfg)
+    out = s.summarize_many([a, b], resume_from=[str(dump), None])
+    assert out[0]["num_resumed_chunks"] == ref["num_chunks"]
+    assert out[1]["num_resumed_chunks"] == 0
+    assert out[0]["summary"] and out[1]["summary"]
+    # alpha's rehydrated chunks never re-entered the pooled queue: the
+    # shared accounting only paid for beta's map + both reduce trees
+    assert out[0]["total_requests"] < ref["total_requests"] + out[1]["num_chunks"]
+
+    with pytest.raises(ValueError, match="resume_from"):
+        s.summarize_many([a, b], resume_from=[str(dump)])
