@@ -326,6 +326,21 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
         **roofline,
         **_scheduler_window(sched, metrics_before),
     })
+    # live-vs-offline agreement (ISSUE 8 acceptance): the live attribution
+    # gauges gathered DURING the timed reps against the RTT-amortized
+    # roofline probe — rel = live/offline - 1 (within ±0.05 = agreeing)
+    pa = detail.get("perf_attribution") or {}
+    cmp_block = {}
+    live_mfu = (pa.get("prefill_mfu") or {}).get("p50")
+    if live_mfu and detail.get("model_flops_utilization"):
+        cmp_block["prefill_mfu_rel"] = round(
+            live_mfu / detail["model_flops_utilization"] - 1.0, 3)
+    live_hbm = (pa.get("decode_hbm_util") or {}).get("p50")
+    if live_hbm and detail.get("hbm_bw_utilization"):
+        cmp_block["decode_hbm_rel"] = round(
+            live_hbm / detail["hbm_bw_utilization"] - 1.0, 3)
+    if cmp_block:
+        detail["live_vs_roofline"] = cmp_block
     return float(value), detail
 
 
@@ -387,6 +402,12 @@ def _scheduler_window(sched, before: dict) -> dict:
         # admissions and the prompt tokens whose prefill was skipped
         # entirely (the map preamble re-use win; engine/prefix_cache.py)
         "prefix_cache": _prefix_window(m, before),
+        # live per-phase roofline attribution (obs/perf.py): MFU / HBM
+        # utilization / step-gap percentiles from the serving path's own
+        # dispatch walls — what future BENCH_r* rounds record alongside
+        # chunks/s, and the numbers the offline roofline block above is
+        # checked against (live_vs_roofline)
+        "perf_attribution": sched.perf_attribution_report(),
     }
 
 

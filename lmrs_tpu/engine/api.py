@@ -65,6 +65,17 @@ class GenerationRequest:
     # transferred KV pages + first token instead of prefilling.
     handoff_export: bool = False
     handoff_state: dict | None = None
+    # Distributed trace id (docs/OBSERVABILITY.md § Trace propagation).
+    # Minted at INGRESS (the HTTP server anchors the ``X-LMRS-Trace``
+    # header, or mints one; the router mints for engine-protocol callers)
+    # and carried across every hop: forwards/retries resend the header,
+    # the handoff ticket + payload ride it across the prefill→decode pod
+    # boundary, and the job journal persists it so a resumed job
+    # continues its trace.  Engines key the request's span track on it
+    # (``Tracer.track_for``) so one request's spans stitch into one
+    # causal chain fleet-wide; None (engine-direct callers, the CLI
+    # pipeline) falls back to the per-run request-id track.
+    trace_id: str | None = None
 
 
 def remaining_budget(req: GenerationRequest,

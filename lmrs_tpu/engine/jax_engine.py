@@ -258,6 +258,17 @@ class JaxEngine:
         it."""
         return self._scheduler.registry if self._scheduler else None
 
+    def debug_profile(self, duration_s: float,
+                      out_dir: str) -> tuple[bool, str]:
+        """Optional Engine hook behind ``POST /v1/debug/profile``: start a
+        bounded on-demand ``jax.profiler`` capture of this process (one at
+        a time; auto-stopped).  Returns ``(ok, dir_or_reason)`` — engines
+        without device work (MockEngine) simply lack the hook and the
+        server answers 501."""
+        from lmrs_tpu.obs.perf import start_profile_capture
+
+        return start_profile_capture(out_dir, duration_s)
+
     # -------------------------------------------------------------- generate
 
     def generate_batch(self, requests: list[GenerationRequest],

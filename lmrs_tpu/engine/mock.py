@@ -25,6 +25,15 @@ from lmrs_tpu.testing import faults
 _TS_RE = re.compile(r"\[(?:\d+:)?\d{2}:\d{2}\]")
 
 
+def _mock_tid(tr, req: GenerationRequest) -> int:
+    """The request's span-track id — same rule as the scheduler's
+    ``_tid``: keyed on the propagated trace id when present (one causal
+    chain fleet-wide; the stitcher's join key), else the legacy
+    request-id track."""
+    return (tr.track_for(req.trace_id) if req.trace_id
+            else req_tid(req.request_id))
+
+
 class MockEngine:
     """Offline deterministic engine.
 
@@ -73,7 +82,10 @@ class MockEngine:
             t0 = time.time()
             res = self._one(req)
             if tr:  # minimal lifecycle: the mock has no queue or slots
-                tid = req_tid(req.request_id)
+                # the tid is resolved AFTER _one so a handoff import's
+                # adopted trace takes effect: CI's no-device disagg
+                # traces stitch end-to-end through router → mock backends
+                tid = _mock_tid(tr, req)
                 tr.complete("generate", t0, time.time(), tid=tid,
                             args={"completion_tokens": res.completion_tokens})
                 tr.instant("cancel" if res.finish_reason == "cancelled"
@@ -172,6 +184,16 @@ class MockEngine:
                     request_id=req.request_id, finish_reason="error",
                     error=f"handoff import failed: {type(e).__name__}: {e}")
             state = req.handoff_state
+            # continue the exporter's trace across the pod boundary (the
+            # same adoption rule as the scheduler's _admit_import)
+            if not req.trace_id and isinstance(state.get("trace_id"), str):
+                req.trace_id = state["trace_id"]
+            tr = get_tracer()
+            if tr:
+                tr.instant(
+                    "handoff_import", tid=_mock_tid(tr, req),
+                    args={"pages": 0,  # the mock's state is pageless text
+                          "kv_len": int(state.get("prompt_tokens", 0))})
             text = state["text"]
             return GenerationResult(
                 request_id=req.request_id,
@@ -202,10 +224,17 @@ class MockEngine:
                 payload = {"text": text, "prompt_tokens": prompt_tokens,
                            "stop_sequence": stop_hit,
                            "finish_reason": "stop"}
+                if req.trace_id:
+                    payload["trace_id"] = req.trace_id
                 with self._pinned_lock:
                     self._pinned[req.request_id] = {
                         "payload": payload,
                         "deadline_t": time.time() + self.handoff_ttl_s}
+                tr = get_tracer()
+                if tr:  # the stitcher's skew anchor on the prefill pod
+                    tr.instant(
+                        "handoff_export", tid=_mock_tid(tr, req),
+                        args={"pages": 0, "kv_len": prompt_tokens})
                 return GenerationResult(
                     request_id=req.request_id,
                     text=first,

@@ -43,8 +43,9 @@ from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
                                       audit_allocator)
 from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.models.transformer import forward_paged
-from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, MetricsRegistry,
-                          get_tracer, req_tid)
+from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS,
+                          DispatchAttribution, MetricsRegistry,
+                          dump_postmortem, get_tracer, req_tid)
 from lmrs_tpu.ops.sampling import sample_logits
 from lmrs_tpu.testing import faults
 
@@ -408,6 +409,23 @@ class ContinuousScheduler:
                                    help="device scatter of an imported "
                                         "page set at admission",
                                    unit="seconds")
+        # Live performance attribution (obs/perf.py): per-dispatch
+        # FLOPs/bytes from the roofline model, measured dispatch walls
+        # (minus host RTT) -> lmrs_prefill_mfu_ratio /
+        # lmrs_decode_hbm_util_ratio / lmrs_step_gap_ms.  Pending-flops
+        # bookkeeping: prefill dispatches issued this iteration are
+        # sequenced on device before the decode block that fetches their
+        # tok0s, so their model FLOPs are attributed to that block's wall.
+        self._perf = DispatchAttribution(model_cfg, engine_cfg,
+                                         self.registry)
+        self._attr_pending_flops = 0.0
+        self._attr_prefill_cold = False  # a compiling shape in the wave
+        self._attr_last_gb = 0.0  # last block's model bytes (span arg)
+        # LMRS_PROFILE_ON_SLOW_STEP: a decode block slower than the
+        # threshold (warm shapes only) triggers ONE jax.profiler capture
+        # per process into LMRS_PROFILE_DIR — the "why was that step
+        # slow" hook that needs no redeploy
+        self._slow_step_fired = False
 
     @property
     def metrics(self) -> dict:
@@ -445,6 +463,54 @@ class ContinuousScheduler:
         ``metrics_report()``, for Prometheus exposition (serving/server.py
         content-negotiates ``GET /metrics`` over it)."""
         return self.registry
+
+    def perf_attribution_report(self) -> dict:
+        """Live per-phase roofline attribution (obs/perf.py) — the
+        ``perf_attribution`` block of metrics_report() and bench detail."""
+        return self._perf.report()
+
+    def _tid(self, req: GenerationRequest) -> int:
+        """The request's span-track id: keyed on its distributed trace id
+        when it carries one (one causal chain fleet-wide, stable across
+        pods and run epochs) — else the legacy per-run request-id track.
+        Call only under an ``if self._tr:`` guard."""
+        if req.trace_id:
+            return self._tr.track_for(req.trace_id)
+        return req_tid(req.request_id)
+
+    def _consume_prefill_attr(self) -> tuple[float, bool]:
+        """Take (and reset) the pending prefill-FLOPs attribution: the
+        model FLOPs of every prefill dispatch issued since the last
+        consumption, plus whether any of them was a compiling (cold)
+        shape — cold waves never produce MFU samples."""
+        flops, cold = self._attr_pending_flops, self._attr_prefill_cold
+        self._attr_pending_flops = 0.0
+        self._attr_prefill_cold = False
+        return flops, cold
+
+    def _maybe_profile_slow_step(self, wall_s: float, warm: bool) -> None:
+        """LMRS_PROFILE_ON_SLOW_STEP trigger: the first WARM decode block
+        slower than the threshold starts one bounded jax.profiler capture
+        (LMRS_PROFILE_DIR, default <tmp>/lmrs_profile) — once per
+        process, so a persistently slow engine cannot profile forever."""
+        if self._slow_step_fired:
+            return
+        from lmrs_tpu.obs.perf import (default_profile_dir,
+                                       slow_step_threshold_s,
+                                       start_profile_capture)
+
+        thresh = slow_step_threshold_s()
+        if not thresh or not warm or wall_s <= thresh:
+            return
+        self._slow_step_fired = True
+        try:
+            dur = float(os.environ.get("LMRS_PROFILE_CAPTURE_S", "3") or 3)
+        except ValueError:
+            dur = 3.0
+        ok, msg = start_profile_capture(default_profile_dir(), dur)
+        logger.warning("slow decode block (%.3fs > %.3fs threshold): "
+                       "profiler capture %s (%s)", wall_s, thresh,
+                       "started" if ok else "NOT started", msg)
 
     def _timed_get(self, x):
         """``jax.device_get`` with the blocking wait charged to the
@@ -488,6 +554,7 @@ class ContinuousScheduler:
             "ttft_ms": self._h_ttft.percentile_report(),
             "decode_block_gap_ms": self._h_block_gap.percentile_report(),
             "queue_wait_ms": self._h_queue_wait.percentile_report(),
+            "perf_attribution": self._perf.report(),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
             **({"prefix_cache": self._prefix_cache_report()}
@@ -520,6 +587,11 @@ class ContinuousScheduler:
         self._h_ttft.reset()
         self._h_block_gap.reset()
         self._h_queue_wait.reset()
+        # live-attribution distributions ride the same warmup isolation
+        # (the totals counters stay cumulative, like every counter here)
+        self._perf.h_mfu.reset()
+        self._perf.h_hbm.reset()
+        self._perf.h_gap.reset()
 
     def _pick_kernel(self) -> bool:
         from lmrs_tpu.utils.platform import on_tpu
@@ -637,7 +709,7 @@ class ContinuousScheduler:
                     self._any_deadline = True
                 if tr:
                     tr.instant("enqueue", ts=t_enq[req.request_id],
-                               tid=req_tid(req.request_id),
+                               tid=self._tid(req),
                                args={"prompt_tokens": len(ids)})
 
         fresh: deque[int] = deque()  # completed rids awaiting delivery
@@ -647,7 +719,7 @@ class ContinuousScheduler:
             t_enq[req.request_id] = time.time()
             if tr:
                 tr.instant("enqueue", ts=t_enq[req.request_id],
-                           tid=req_tid(req.request_id),
+                           tid=self._tid(req),
                            args={"prompt_tokens": len(ids)})
 
         slots: list[_SlotState | None] = [None] * self.B
@@ -766,13 +838,15 @@ class ContinuousScheduler:
                 if t_q is not None and t0 is None:
                     self._h_queue_wait.observe(now - t_q)
                     if tr:
-                        tr.complete("queue_wait", t_q, now, tid=req_tid(rid))
+                        tr.complete("queue_wait", t_q, now,
+                                    tid=self._tid(req))
                 if tr:
-                    tr.instant("admit", ts=now, tid=req_tid(rid),
+                    tr.instant("admit", ts=now, tid=self._tid(req),
                                args={"slot": b,
                                      "continuation": t0 is not None})
                     if cached_tokens:
-                        tr.instant("prefix_match", ts=now, tid=req_tid(rid),
+                        tr.instant("prefix_match", ts=now,
+                                   tid=self._tid(req),
                                    args={"tokens_reused": cached_tokens})
                 # a cache hit enters the existing chunked-prefill machinery
                 # at the match boundary: the first chunk dispatches as a
@@ -837,6 +911,7 @@ class ContinuousScheduler:
                 # stays on device, is scattered into the decode dispatch's
                 # last_tok input, and rides back in the decode block's single
                 # device_get — one fewer ~full-RTT host sync per admission wave.
+                t_pf = time.time()  # prefill-wave dispatch-issue anchor
                 pending = self._advance_prefills(slots)
                 deferred: list[tuple[int, int, int]] = []  # (slot, pend idx, row)
                 for p, (tok0_dev, rows) in enumerate(pending):
@@ -847,7 +922,7 @@ class ContinuousScheduler:
                         if tr:
                             tr.complete(
                                 "prefill", st.t_admit, st.t_decode_start,
-                                tid=req_tid(st.req.request_id),
+                                tid=self._tid(st.req),
                                 args={"prompt_tokens": len(st.prompt_ids)})
                         st.kv_len = len(st.prompt_ids)
                         kv_lens[b] = st.kv_len
@@ -870,6 +945,12 @@ class ContinuousScheduler:
                     # pod never burns a decode-block dispatch on tokens the
                     # handoff would trim anyway.
                     fetched = self._timed_get([t for t, _ in pending])
+                    # clean prefill MFU sample: the wall from dispatch
+                    # issue to this fetch covers exactly the prefill
+                    # compute (+1 RTT) — the prefill pod's whole life
+                    flops, cold = self._consume_prefill_attr()
+                    self._perf.note_prefill_sync(flops, t_pf, time.time(),
+                                                 warm=not cold)
                     for (b, p, row) in deferred:
                         st = slots[b]
                         tok0 = int(fetched[p][row])
@@ -895,6 +976,10 @@ class ContinuousScheduler:
                         # now — a stalled slot's tok0 is real output and must
                         # not be dropped (preempted slots resample theirs)
                         fetched = self._timed_get([t for t, _ in pending])
+                        flops, cold = self._consume_prefill_attr()
+                        self._perf.note_prefill_sync(flops, t_pf,
+                                                     time.time(),
+                                                     warm=not cold)
                         for (b, p, row) in deferred:
                             if slots[b] is None:
                                 continue
@@ -952,22 +1037,25 @@ class ContinuousScheduler:
                     block_tokens += len(new)
                     if tr and new:
                         tr.instant("decode_block", ts=now,
-                                   tid=req_tid(st.req.request_id),
+                                   tid=self._tid(st.req),
                                    args={"tokens": len(new)})
                     self._maybe_finish(b, slots, results, active, fresh,
                                        kv_lens, last_tok)
                 if tr:
                     # scheduler-track span: dispatch issue through host-side
                     # result processing; start timestamps are the former
-                    # LMRS_TRACE_DISPATCH list (Tracer.timestamps)
+                    # LMRS_TRACE_DISPATCH list (Tracer.timestamps).
+                    # hbm_gb = the block's model byte cost (perf
+                    # attribution; 0 for spec blocks, whose model differs)
                     tr.complete("decode_block", now, time.time(),
                                 args={"active": n_live,
-                                      "tokens": block_tokens})
+                                      "tokens": block_tokens,
+                                      "hbm_gb": self._attr_last_gb})
                 for b in stalled:  # stalled rows rejoin the next dispatch
                     if slots[b] is not None:
                         active[b] = True
 
-        except Exception:
+        except Exception as run_exc:
             # Dispatch/step failure mid-run.  The exception re-raises —
             # every caller (MapExecutor, the HTTP batcher) already
             # translates engine exceptions into per-request error results —
@@ -977,6 +1065,15 @@ class ContinuousScheduler:
             # (a failed DONATED dispatch leaves k/v consumed), and the
             # prefix cache — whose pages point into the discarded pool
             # content — drops its retained nodes.
+            # Flight recorder FIRST (obs/flight.py): the postmortem must
+            # capture the metrics/spans AS THE FAULT LEFT THEM, before
+            # recovery rewrites the pool state.  No-op unless
+            # LMRS_POSTMORTEM_DIR is armed; never raises.
+            dump_postmortem(
+                "dispatch_fault", metrics=self.metrics,
+                extra={"error": f"{type(run_exc).__name__}: {run_exc}",
+                       "live_slots": sum(s is not None for s in slots),
+                       "queued": len(queue)})
             for b in range(self.B):
                 if slots[b] is not None:
                     try:
@@ -1077,7 +1174,7 @@ class ContinuousScheduler:
                 self._c_cancelled.inc()
                 if self._tr:  # cancelled while still queued: no spans open
                     self._tr.instant("cancel",
-                                     tid=req_tid(req.request_id),
+                                     tid=self._tid(req),
                                      args={"state": "queued"})
         for b in range(self.B):
             st = slots[b]
@@ -1143,7 +1240,7 @@ class ContinuousScheduler:
         fresh.append(req.request_id)
         (self._c_deadline if continuation else self._c_shed).inc()
         if self._tr:
-            self._tr.instant(reason, tid=req_tid(req.request_id),
+            self._tr.instant(reason, tid=self._tid(req),
                              args={"queued": True})
 
     def _sweep_deadlines(self, queue, slots, results, active, fresh,
@@ -1155,10 +1252,12 @@ class ContinuousScheduler:
         The WHOLE queue is scanned, not just the head: an entry stuck
         behind back-pressure must not have to reach the head to expire."""
         now = time.time()
+        expired = 0
         for i in range(len(queue) - 1, -1, -1):
             req = queue[i][0]
             if req.deadline_s is not None and req.deadline_s <= now:
                 self._expire_queue_entry(queue, i, results, fresh)
+                expired += 1
         for b in range(self.B):
             st = slots[b]
             if (st is None or st.req.deadline_s is None
@@ -1168,8 +1267,22 @@ class ContinuousScheduler:
             self._finish_slot(b, slots, results, active, fresh, kv_lens,
                               last_tok, gen, text, stop_hit, "deadline")
             self._c_deadline.inc()
+            expired += 1
             logger.debug("request %d expired in flight (slot %d)",
                          st.req.request_id, b)
+        # deadline-expiry STORM: one sweep reaping >= LMRS_DEADLINE_STORM
+        # requests (default 3) means the pod is converting overload into
+        # expired work — freeze the evidence (no-op when the flight
+        # recorder is unarmed)
+        if expired:
+            try:
+                storm = int(os.environ.get("LMRS_DEADLINE_STORM", "3") or 3)
+            except ValueError:
+                storm = 3
+            if storm > 0 and expired >= storm:
+                dump_postmortem("deadline_storm", metrics=self.metrics,
+                                extra={"expired_this_sweep": expired,
+                                       "queued": len(queue)})
 
     # ---------------------------------------------------------------- audit
 
@@ -1212,6 +1325,11 @@ class ContinuousScheduler:
             violations.append(f"{self._audit_double_finish} result "
                               "record(s) overwrote an existing result "
                               "(termination-exactly-once broken)")
+        if violations:
+            # an invariant break is exactly the moment the last-N spans
+            # and counters matter; no-op unless the recorder is armed
+            dump_postmortem("audit_failure", metrics=self.metrics,
+                            extra={"violations": violations})
         return violations
 
     def _trimmed_output(self, st: _SlotState):
@@ -1239,7 +1357,7 @@ class ContinuousScheduler:
             self._h_ttft.observe(now - t0)
             if self._tr:
                 self._tr.instant("first_token", ts=now,
-                                 tid=req_tid(st.req.request_id))
+                                 tid=self._tid(st.req))
 
     def _trim_tokens(self, gen: list[int], max_new: int, stop):
         gen = gen[:max_new]
@@ -1268,7 +1386,7 @@ class ContinuousScheduler:
             device_seconds=now - st.t_start,
         ))
         if self._tr:
-            tid = req_tid(st.req.request_id)
+            tid = self._tid(st.req)
             if st.t_decode_start:  # close the decode span of this slot life
                 self._tr.complete("decode", st.t_decode_start, now, tid=tid,
                                   args={"completion_tokens": len(gen)})
@@ -1347,6 +1465,11 @@ class ContinuousScheduler:
         payload["tokens"] = [int(t) for t in st.prompt_ids]
         payload["generated"] = [int(t) for t in gen]
         payload["n_prompt"] = st.n_prompt
+        # the trace rides the payload across the pod boundary: the decode
+        # pod's import continues this request's span chain under the SAME
+        # trace id even when the ticket is followed without the router
+        if st.req.trace_id:
+            payload["trace_id"] = st.req.trace_id
         # budget-overshoot pages (decode-capacity growth past the prompt)
         # are NOT part of the handoff — release them before pinning
         if len(st.seq.pages) > keep:
@@ -1369,7 +1492,7 @@ class ContinuousScheduler:
             completion_tokens=len(gen), finish_reason="handoff",
             device_seconds=now - st.t_start))
         if self._tr:
-            tid = req_tid(rid)
+            tid = self._tid(st.req)
             if st.t_decode_start:
                 self._tr.complete("decode", st.t_decode_start, now, tid=tid,
                                   args={"completion_tokens": len(gen)})
@@ -1425,7 +1548,10 @@ class ContinuousScheduler:
             logger.warning("handoff %d orphaned: %d pinned pages reclaimed",
                            request_id, n)
         if self._tr:
-            self._tr.instant("handoff_release", tid=req_tid(request_id),
+            trace = rec["payload"].get("trace_id")
+            tid = (self._tr.track_for(trace) if trace
+                   else req_tid(request_id))
+            self._tr.instant("handoff_release", tid=tid,
                              args={"pages": n, "orphaned": orphaned})
         return n
 
@@ -1474,6 +1600,12 @@ class ContinuousScheduler:
         retry, and the pool stays clean either way."""
         req, ids, max_new, n_prompt, prior, t0 = queue[0]
         state = req.handoff_state
+        # continue the exporter's trace: the payload carries the trace id
+        # across the pod boundary, so the decode-side spans land on the
+        # SAME fleet-wide chain (a request arriving with its own id —
+        # the router re-sent the header — keeps it; they are equal anyway)
+        if not req.trace_id and isinstance(state.get("trace_id"), str):
+            req.trace_id = state["trace_id"]
         try:
             need = int(state.get("n_pages", 0) or 0)
         except (TypeError, ValueError):
@@ -1588,7 +1720,7 @@ class ContinuousScheduler:
         self._g_peak_slots.track_max(sum(s is not None for s in slots))
         if self._tr:
             self._tr.instant("handoff_import", ts=now,
-                             tid=req_tid(req.request_id),
+                             tid=self._tid(req),
                              args={"slot": b, "kv_len": kv_len,
                                    "pages": len(seq.pages)})
         # stream the already-generated first token immediately (the slot
@@ -1954,7 +2086,7 @@ class ContinuousScheduler:
         self._c_preemptions.inc()
         if self._tr:
             now = time.time()
-            tid = req_tid(st.req.request_id)
+            tid = self._tid(st.req)
             if st.t_decode_start:  # close this slot life's decode span
                 self._tr.complete("decode", st.t_decode_start, now, tid=tid,
                                   args={"preempted": True})
@@ -2120,12 +2252,19 @@ class ContinuousScheduler:
                 self._c_prefill_tokens.inc(len(chunk))
             batch_tokens = sum(len(c) for _, _, c, _, _ in items)
             self._h_prefill_batch.observe(batch_tokens)
+            # roofline attribution: real-token FLOPs of this dispatch
+            # (window chunks additionally attend their cached prefix),
+            # consumed by whichever block fetches the wave's results
+            flops = sum(self._perf.prefill_flops(len(c), kv_start=p)
+                        for _, _, c, p, _ in items)
+            self._attr_pending_flops += flops
             if self._tr:
                 self._tr.instant("prefill_dispatch",
                                  args={"rows": len(items),
                                        "tokens": batch_tokens,
                                        "bucket": s_bucket,
-                                       "fresh": bool(fresh)})
+                                       "fresh": bool(fresh),
+                                       "flops_g": round(flops / 1e9, 3)})
             self._key, sub = jax.random.split(self._key)
             args = (
                 self.params, self.cache.k, self.cache.v,
@@ -2135,6 +2274,8 @@ class ContinuousScheduler:
                 jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
             )
             key_ = ("prefill", fresh, s_bucket, w, ring)
+            if key_ not in self._ran_ok:
+                self._attr_prefill_cold = True  # compiling: no MFU sample
             try:
                 fn = (self._get_prefill_fn(s_bucket, use_ring=ring) if fresh
                       else self._get_prefill_window_fn(s_bucket, w))
@@ -2226,10 +2367,13 @@ class ContinuousScheduler:
             self._c_prefill_tokens.inc(n)
             off += n
         self._h_prefill_batch.observe(s_real)
+        flops = sum(self._perf.prefill_flops(len(c)) for _, _, c in items)
+        self._attr_pending_flops += flops
         if self._tr:
             self._tr.instant("prefill_dispatch",
                              args={"rows": len(items), "tokens": s_real,
-                                   "bucket": s_bucket, "packed": True})
+                                   "bucket": s_bucket, "packed": True,
+                                   "flops_g": round(flops / 1e9, 3)})
         self._key, sub = jax.random.split(self._key)
         args = (
             self.params, self.cache.k, self.cache.v,
@@ -2240,6 +2384,8 @@ class ContinuousScheduler:
             jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
         )
         key_ = ("packed", s_bucket)
+        if key_ not in self._ran_ok:
+            self._attr_prefill_cold = True  # compiling: no MFU sample
         try:
             tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
                 self._get_packed_prefill_fn(s_bucket)(*args)
@@ -2406,6 +2552,10 @@ class ContinuousScheduler:
         fetched together with the block's outputs in the one device_get."""
         w, table = self._decode_window(slots, self.decode_block)
         B = self.B
+        # attribution inputs, taken from the caller's FULL slot arrays
+        # before any compaction/permutation below rewrites them
+        attr_live_rows = int(np.sum(active))
+        attr_live_tokens = int(np.sum(kv_lens[active]))
         # Compact-batch drain: the decode program's cost scales with its
         # batch dim even for masked rows, so when few slots are live (queue
         # drained, reduce-tree tails) gather the live rows into one fixed
@@ -2490,6 +2640,8 @@ class ContinuousScheduler:
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
+        decode_warm = ("decode", bc, w) in self._ran_ok
+        t_disp = time.time()
         try:
             out = self._get_decode_fn(w)(*args)
         except Exception:
@@ -2510,6 +2662,17 @@ class ContinuousScheduler:
         toks, n_valid, *tok0s = self._timed_get(  # one transfer
             (toks, n_valid, *[t for t, _ in pending]))
         toks, n_valid = np.asarray(toks), np.asarray(n_valid)
+        t_done = time.time()
+        # live roofline attribution: the fetch above waited out this
+        # block's device work (plus any same-iteration prefill sequenced
+        # before it — its FLOPs are pending and charged here)
+        flops, cold_pf = self._consume_prefill_attr()
+        self._attr_last_gb = round(self._perf.note_block(
+            t_disp, t_done, self.decode_block, attr_live_rows,
+            attr_live_tokens, flops,
+            warm=decode_warm and not cold_pf) / 1e9, 3)
+        self._maybe_profile_slow_step(t_done - t_disp,
+                                      decode_warm and not cold_pf)
         if bc < B or perm is not None:
             # scatter compact and/or group-permuted results back to
             # full-width slot arrays (srows maps dispatch row -> slot;
@@ -2619,6 +2782,7 @@ class ContinuousScheduler:
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
+        t_disp = time.time()
         try:
             out = self._get_spec_decode_fn(w)(*args)
         except Exception:
@@ -2635,6 +2799,15 @@ class ContinuousScheduler:
         self._ran_ok.add(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out
         toks, counts = self._timed_get((toks, counts))  # one transfer
+        # spec blocks contribute step gaps but no byte/FLOP samples (the
+        # verify-step byte model differs); pending prefill FLOPs are
+        # consumed — still counted, never sampled — so they cannot
+        # mis-attribute to a later plain block
+        self._perf.note_gap(t_disp, time.time())
+        flops, _ = self._consume_prefill_attr()
+        if flops > 0:
+            self._perf.c_flops.inc(flops)
+        self._attr_last_gb = 0.0
         emitted: list[list[int]] = []
         for b in range(self.B):
             row: list[int] = []

@@ -47,7 +47,11 @@ from lmrs_tpu.testing import faults
 logger = logging.getLogger("lmrs.jobs.journal")
 
 # record types the manager writes (unknown types are ignored on replay —
-# forward compatibility for journals written by a newer build)
+# forward compatibility for journals written by a newer build).
+# REC_HEADER fields: job_id, fingerprint, transcript_sha, created_t,
+# trace_id (the job's distributed trace — recovery restores it so a
+# resumed job continues the trace it started under; pre-trace journals
+# simply lack the key), and a superseding header adds num_chunks.
 REC_HEADER = "job_header"
 REC_CHUNK = "chunk_done"
 REC_NODE = "reduce_node_done"

@@ -89,6 +89,11 @@ class Job:
     status: str = "queued"
     created_t: float = field(default_factory=time.time)
     recovered: bool = False
+    # distributed trace id (docs/OBSERVABILITY.md § Trace propagation):
+    # minted (or taken from the submit's X-LMRS-Trace header) at submit,
+    # persisted in the journal header, restored by recover() — a resumed
+    # job CONTINUES its trace instead of starting an anonymous one
+    trace_id: str | None = None
     # progress (GET /v1/jobs/<id> partial-progress contract)
     n_chunks: int = 0
     chunks_done: int = 0
@@ -211,12 +216,16 @@ class JobManager:
 
     # ------------------------------------------------------------- public
 
-    def submit(self, transcript_data: dict, params: dict | None = None) -> Job:
+    def submit(self, transcript_data: dict, params: dict | None = None,
+               trace_id: str | None = None) -> Job:
         """Persist + queue a job; returns immediately (POST /v1/jobs).
         Content-addressed: an identical (transcript, params) submit
         returns the existing job — live jobs dedupe, terminal
         failed/cancelled jobs re-queue on the SAME journal so the retry
-        resumes everything already journaled."""
+        resumes everything already journaled.  ``trace_id`` (the submit
+        header) is persisted in the journal header so the job's trace
+        survives restarts; a duplicate submit keeps the FIRST trace (the
+        journal is the truth)."""
         params = self._sanitize_params(params)
         fp = self._fingerprint(params)
         jid = jl.job_id_for(transcript_data, fp)
@@ -254,6 +263,10 @@ class JobManager:
                 self._c_submitted.inc()
                 self._g_active.set(self._active_count())
                 fresh = True
+            if job.trace_id is None:
+                from lmrs_tpu.obs import new_trace_id
+
+                job.trace_id = trace_id or new_trace_id()
         # Disk I/O OUTSIDE the lock: the fsync'd header append must not
         # serialize every get()/jobs()/stats() reader behind the disk.  A
         # concurrent duplicate submit finds the registered job and returns
@@ -274,6 +287,7 @@ class JobManager:
                 self._append(job, {
                     "type": jl.REC_HEADER, "job_id": jid, "fingerprint": fp,
                     "transcript_sha": jl.job_id_for(transcript_data, ""),
+                    "trace_id": job.trace_id,
                     "created_t": job.created_t})
         except Exception as e:
             # the registered-but-unqueued job must not linger "queued"
@@ -285,7 +299,8 @@ class JobManager:
             raise
         tr = get_tracer()
         if tr:
-            tr.instant("job_submit", pid=PID_PIPELINE, args={"job": jid})
+            tr.instant("job_submit", pid=PID_PIPELINE,
+                       args={"job": jid, "trace": job.trace_id})
         self._queue.put(jid)
         return job
 
@@ -355,6 +370,12 @@ class JobManager:
                 job = self._register(jid, req.get("params") or {}, fp)
                 job.journal = jl.Journal(job.wal_path)
                 job.recovered = True
+                # a resumed job CONTINUES its trace: the header's id was
+                # minted at the original submit (pre-trace journals just
+                # start a fresh trace here)
+                header_trace = (state["header"] or {}).get("trace_id")
+                if isinstance(header_trace, str) and header_trace:
+                    job.trace_id = header_trace
                 if state["done"] is not None:
                     self._finish_from_record(job, state["done"])
                     continue
@@ -364,7 +385,7 @@ class JobManager:
             tr = get_tracer()
             if tr:
                 tr.instant("job_recover", pid=PID_PIPELINE,
-                           args={"job": jid})
+                           args={"job": jid, "trace": job.trace_id})
             logger.info("job %s: interrupted journal found; re-queued "
                         "(%d chunk record(s), %d reduce node(s))", jid,
                         len(state["chunks"]), len(state["nodes"]))
@@ -387,6 +408,7 @@ class JobManager:
             "status": job.status,
             "created_t": job.created_t,
             "recovered": job.recovered,
+            "trace_id": job.trace_id,
             "progress": {
                 "num_chunks": job.n_chunks,
                 "chunks_done": job.chunks_done,
@@ -548,7 +570,8 @@ class JobManager:
         if state["header"] is None:
             self._append(job, {
                 "type": jl.REC_HEADER, "job_id": job.job_id,
-                "fingerprint": job.fingerprint, "created_t": job.created_t})
+                "fingerprint": job.fingerprint, "created_t": job.created_t,
+                "trace_id": job.trace_id})
 
         transcript = json.loads(job.req_path.read_text("utf-8"))["transcript"]
         params = job.params
@@ -600,7 +623,8 @@ class JobManager:
             if tr:
                 tr.instant("job_resume", pid=PID_PIPELINE,
                            args={"job": job.job_id, "resumed_chunks": resumed,
-                                 "journaled_nodes": len(state["nodes"])})
+                                 "journaled_nodes": len(state["nodes"]),
+                                 "trace": job.trace_id})
             logger.info("job %s: resumed %d/%d chunk summaries and %d "
                         "reduce node(s) from the journal", job.job_id,
                         resumed, len(chunks), len(state["nodes"]))
@@ -747,7 +771,8 @@ class JobManager:
         tr = get_tracer()
         if tr:
             tr.instant("job_done", pid=PID_PIPELINE,
-                       args={"job": job.job_id, "status": status})
+                       args={"job": job.job_id, "status": status,
+                             "trace": job.trace_id})
         logger.info("job %s: %s (%d/%d chunks, %d failed, %d resumed, "
                     "%d node(s) reused)", job.job_id, status,
                     job.chunks_done, job.n_chunks, job.chunks_failed,

@@ -1,11 +1,20 @@
-"""Unified telemetry: lifecycle tracing + metric registry + exposition.
+"""Unified telemetry: lifecycle tracing + metric registry + exposition,
+cross-host trace stitching, live perf attribution, flight recorder.
 
 Dependency-free (stdlib + numpy).  See docs/OBSERVABILITY.md for the
-metric catalog and how to open an exported trace in Perfetto.
+metric catalog, the stitching/skew-alignment method, and how to open an
+exported trace in Perfetto.
 """
 
+from lmrs_tpu.obs.flight import (
+    POSTMORTEM_SCHEMA,
+    dump_postmortem,
+    postmortem_dir,
+    validate_postmortem_file,
+)
 from lmrs_tpu.obs.metrics import (
     DEFAULT_LATENCY_BUCKETS_S,
+    MS_LATENCY_BUCKETS,
     POW2_TOKEN_BUCKETS,
     RATIO_BUCKETS,
     Counter,
@@ -16,26 +25,41 @@ from lmrs_tpu.obs.metrics import (
     log_buckets,
     merge_expositions,
 )
+from lmrs_tpu.obs.perf import (
+    DispatchAttribution,
+    profile_capture_active,
+    start_profile_capture,
+)
 from lmrs_tpu.obs.trace import (
     PID_ENGINE,
     PID_PIPELINE,
+    PID_STITCH,
     TID_SCHED,
+    TRACE_TRACK_PREFIX,
     Tracer,
     disable_tracing,
     enable_tracing,
     export_current,
     get_tracer,
+    new_trace_id,
     req_tid,
+    stitch_traces,
+    stitched_chains,
     validate_trace_events,
     validate_trace_file,
 )
 
 __all__ = [
-    "DEFAULT_LATENCY_BUCKETS_S", "POW2_TOKEN_BUCKETS", "RATIO_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS_S", "MS_LATENCY_BUCKETS", "POW2_TOKEN_BUCKETS",
+    "RATIO_BUCKETS",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "add_label_to_exposition", "log_buckets", "merge_expositions",
-    "PID_ENGINE", "PID_PIPELINE", "TID_SCHED", "Tracer",
+    "DispatchAttribution", "profile_capture_active", "start_profile_capture",
+    "POSTMORTEM_SCHEMA", "dump_postmortem", "postmortem_dir",
+    "validate_postmortem_file",
+    "PID_ENGINE", "PID_PIPELINE", "PID_STITCH", "TID_SCHED",
+    "TRACE_TRACK_PREFIX", "Tracer",
     "disable_tracing", "enable_tracing", "export_current", "get_tracer",
-    "req_tid",
+    "new_trace_id", "req_tid", "stitch_traces", "stitched_chains",
     "validate_trace_events", "validate_trace_file",
 ]

@@ -39,6 +39,14 @@ POW2_TOKEN_BUCKETS: tuple[float, ...] = tuple(float(2 ** i) for i in range(4, 17
 # occupancy/utilization ratios are bounded [0, 1]: linear tenths, not log
 RATIO_BUCKETS: tuple[float, ...] = tuple(round(i / 10.0, 1) for i in range(1, 11))
 
+# millisecond-valued histograms (lmrs_step_gap_ms): 0.1 ms (a warm host
+# turnaround) .. 50 s (a wedged dispatch), one-two-five per decade.  The
+# values are OBSERVED in ms, so the Prometheus _sum stays in the unit the
+# metric name promises.
+MS_LATENCY_BUCKETS: tuple[float, ...] = tuple(
+    round(m * 10.0 ** e, 6) for e in range(-1, 4) for m in (1.0, 2.5, 5.0)
+) + (50000.0,)
+
 _SAMPLE_CAP = 200_000  # same bound (drop oldest half) as the old raw lists
 
 

@@ -61,16 +61,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "/v1/jobs): write-ahead journals live here and "
                         "interrupted jobs resume on startup (default: "
                         "LMRS_JOBS_DIR; unset disables — 501)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable the in-process lifecycle tracer; GET "
+                        "/v1/trace then serves this host's span ring "
+                        "(Chrome-trace JSON) for the router-side fleet "
+                        "stitcher (also: LMRS_TRACE=1)")
     p.add_argument("--quiet", "-q", action="store_true")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
     setup_logging(quiet=args.quiet)
     from lmrs_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
+    if args.trace or os.environ.get("LMRS_TRACE", "") not in ("", "0"):
+        # before the engine builds: the scheduler captures the tracer per
+        # run, and serving spans must cover the first request
+        from lmrs_tpu.obs import enable_tracing
+
+        enable_tracing()
     engine_cfg = EngineConfig(
         backend=args.backend,
         model=args.model,

@@ -114,11 +114,16 @@ class TicketRegistry:
         self._lock = threading.Lock()
         self._tickets: dict[str, dict] = {}
 
-    def create(self, request_id: int, deadline_t: float) -> str:
+    def create(self, request_id: int, deadline_t: float,
+               trace_id: str | None = None) -> str:
+        """Publish a ticket.  ``trace_id`` rides the record (and the
+        ticket descriptor the serving layer returns) so the prefill→
+        decode hop stays on one distributed trace even for clients that
+        follow the ticket without the router."""
         tid = uuid.uuid4().hex
         with self._lock:
             self._tickets[tid] = {"rid": request_id, "deadline_t": deadline_t,
-                                  "consumed": False,
+                                  "consumed": False, "trace_id": trace_id,
                                   "created_t": self._clock()}
         return tid
 

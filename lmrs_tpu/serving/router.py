@@ -48,6 +48,7 @@ from urllib.parse import urlsplit
 
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  drain_with_callback, remaining_budget)
+from lmrs_tpu.obs import new_trace_id, stitch_traces
 from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.router")
@@ -390,10 +391,59 @@ class RouterEngine:
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
 
+    # ------------------------------------------------------ trace stitching
+
+    def stitched_trace(self) -> dict:
+        """Pull every backend's ``GET /v1/trace`` page, clock-align, and
+        merge into ONE Perfetto document (obs.stitch_traces): per-host
+        tracks under remapped pids plus a synthesized per-trace-id track
+        where a disaggregated request reads as a single causal chain.
+        Hosts that are down or not tracing stay visible in the returned
+        ``stitch.unreachable`` list instead of silently vanishing.
+        Served by a fronting EngineHTTPServer as its own ``/v1/trace``.
+
+        Control-plane like ``_job_call``: bare connections, short
+        timeout, concurrent on the dispatch pool — a serial pull would
+        stack connect timeouts across a partitioned fleet."""
+        def fetch(h: _Host):
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(h.netloc, timeout=5.0)
+                conn.request("GET", "/v1/trace")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    logger.debug("trace fetch from %s: HTTP %d",
+                                 h.netloc, resp.status)
+                    return None
+                return json.loads(resp.read())
+            except Exception as e:  # noqa: BLE001 - best-effort per host
+                logger.debug("trace fetch failed for %s: %s: %s",
+                             h.netloc, type(e).__name__, e)
+                return None
+            finally:
+                if conn is not None:
+                    conn.close()
+
+        futures = [(h, self._pool.submit(fetch, h)) for h in self.hosts]
+        pages: list[tuple[str, dict]] = []
+        unreachable: list[str] = []
+        for h, fut in futures:
+            try:
+                doc = fut.result(timeout=10.0)
+            except Exception:  # noqa: BLE001 - pool saturation/timeout
+                doc = None
+            if doc is None:
+                unreachable.append(h.netloc)
+            else:
+                pages.append((h.netloc, doc))
+        merged = stitch_traces(pages)
+        merged["stitch"]["unreachable"] = unreachable
+        return merged
+
     # ------------------------------------------------------- job forwarding
 
-    def job_request(self, method: str, path: str,
-                    body: dict | None) -> tuple[int, dict]:
+    def job_request(self, method: str, path: str, body: dict | None,
+                    trace_id: str | None = None) -> tuple[int, dict]:
         """Forward one /v1/jobs call to the backend fleet (the front
         server's ``_job_http`` delegates here when it has no local
         JobManager).  Placement is STICKY: a submit hashes its transcript
@@ -419,7 +469,8 @@ class RouterEngine:
                 if not host.healthy and k < len(ring) - 1:
                     continue  # same optimism as _targets: try someone
                 try:
-                    status, payload = self._job_call(host, method, path, body)
+                    status, payload = self._job_call(host, method, path,
+                                                     body, trace_id)
                 except Exception as e:  # noqa: BLE001 - next host
                     host.failed += 1
                     last = (502, {"error": {
@@ -506,7 +557,8 @@ class RouterEngine:
                 self._job_hosts.pop(next(iter(self._job_hosts)))
 
     def _job_call(self, host: _Host, method: str, path: str,
-                  body: dict | None) -> tuple[int, dict]:
+                  body: dict | None,
+                  trace_id: str | None = None) -> tuple[int, dict]:
         """One forwarded job call.  A bare connection on purpose (like
         probes): the control plane must not consume the request path's
         ``router.connect`` fault occurrences — chaos plans stay replayable.
@@ -514,10 +566,13 @@ class RouterEngine:
         immediately, GET is a status read) — a sequential fleet scan must
         not hold an HTTP handler thread 30 s per partitioned host."""
         conn = http.client.HTTPConnection(host.netloc, timeout=10.0)
+        headers = {"Content-Type": "application/json"}
+        if trace_id:
+            headers["X-LMRS-Trace"] = trace_id
         try:
             conn.request(method, path,
                          body=None if body is None else json.dumps(body),
-                         headers={"Content-Type": "application/json"})
+                         headers=headers)
             resp = conn.getresponse()
             raw = resp.read()
             try:
@@ -605,6 +660,12 @@ class RouterEngine:
 
     def _one(self, i: int, req: GenerationRequest, on_tokens,
              cancelled: set[int]) -> GenerationResult:
+        # trace ingress for engine-protocol callers (the executor, a
+        # fronting server hands requests that already carry one): every
+        # forward, retry, and handoff leg re-sends the id via the
+        # X-LMRS-Trace header, so one request is ONE trace fleet-wide
+        if req.trace_id is None:
+            req.trace_id = new_trace_id()
         if self._disagg_ready():
             res = self._one_disagg(i, req, on_tokens, cancelled)
             if res is not None:
@@ -802,9 +863,11 @@ class RouterEngine:
                 raise _HostConnectError(str(e)) from e
             with self._inflight_lock:
                 self._inflight[rid] = conn.sock
+            headers = {"Content-Type": "application/json"}
+            if req.trace_id:
+                headers["X-LMRS-Trace"] = req.trace_id
             conn.request("POST", "/v1/chat/completions",
-                         body=json.dumps(body),
-                         headers={"Content-Type": "application/json"})
+                         body=json.dumps(body), headers=headers)
             if rid in cancelled:
                 raise ConnectionAbortedError("cancelled during connect")
             resp = conn.getresponse()
@@ -866,8 +929,11 @@ class RouterEngine:
                 # cancel() must still be able to hang up
                 self._inflight[rid] = conn.sock
             payload = json.dumps(body)
+            headers = {"Content-Type": "application/json"}
+            if req.trace_id:
+                headers["X-LMRS-Trace"] = req.trace_id
             conn.request("POST", "/v1/chat/completions", body=payload,
-                         headers={"Content-Type": "application/json"})
+                         headers=headers)
             # close the cancel() race on an unconnected conn: cancel adds
             # its id BEFORE closing, and close() on a socketless
             # HTTPConnection no-ops (request() would then auto-open a

@@ -32,6 +32,7 @@ import json
 import logging
 import math
 import queue
+import re
 import socket
 import threading
 import time
@@ -39,11 +40,24 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
+from lmrs_tpu.obs import get_tracer, new_trace_id
 from lmrs_tpu.serving.handoff import (ImportLog, TicketRegistry,
                                       decode_payload, encode_payload)
 from lmrs_tpu.testing import faults
 
 logger = logging.getLogger("lmrs.serving")
+
+# X-LMRS-Trace values ride track names, tickets, and journals: confine
+# them to a safe alphabet and length — a malformed header mints fresh
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_.:-]{1,64}$")
+
+
+def clean_trace_id(raw) -> str | None:
+    """A wire-supplied trace id, validated; None when absent/garbage."""
+    if not isinstance(raw, str):
+        return None
+    raw = raw.strip()
+    return raw if _TRACE_ID_RE.match(raw) else None
 
 
 class _Job:
@@ -582,6 +596,8 @@ class EngineHTTPServer:
                 if self.path == "/healthz":
                     self._send(200, {"status": "ok", "role": outer.role,
                                      "uptime_s": round(time.time() - outer.started, 1)})
+                elif self.path == "/v1/trace":
+                    self._get_trace()
                 elif self.path.startswith("/v1/handoff/"):
                     self._get_handoff(self.path.split("/")[3])
                 elif (self.path == "/v1/jobs"
@@ -645,6 +661,18 @@ class EngineHTTPServer:
                     return True
                 return data == b""
 
+            def _apply_trace(self, req: GenerationRequest) -> None:
+                """Anchor (or MINT — this server is ingress) the request's
+                distributed trace id from the ``X-LMRS-Trace`` header.
+                Every request gets one: the engine keys its span track on
+                it, forwards resend it, and the handoff ticket/journal
+                carry it.  ``_trace_minted`` records whether the id was
+                born here — a locally-minted id yields to the trace a
+                handoff payload arrives with (_apply_handoff)."""
+                supplied = clean_trace_id(self.headers.get("X-LMRS-Trace"))
+                self._trace_minted = supplied is None
+                req.trace_id = supplied or new_trace_id()
+
             def _apply_deadline(self, req: GenerationRequest,
                                 body: dict) -> bool:
                 """Anchor the wire deadline budget (RELATIVE seconds from
@@ -673,6 +701,67 @@ class EngineHTTPServer:
                     return False
                 req.deadline_s = time.time() + budget
                 return True
+
+            # --------------------------------------- trace export / profile
+
+            def _get_trace(self) -> None:
+                """``GET /v1/trace``: this host's trace ring as a Chrome-
+                trace JSON document — or, when the engine is a router
+                (``stitched_trace`` hook), the whole fleet's buffers
+                pulled, clock-aligned, and merged into one Perfetto trace
+                (obs.stitch_traces).  409 when tracing is off here (arm
+                with LMRS_TRACE=1 / ``lmrs-serve --trace``)."""
+                stitch = getattr(outer.engine, "stitched_trace", None)
+                if stitch is not None:
+                    try:
+                        self._send(200, stitch())
+                    except Exception as e:  # noqa: BLE001 - marked error
+                        logger.exception("trace stitch failed")
+                        self._send(502, {"error": {
+                            "message": f"trace stitch failed: "
+                                       f"{type(e).__name__}: {e}",
+                            "type": "trace_error"}})
+                    return
+                tr = get_tracer()
+                if tr is None:
+                    self._send(409, {"error": {
+                        "message": "tracing is not enabled on this host "
+                                   "(start lmrs-serve with --trace or "
+                                   "LMRS_TRACE=1)",
+                        "type": "trace_error"}})
+                    return
+                self._send(200, tr.payload(
+                    host=f"{outer.host}:{outer.port}"))
+
+            def _post_profile(self, body: dict) -> None:
+                """``POST /v1/debug/profile``: bounded on-demand
+                jax.profiler capture via the engine's ``debug_profile``
+                hook.  Body: ``{"duration_s": 2.0, "out_dir": "..."}``
+                (out_dir defaults to LMRS_PROFILE_DIR)."""
+                hook = getattr(outer.engine, "debug_profile", None)
+                if hook is None:
+                    self._send(501, {"error": {
+                        "message": "this engine backend has no profiler "
+                                   "(jax backend only)",
+                        "type": "profile_error"}})
+                    return
+                try:
+                    duration = float(body.get("duration_s", 2.0))
+                except (TypeError, ValueError):
+                    self._send(400, {"error": {
+                        "message": "duration_s must be a number",
+                        "type": "profile_error"}})
+                    return
+                from lmrs_tpu.obs.perf import default_profile_dir
+
+                out_dir = body.get("out_dir") or default_profile_dir()
+                ok, msg = hook(duration, str(out_dir))
+                if not ok:
+                    self._send(409, {"error": {"message": msg,
+                                               "type": "profile_error"}})
+                    return
+                self._send(200, {"status": "capturing", "dir": msg,
+                                 "duration_s": duration})
 
             # -------------------------------------- disaggregated handoff
 
@@ -756,6 +845,12 @@ class EngineHTTPServer:
                     self._send(err[0], err[1])
                     return False
                 req.handoff_state = payload
+                # a locally-minted trace id yields to the one the payload
+                # carried across the pod boundary (a router-forwarded
+                # request sent the header, so the two are already equal)
+                if (getattr(self, "_trace_minted", False)
+                        and clean_trace_id(payload.get("trace_id"))):
+                    req.trace_id = payload["trace_id"]
                 return True
 
             def do_DELETE(self):
@@ -774,13 +869,20 @@ class EngineHTTPServer:
                 if body is None:
                     self._send(400, {"error": {"message": "invalid JSON body"}})
                     return
+                if self.path == "/v1/debug/profile":
+                    self._post_profile(body)
+                    return
                 if self.path == "/v1/jobs":
-                    code, payload = outer._job_http("POST", self.path, body)
+                    code, payload = outer._job_http(
+                        "POST", self.path, body,
+                        trace_id=clean_trace_id(
+                            self.headers.get("X-LMRS-Trace")))
                     self._send(code, payload)
                     return
                 try:
                     if self.path == "/v1/chat/completions":
                         req = _chat_to_request(body, outer.max_tokens_cap)
+                        self._apply_trace(req)
                         if not self._apply_deadline(req, body):
                             return
                         if not self._apply_handoff(req, body):
@@ -797,7 +899,7 @@ class EngineHTTPServer:
                         # dead socket just raises, swallowed below
                         try:
                             if res.finish_reason == "handoff":
-                                self._respond_ticket(res)
+                                self._respond_ticket(res, req)
                             else:
                                 self._respond_openai(body, res)
                         except OSError:
@@ -805,6 +907,7 @@ class EngineHTTPServer:
                         return
                     elif self.path == "/v1/messages":
                         req = _messages_to_request(body, outer.max_tokens_cap)
+                        self._apply_trace(req)
                         if not self._apply_deadline(req, body):
                             return
                         if not self._apply_handoff(req, body):
@@ -817,7 +920,7 @@ class EngineHTTPServer:
                             req, poll_disconnect=self._client_gone)
                         try:
                             if res.finish_reason == "handoff":
-                                self._respond_ticket(res)
+                                self._respond_ticket(res, req)
                             else:
                                 self._respond_anthropic(body, res)
                         except OSError:
@@ -829,15 +932,19 @@ class EngineHTTPServer:
                     logger.exception("request handling failed")
                     self._send(500, {"error": {"message": str(e)}})
 
-            def _respond_ticket(self, res: GenerationResult) -> None:
+            def _respond_ticket(self, res: GenerationResult,
+                                req: GenerationRequest) -> None:
                 """Publish a handoff ticket for a prefill-role completion:
                 the request stopped after its first token with pages
                 pinned; the ticket is what the router follows to the
                 decode pool.  Never reaches plain clients — only requests
-                that ASKED for handoff can produce finish_reason='handoff'."""
+                that ASKED for handoff can produce finish_reason='handoff'.
+                The request's trace id rides the ticket so the decode leg
+                continues the same distributed trace."""
                 ttl = outer.handoff_ttl_s
                 tid = outer.handoff.create(res.request_id,
-                                           time.time() + ttl)
+                                           time.time() + ttl,
+                                           trace_id=req.trace_id)
                 outer._c_tickets.inc()
                 self._send(200, {
                     "object": "handoff.ticket",
@@ -847,6 +954,7 @@ class EngineHTTPServer:
                         "prompt_tokens": res.prompt_tokens,
                         "completion_tokens": res.completion_tokens,
                         "expires_in_s": ttl,
+                        "trace": req.trace_id,
                     },
                 })
 
@@ -1026,18 +1134,21 @@ class EngineHTTPServer:
 
     # ------------------------------------------------ durable-job plumbing
 
-    def _job_http(self, method: str, path: str, body: dict | None):
+    def _job_http(self, method: str, path: str, body: dict | None,
+                  trace_id: str | None = None):
         """The /v1/jobs surface: returns ``(status, payload)``.
 
         Local-first: a configured JobManager answers here.  Without one,
         an engine exposing ``job_request`` (RouterEngine) forwards to the
         backend fleet — jobs live next to the engine that runs them, so
-        their journals survive that host's restarts.  Neither → 501."""
+        their journals survive that host's restarts.  Neither → 501.
+        ``trace_id`` (the submit header) rides into the job journal so a
+        recovered job continues its trace."""
         if self.jobs is None:
             forward = getattr(self.engine, "job_request", None)
             if forward is not None:
                 try:
-                    return forward(method, path, body)
+                    return forward(method, path, body, trace_id=trace_id)
                 except Exception as e:  # noqa: BLE001 - marked, never a 500 crash
                     logger.exception("job forward failed")
                     return 502, {"error": {
@@ -1058,7 +1169,8 @@ class EngineHTTPServer:
                     "type": "job_error"}}
             try:
                 job = self.jobs.submit(transcript,
-                                       (body or {}).get("params"))
+                                       (body or {}).get("params"),
+                                       trace_id=trace_id)
             except ValueError as e:  # unknown/malformed param values
                 return 400, {"error": {"message": str(e),
                                        "type": "job_error"}}

@@ -66,19 +66,26 @@ def matmul_params(cfg: ModelConfig) -> int:
     return cfg.n_layers * per_layer + d * cfg.vocab_size
 
 
-def prefill_flops(cfg: ModelConfig, n_tokens: int, head_tokens: int | None = None) -> float:
-    """Forward FLOPs for a fresh causal prefill of ``n_tokens``.
+def prefill_flops(cfg: ModelConfig, n_tokens: int,
+                  head_tokens: int | None = None,
+                  kv_start: int = 0) -> float:
+    """Forward FLOPs for a causal prefill of ``n_tokens``.
 
     Dense matmuls: 2 FLOPs per param per token.  Causal attention:
     2 * S^2 * hd * H per layer (QK^T + PV, averaged S/2 keys per query,
     2 FLOPs per MAC).  ``head_tokens`` restricts the LM-head matmul to the
-    sampled rows (the packed-prefill gather, forward_paged)."""
+    sampled rows (the packed-prefill gather, forward_paged).  ``kv_start``
+    models a WINDOWED continuation chunk (chunked prefill): the chunk's
+    tokens additionally attend ``kv_start`` earlier cached KV tokens —
+    kv_start=0 reduces exactly to the fresh causal count."""
     d = cfg.dim
     body = matmul_params(cfg) - d * cfg.vocab_size
     fl = 2.0 * body * n_tokens
     fl += 2.0 * (head_tokens if head_tokens is not None else n_tokens) \
         * d * cfg.vocab_size
-    fl += 2.0 * cfg.n_layers * float(n_tokens) ** 2 * cfg.hd * cfg.n_heads
+    fl += 2.0 * cfg.n_layers * (float(n_tokens) ** 2
+                                + 2.0 * kv_start * n_tokens) \
+        * cfg.hd * cfg.n_heads
     return fl
 
 

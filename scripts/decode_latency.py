@@ -4,8 +4,18 @@ while a long prompt admits mid-stream, chunked (512) vs one-dispatch
 (4096) prefill.  Dispatch timestamps come from the lifecycle tracer's
 ``decode_block`` span starts (obs/trace.py — the one dispatch-timestamp
 path; the LMRS_TRACE_DISPATCH env hack this script used to flip is gone).
-Run: python scripts/decode_latency.py
+
+Run live:     python scripts/decode_latency.py
+Read a trace: python scripts/decode_latency.py --from-trace stitched.json
+              [--pod host:port]
+
+``--from-trace`` analyzes an exported trace file instead of running an
+engine — including a ROUTER-STITCHED multi-host trace (``GET /v1/trace``
+on a router front, obs.stitch_traces), where each pod's scheduler track
+is reported separately; ``--pod`` filters to process names containing
+the given substring (a netloc, typically).
 """
+import argparse
 import time
 
 import _pathfix  # noqa: F401  (repo-root import shim)
@@ -13,12 +23,53 @@ import numpy as np
 
 from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.api import GenerationRequest
-from lmrs_tpu.engine.jax_engine import JaxEngine
-from lmrs_tpu.obs import TID_SCHED, enable_tracing
+from lmrs_tpu.obs import TID_SCHED, enable_tracing, validate_trace_file
 from lmrs_tpu.utils.logging import setup_logging
 
 
+def _gap_line(label: str, ts: np.ndarray, wall: float | None = None) -> None:
+    if len(ts) < 2:
+        print(f"{label}: only {len(ts)} dispatch(es); no gaps", flush=True)
+        return
+    gaps = np.diff(np.sort(ts)) * 1e3
+    wall_part = f"wall={wall:.1f}s " if wall is not None else ""
+    print(f"{label}: {wall_part}dispatches={len(ts)} "
+          f"gap p50={np.percentile(gaps, 50):.0f}ms "
+          f"p90={np.percentile(gaps, 90):.0f}ms "
+          f"p99={np.percentile(gaps, 99):.0f}ms max={gaps.max():.0f}ms",
+          flush=True)
+
+
+def analyze_trace(path: str, pod: str | None = None) -> dict[str, np.ndarray]:
+    """Decode-dispatch gap analysis of an exported trace file.  Handles
+    both a single-host export (pid 1's scheduler track) and a stitched
+    multi-host document (per-host pids; process names carry the netloc).
+    Returns {pod name: dispatch start timestamps (s)}."""
+    events = validate_trace_file(path)
+    pnames = {e["pid"]: (e.get("args") or {}).get("name", "")
+              for e in events
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    per_pod: dict[str, list[float]] = {}
+    for e in events:
+        if (e.get("name") == "decode_block" and e.get("ph") == "X"
+                and e.get("tid") == TID_SCHED):
+            name = pnames.get(e["pid"], f"pid{e['pid']}") or f"pid{e['pid']}"
+            if pod is not None and pod not in name:
+                continue
+            per_pod.setdefault(name, []).append(e["ts"] / 1e6)
+    if not per_pod:
+        have = sorted(n for n in pnames.values() if "engine" in n)
+        raise SystemExit(
+            f"no decode_block dispatch spans matched"
+            + (f" pod filter {pod!r}" if pod else "")
+            + (f"; engine tracks present: {have}" if have else
+               "; the trace has no engine tracks"))
+    return {name: np.asarray(ts) for name, ts in sorted(per_pod.items())}
+
+
 def run(prefill_chunk, label):
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
     tracer = enable_tracing()
     model = model_preset("bench-1b")
     eng = JaxEngine(EngineConfig(
@@ -41,18 +92,27 @@ def run(prefill_chunk, label):
     eng.generate_batch(active + longs)
     wall = time.time() - t0
     ts = np.asarray(tracer.timestamps("decode_block", tid=TID_SCHED))
-    gaps = np.diff(ts) * 1e3
-    print(f"{label}: wall={wall:.1f}s dispatches={len(ts)} "
-          f"gap p50={np.percentile(gaps, 50):.0f}ms "
-          f"p90={np.percentile(gaps, 90):.0f}ms "
-          f"p99={np.percentile(gaps, 99):.0f}ms max={gaps.max():.0f}ms",
-          flush=True)
+    _gap_line(label, ts, wall)
     eng.shutdown()
-    return gaps
+    return np.diff(ts) * 1e3
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--from-trace", default=None, metavar="PATH",
+                    help="analyze an exported (possibly router-stitched "
+                         "multi-host) trace file instead of running live")
+    ap.add_argument("--pod", default=None,
+                    help="with --from-trace: only tracks whose process "
+                         "name contains this substring (a host netloc)")
+    args = ap.parse_args()
     setup_logging(quiet=True)
+    if args.from_trace:
+        for name, ts in analyze_trace(args.from_trace, args.pod).items():
+            _gap_line(name, ts)
+        return
+    if args.pod:
+        raise SystemExit("--pod requires --from-trace")
     for pc, label in ((512, "chunked-512"), (4096, "one-dispatch"),
                       (4096, "one-dispatch-2"), (512, "chunked-512-2")):
         run(pc, label)

@@ -291,6 +291,68 @@ def test_deadline_expires_in_flight_within_a_block(jax_engine):
     assert sched.audit() == []
 
 
+# ------------------------------------------------------- flight recorder
+
+
+def test_postmortem_on_dispatch_fault(jax_engine, monkeypatch, tmp_path):
+    """A scheduler-step fault mid-run must leave a schema-valid
+    postmortem behind (spans + metrics frozen BEFORE pool recovery),
+    while the run itself still degrades and the auditor stays clean —
+    the flight-recorder arm of the acceptance criteria."""
+    from lmrs_tpu.obs import validate_postmortem_file
+
+    monkeypatch.setenv("LMRS_POSTMORTEM_DIR", str(tmp_path))
+    monkeypatch.setenv("LMRS_POSTMORTEM_MIN_S", "0")
+    soak(jax_engine, jax_engine._scheduler, 11, JAX_PLANS["step"])
+    dumps = sorted(tmp_path.glob("postmortem-dispatch_fault-*.json"))
+    assert dumps, "dispatch fault produced no postmortem"
+    doc = validate_postmortem_file(dumps[0])
+    assert doc["reason"] == "dispatch_fault"
+    assert "error" in doc["extra"]
+    assert doc["metrics"].get("decode_dispatches", 0) >= 0
+    assert jax_engine._scheduler.audit() == []
+
+
+def test_postmortem_on_inflight_deadline_expiry(jax_engine, monkeypatch,
+                                                tmp_path):
+    """The in-flight deadline-expiry chaos scenario with the storm
+    threshold armed at 1: the sweep that reaps the expired slot dumps a
+    deadline_storm postmortem (same stall-driven shape as
+    test_deadline_expires_in_flight_within_a_block), auditor clean."""
+    from lmrs_tpu.obs import validate_postmortem_file
+
+    sched = jax_engine._scheduler
+    for rid in (910, 911):  # warm shapes + the observed-TTFT floor
+        jax_engine.generate_batch([GenerationRequest(
+            prompt="warmup storm", request_id=rid, temperature=0.0,
+            max_new_tokens=8)])
+    assert sched._ttft_min < 0.4, sched._ttft_min
+    monkeypatch.setenv("LMRS_POSTMORTEM_DIR", str(tmp_path))
+    monkeypatch.setenv("LMRS_POSTMORTEM_MIN_S", "0")
+    monkeypatch.setenv("LMRS_DEADLINE_STORM", "1")
+    plan = FaultPlan(faults=[{"site": "scheduler.step", "at": [3],
+                              "action": "stall", "stall_s": 0.7}])
+    with faults.injected(plan):
+        res = jax_engine.generate_batch([GenerationRequest(
+            prompt="expire me into the recorder", request_id=0,
+            temperature=0.0, max_new_tokens=64,
+            deadline_s=time.time() + 0.4)])[0]
+    assert res.finish_reason == "deadline", res
+    dumps = sorted(tmp_path.glob("postmortem-deadline_storm-*.json"))
+    assert dumps, "in-flight expiry produced no postmortem"
+    doc = validate_postmortem_file(dumps[0])
+    assert doc["extra"]["expired_this_sweep"] >= 1
+    assert sched.audit() == []
+
+
+def test_postmortem_disabled_without_dir(jax_engine, monkeypatch, tmp_path):
+    """With LMRS_POSTMORTEM_DIR unset the recorder is a strict no-op —
+    the existing chaos grid must not start writing files."""
+    monkeypatch.delenv("LMRS_POSTMORTEM_DIR", raising=False)
+    soak(jax_engine, jax_engine._scheduler, 23, JAX_PLANS["step"])
+    assert not list(tmp_path.glob("postmortem-*.json"))
+
+
 def test_static_scheduler_sheds_expired_at_admission():
     """The static scheduler also honors admission shedding (it cannot
     expire in flight — no host sync inside its on-device while_loop; see

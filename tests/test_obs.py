@@ -205,6 +205,118 @@ def test_trace_validation_rejects_bad_events():
                                 "pid": 1, "tid": 1}])
 
 
+def _instant(name, args, ts=1.0):
+    return {"name": name, "ph": "i", "s": "t", "ts": ts, "pid": 1,
+            "tid": 10, "args": args}
+
+
+def test_trace_validation_handoff_job_instant_contracts():
+    """The handoff/job lifecycle instants carry contract args their
+    consumers (stitcher skew anchors, jobs dashboard) parse — a dropped
+    key must fail the gate, not silently break a reader."""
+    # conforming instants pass
+    validate_trace_events([
+        _instant("handoff_export", {"pages": 4, "kv_len": 128}),
+        _instant("handoff_import", {"pages": 4, "kv_len": 128, "slot": 0}),
+        _instant("handoff_release", {"pages": 4, "orphaned": False}),
+        _instant("job_submit", {"job": "job-abc"}),
+        _instant("job_recover", {"job": "job-abc"}),
+        _instant("job_resume", {"job": "job-abc", "resumed_chunks": 3}),
+        _instant("job_done", {"job": "job-abc", "status": "done"}),
+    ])
+    # each required key missing is a schema violation
+    for bad in (
+        _instant("handoff_export", {"pages": 4}),            # no kv_len
+        _instant("handoff_import", {"kv_len": 128}),         # no pages
+        _instant("handoff_release", {"pages": 4}),           # no orphaned
+        _instant("job_done", {"job": "job-abc"}),            # no status
+        _instant("job_resume", {"job": "job-abc"}),          # no count
+        _instant("job_submit", {}),                          # no job
+    ):
+        with pytest.raises(ValueError):
+            validate_trace_events([bad])
+
+
+def test_trace_validation_perf_attribution_args_numeric():
+    """Perf-attribution args (flops_g/hbm_gb/mfu/...) must be finite
+    non-negative numbers wherever they appear — a NaN or negative value
+    poisons every aggregation built on the trace."""
+    ok = {"name": "prefill_dispatch", "ph": "i", "s": "t", "ts": 1.0,
+          "pid": 1, "tid": 0, "args": {"tokens": 512, "flops_g": 1.25}}
+    validate_trace_events([ok])
+    for key, val in (("flops_g", -1.0), ("flops_g", float("nan")),
+                     ("hbm_gb", float("inf")), ("tokens", -5),
+                     ("mfu", True), ("hbm_util", "0.5")):
+        bad = {**ok, "args": {**ok["args"], key: val}}
+        with pytest.raises(ValueError):
+            validate_trace_events([bad])
+
+
+def test_track_for_int_compat_and_trace_allocation(tracer):
+    """int keys keep the legacy REQ_TID_BASE mapping; string (trace-id)
+    keys allocate stable tids from a disjoint base and name their track
+    trace:<id> — the metadata the cross-host stitcher keys on."""
+    from lmrs_tpu.obs import TRACE_TRACK_PREFIX
+    from lmrs_tpu.obs.trace import TRACE_TID_BASE
+
+    assert tracer.track_for(7) == req_tid(7)
+    t1 = tracer.track_for("trace-a")
+    assert t1 == tracer.track_for("trace-a")  # stable
+    t2 = tracer.track_for("trace-b")
+    assert t1 != t2 and t1 >= TRACE_TID_BASE
+    names = {(e["pid"], e["tid"]): e["args"]["name"]
+             for e in tracer.payload()["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert names[(1, t1)] == f"{TRACE_TRACK_PREFIX}trace-a"
+    assert names[(1, t2)] == f"{TRACE_TRACK_PREFIX}trace-b"
+
+
+def test_stitch_traces_aligns_skewed_clocks(tracer):
+    """Two synthetic host pages whose clocks disagree by 10 s (the decode
+    host's import timestamps PRECEDE the export on the merged clock):
+    the stitcher's handoff-pair skew anchor shifts the decode host
+    forward so the stitched chain reads causally, and same-clock hosts
+    are left untouched."""
+    from lmrs_tpu.obs import stitch_traces, stitched_chains
+
+    def host_page(events):
+        tr = Tracer()
+        tid = tr.track_for("tr-1")
+        for name, ts, args in events:
+            tr.instant(name, ts=ts, tid=tid, args=args)
+        return tr.payload()
+
+    t0 = 1000.0
+    skew = -10.0  # decode host clock 10 s behind
+    prefill = host_page([
+        ("enqueue", t0, {"prompt_tokens": 8}),
+        ("handoff_export", t0 + 1.0, {"pages": 2, "kv_len": 8}),
+        ("handoff_release", t0 + 3.0, {"pages": 2, "orphaned": False}),
+    ])
+    decode = host_page([
+        ("handoff_import", t0 + 2.0 + skew, {"pages": 2, "kv_len": 8}),
+        ("finish", t0 + 4.0 + skew, {"reason": "stop",
+                                     "completion_tokens": 4}),
+    ])
+    doc = stitch_traces([("pre:8000", prefill), ("dec:8000", decode)])
+    validate_trace_events(doc["traceEvents"])
+    off = doc["stitch"]["offsets_ms"]
+    assert off["pre:8000"] == 0.0
+    assert off["dec:8000"] > 0  # shifted forward to restore causality
+    chains = stitched_chains(doc["traceEvents"])
+    assert list(chains) == ["tr-1"]
+    names = [e["name"] for e in chains["tr-1"]]
+    assert names.index("handoff_export") < names.index("handoff_import")
+    assert names[0] == "enqueue" and names[-1] == "finish"
+    # hosts already on one clock are left untouched (0 in the interval)
+    doc2 = stitch_traces([
+        ("pre:8000", prefill),
+        ("dec:8000", host_page([
+            ("handoff_import", t0 + 2.0, {"pages": 2, "kv_len": 8}),
+            ("finish", t0 + 4.0, {"reason": "stop"})]))])
+    assert doc2["stitch"]["offsets_ms"]["dec:8000"] == 0.0
+
+
 def test_timestamps_filter(tracer):
     tracer.complete("decode_block", 1.0, 1.5, tid=TID_SCHED)
     tracer.instant("decode_block", ts=1.0, tid=req_tid(3))
@@ -363,6 +475,47 @@ def test_metrics_report_superset_of_pre_pr_keys():
     text = eng._scheduler.registry.render_prometheus()
     assert "lmrs_ttft_seconds_bucket" in text
     _assert_valid_exposition(text)
+    eng.shutdown()
+
+
+def test_perf_attribution_surface():
+    """The live-attribution block rides metrics_report() and the
+    Prometheus page (histograms + _last gauges + model-work counters);
+    after real dispatches the model-work counters are nonzero and the
+    step-gap histogram sampled (CPU run: ratios may be empty — compiling
+    shapes and the garbage guard legitimately skip samples)."""
+    from lmrs_tpu.config import EngineConfig
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                 max_tokens=16, max_batch_slots=2, seed=0,
+                                 decode_block=4), _tiny_model())
+    for rid in range(2):  # second wave runs warm shapes
+        eng.generate_batch([GenerationRequest(
+            prompt="attribution probe " * 3, request_id=rid,
+            temperature=0.0, max_new_tokens=12)])
+    pa = eng.engine_metrics()["perf_attribution"]
+    assert {"prefill_mfu", "decode_hbm_util", "step_gap_ms",
+            "model_prefill_gflops", "model_decode_gb",
+            "rtt_ms"} <= set(pa)
+    assert pa["model_prefill_gflops"] > 0
+    assert pa["model_decode_gb"] > 0
+    assert (pa["step_gap_ms"] or {}).get("n", 0) >= 1
+    text = eng._scheduler.registry.render_prometheus()
+    for name in ("lmrs_prefill_mfu_ratio_bucket",
+                 "lmrs_decode_hbm_util_ratio_bucket",
+                 "lmrs_step_gap_ms_bucket",
+                 "lmrs_prefill_model_flops_total",
+                 "lmrs_decode_model_bytes_total",
+                 "lmrs_step_gap_ms_last"):
+        assert name in text, name
+    _assert_valid_exposition(text)
+    # warmup isolation: the distributions reset, the counters persist
+    eng._scheduler.reset_latency_stats()
+    pa2 = eng.engine_metrics()["perf_attribution"]
+    assert pa2["step_gap_ms"] is None
+    assert pa2["model_prefill_gflops"] == pa["model_prefill_gflops"]
     eng.shutdown()
 
 

@@ -1,0 +1,206 @@
+"""Fleet-wide trace stitching gate (ISSUE 8 tentpole b + acceptance 3).
+
+A REAL two-process prefill-role + decode-role topology (lmrs-serve OS
+processes, mock backend, LMRS_TRACE=1) serves a disaggregated request
+through the pool-aware router; the router then pulls each pod's
+``GET /v1/trace`` page and stitches them (obs.stitch_traces).  Asserted:
+
+* the merged file passes ``validate_trace_file`` (the same schema gate
+  CI runs on single-host traces, now including the handoff-instant
+  contract args);
+* the request appears as exactly ONE stitched causal chain under ONE
+  trace id — spans from BOTH pods, with the prefill pod's
+  ``handoff_export`` strictly before the decode pod's
+  ``handoff_import`` and a terminal ``finish``;
+* the local ``/v1/trace`` endpoint answers per host, and 409s when
+  tracing is off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.obs import stitched_chains, validate_trace_file
+from lmrs_tpu.serving.router import RouterEngine
+
+_PROMPT = ("Transcript section: The committee reviewed the budget at "
+           "length. Afterwards the chair summarized the next steps for "
+           "the quarter in detail. Finally the group agreed to reconvene "
+           "on Tuesday to close the remaining items.")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(port: int, role: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu", LMRS_TRACE="1")
+    return subprocess.Popen(
+        [sys.executable, "-m", "lmrs_tpu.serving.cli",
+         "--backend", "mock", "--port", str(port), "--role", role, "-q"],
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _wait_healthy(url: str, proc, deadline_s: float = 60.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"worker died rc={proc.returncode}: "
+                f"{proc.stderr.read().decode()[-2000:]}")
+        try:
+            with urllib.request.urlopen(f"{url}/healthz", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"{url} never became healthy")
+
+
+@pytest.fixture(scope="module")
+def traced_topology():
+    """prefill-role + decode-role lmrs-serve processes with the
+    in-process tracer armed (LMRS_TRACE=1)."""
+    ports = [free_port(), free_port()]
+    procs = [_spawn_worker(ports[0], "prefill"),
+             _spawn_worker(ports[1], "decode")]
+    try:
+        for port, proc in zip(ports, procs):
+            _wait_healthy(f"http://127.0.0.1:{port}", proc)
+        yield ports
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_two_process_disagg_stitches_to_single_causal_chain(
+        traced_topology, tmp_path):
+    """The tier-1 stitch gate: one disaggregated request → one merged
+    Perfetto file → one causally ordered span chain under one trace id."""
+    ports = traced_topology
+    router = RouterEngine([], prefill_hosts=[f"127.0.0.1:{ports[0]}"],
+                          decode_hosts=[f"127.0.0.1:{ports[1]}"])
+    try:
+        res = router.generate_batch([GenerationRequest(
+            prompt=_PROMPT, request_id=0, temperature=0.0)])[0]
+        assert res.error is None and res.text
+        assert router._handoffs == 1 and router._handoff_fallbacks == 0
+
+        doc = router.stitched_trace()
+        assert doc["stitch"]["unreachable"] == []
+        assert set(doc["stitch"]["hosts"]) == {
+            f"127.0.0.1:{p}" for p in ports}
+        out = tmp_path / "stitched.json"
+        out.write_text(json.dumps(doc), encoding="utf-8")
+        events = validate_trace_file(out)  # the CI schema gate
+
+        chains = stitched_chains(events)
+        assert len(chains) == 1, f"want ONE trace id, got {list(chains)}"
+        (trace_id, chain), = chains.items()
+        assert trace_id == doc["stitch"]["traces"][0]
+        names = [e["name"] for e in chain]
+        hosts = {e["name"]: e["args"]["host"] for e in chain}
+        # causal order: export (pod A) strictly before import (pod B),
+        # terminal finish present, timestamps monotonic
+        assert "handoff_export" in names and "handoff_import" in names
+        assert names.index("handoff_export") < names.index("handoff_import")
+        assert hosts["handoff_export"] == f"127.0.0.1:{ports[0]}"
+        assert hosts["handoff_import"] == f"127.0.0.1:{ports[1]}"
+        assert names[-1] == "finish"
+        ts = [e["ts"] for e in chain]
+        assert ts == sorted(ts)
+        # spans from BOTH pods landed on the one stitched track
+        assert {e["args"]["host"] for e in chain} == {
+            f"127.0.0.1:{p}" for p in ports}
+    finally:
+        router.shutdown()
+
+
+def test_per_host_trace_endpoint(traced_topology):
+    """Each pod's GET /v1/trace serves its own Chrome-trace document
+    (the page the router-side stitcher pulls)."""
+    port = traced_topology[0]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/trace", timeout=5) as r:
+        doc = json.loads(r.read())
+    assert "traceEvents" in doc and doc.get("host", "").endswith(str(port))
+    assert isinstance(doc.get("clock_s"), float)
+
+
+def test_trace_endpoint_409_when_tracing_off():
+    """A host without LMRS_TRACE answers 409 with a clear arming hint —
+    never an empty 200 the stitcher would silently merge as 'no spans'."""
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.obs import disable_tracing
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    disable_tracing()  # other tests may have armed the process tracer
+    server = EngineHTTPServer(MockEngine(), port=0)
+    server.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://{server.host}:{server.port}/v1/trace", timeout=5)
+        assert exc.value.code == 409
+        assert "LMRS_TRACE" in json.loads(exc.value.read())[
+            "error"]["message"]
+    finally:
+        server.shutdown()
+
+
+def test_debug_profile_endpoint_501_without_device_engine():
+    """POST /v1/debug/profile needs the jax engine's profiler hook; the
+    mock backend answers 501 (capability, not a crash)."""
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    server = EngineHTTPServer(MockEngine(), port=0)
+    server.start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://{server.host}:{server.port}/v1/debug/profile",
+            data=json.dumps({"duration_s": 0.5}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=5)
+        assert exc.value.code == 501
+    finally:
+        server.shutdown()
+
+
+def test_debug_profile_capture_jax(tmp_path):
+    """The jax engine's debug_profile hook runs a bounded capture (CPU
+    backend profiles too) and rejects a second concurrent capture."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from lmrs_tpu.obs import profile_capture_active
+    from lmrs_tpu.obs.perf import start_profile_capture
+
+    ok, out = start_profile_capture(str(tmp_path / "prof"), duration_s=0.3)
+    assert ok, out
+    dup_ok, reason = start_profile_capture(str(tmp_path / "p2"), 0.3)
+    assert not dup_ok and "already" in reason
+    t0 = time.time()
+    while profile_capture_active() and time.time() - t0 < 10:
+        time.sleep(0.05)
+    assert not profile_capture_active()
+    assert any((tmp_path / "prof").rglob("*")), "no profile artifacts"
