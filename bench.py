@@ -42,6 +42,8 @@ import threading
 import time
 from pathlib import Path
 
+from lmrs_tpu.utils.env import env_float, env_int, env_str
+
 REFERENCE_BASELINE_CHUNKS_PER_SEC = 0.25
 
 TRANSCRIPT_CANDIDATES = [
@@ -127,8 +129,8 @@ def acquire_backend() -> tuple[bool, str]:
     can't wedge the bench: after the total budget we give up and report
     (a second init thread would just block on the same init lock, so a
     hung attempt is joined, never respawned).  Returns (ok, log)."""
-    total_budget = float(os.environ.get("LMRS_BENCH_INIT_TIMEOUT_S", "600"))
-    attempts = int(os.environ.get("LMRS_BENCH_BACKEND_ATTEMPTS", "5"))
+    total_budget = env_float("LMRS_BENCH_INIT_TIMEOUT_S", 600.0, lo=1.0)
+    attempts = env_int("LMRS_BENCH_BACKEND_ATTEMPTS", 5, lo=1)
     deadline = time.time() + total_budget
     log: list[str] = []
 
@@ -187,7 +189,7 @@ def load_transcript() -> dict:
         data = {"segments": segs}
     # LMRS_BENCH_SEGMENTS: cap the workload (CPU smoke of the bench harness
     # itself — the driver never sets it, so chip runs get the full fixture)
-    cap = int(os.environ.get("LMRS_BENCH_SEGMENTS", "0"))
+    cap = env_int("LMRS_BENCH_SEGMENTS", 0, lo=0)
     if cap > 0:
         data = {"segments": data["segments"][:cap]}
     return data
@@ -221,7 +223,7 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     # egress) — throughput-identical to a trained model of this shape.
     # LMRS_BENCH_MODEL: A/B hook (e.g. "tiny" for a CPU smoke run of the
     # bench harness itself; the driver always runs the default on the chip)
-    model_name = os.environ.get("LMRS_BENCH_MODEL", "bench-1b")
+    model_name = env_str("LMRS_BENCH_MODEL", "bench-1b")
     model = model_preset(model_name)
     cfg = PipelineConfig(
         # 1400-token chunks: chunk body (1250) + context header (150) + the
@@ -254,8 +256,8 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
         # context serving configs should stay at 512 (page-quantized reads
         # dominate there); this is the bench preset's live range talking.
         engine=EngineConfig(backend="jax", max_tokens=128,
-                            max_batch_slots=int(
-                                os.environ.get("LMRS_BENCH_SLOTS", "24")),
+                            max_batch_slots=env_int(
+                                "LMRS_BENCH_SLOTS", 24, lo=1),
                             tokenizer="byte",
                             retry_delay=0.0, seed=0,
                             page_size=1024 if model_name == "bench-8b" else 512,
@@ -300,7 +302,7 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     # percentiles; counter metrics are windowed via the snapshot below.
     sched.reset_latency_stats()
     metrics_before = dict(sched.metrics)
-    reps = max(1, int(os.environ.get("LMRS_BENCH_REPS", "3")))
+    reps = env_int("LMRS_BENCH_REPS", 3, lo=1)
     rep_rows = _partial_reps  # shared with the watchdog (see start_watchdog)
     for _ in range(reps):
         tokens_before = s.executor.total_tokens_used
@@ -432,12 +434,12 @@ def main() -> int:
     # overhead-A/B control) — unknown args are ignored, not fatal
     ap = argparse.ArgumentParser(add_help=False)
     ap.add_argument("--trace-out",
-                    default=os.environ.get("LMRS_TRACE_OUT") or None)
+                    default=env_str("LMRS_TRACE_OUT") or None)
     ap.add_argument("--no-trace", action="store_true")
     args, _ = ap.parse_known_args()
     trace_out = None if args.no_trace else args.trace_out
 
-    deadline = float(os.environ.get("LMRS_BENCH_DEADLINE_S", "1800"))
+    deadline = env_float("LMRS_BENCH_DEADLINE_S", 1800.0, lo=1.0)
     start_watchdog(deadline)
 
     ok, probe_log = acquire_backend()

@@ -1,0 +1,25 @@
+"""`lmrs-lint`: repo-native static analysis (docs/ANALYSIS.md).
+
+Four AST-based pass families over the production tree:
+
+* **race** (`locks.py`) — lock discipline learned from ``# guarded-by:``
+  annotations: unguarded writes to guarded state, lock-acquisition-order
+  cycles, locks held across blocking calls;
+* **tracing** (`tracing.py`) — JAX tracing hazards in jitted/scanned code
+  (Python branching on traced values, host syncs, dynamic shapes, mutable
+  closures) plus the deprecated-API sub-pass;
+* **drift** (`drift.py`) — code-vs-docs contract drift: fault-injection
+  sites vs docs/ROBUSTNESS.md, ``lmrs_*`` metric names vs
+  docs/OBSERVABILITY.md, trace-instant args vs ``validate_trace_events``;
+* **env** (`envpass.py`) — every ``LMRS_*`` env read must route through
+  ``lmrs_tpu.utils.env`` and appear in docs/KNOBS.md.
+
+Entry points: the ``lmrs-lint`` console script / ``python -m
+lmrs_tpu.analysis`` (CI gate), or :func:`run_repo` programmatically.
+"""
+
+from lmrs_tpu.analysis.core import (Baseline, Finding, Module, RepoContext,
+                                    run_passes, run_repo)
+
+__all__ = ["Baseline", "Finding", "Module", "RepoContext", "run_passes",
+           "run_repo"]

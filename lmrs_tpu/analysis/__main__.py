@@ -1,0 +1,7 @@
+"""``python -m lmrs_tpu.analysis`` == the ``lmrs-lint`` console script."""
+
+import sys
+
+from lmrs_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -14,21 +14,24 @@ DEFAULT_PROVIDER; .env.template:1-22) are honored so a reference user's
 from __future__ import annotations
 
 import dataclasses
-import os
 from dataclasses import dataclass, field
 from typing import Any
 
 
 def _env(name: str, default: Any, cast: type = str) -> Any:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        if cast is bool:
-            return raw.strip().lower() in ("1", "true", "yes", "on")
-        return cast(raw)
-    except (TypeError, ValueError):
-        return default
+    """Config-field env override, routed through the shared validated
+    parser (utils/env.py): empty string means default, non-finite numbers
+    are rejected, bad values warn once and keep the default."""
+    from lmrs_tpu.utils import env as _envmod
+
+    if cast is bool:
+        return _envmod.env_bool(name, bool(default))
+    if cast is int:
+        return _envmod.env_int(name, default)
+    if cast is float:
+        return _envmod.env_float(name, default)
+    raw = _envmod.env_str(name, "" if default is None else str(default))
+    return raw if default is not None or raw else default
 
 
 @dataclass

@@ -25,7 +25,6 @@ reused for the whole run.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from collections import deque
@@ -48,6 +47,7 @@ from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS,
                           dump_postmortem, get_tracer, req_tid)
 from lmrs_tpu.ops.sampling import sample_logits
 from lmrs_tpu.testing import faults
+from lmrs_tpu.utils.env import env_bool, env_float, env_int, env_str
 
 logger = logging.getLogger("lmrs.scheduler")
 
@@ -122,7 +122,7 @@ class ContinuousScheduler:
         # burns one decode-block dispatch whose tokens are trimmed — rare
         # for summarization workloads.  LMRS_DEFER_TOK0=0 restores the
         # synchronous fetch for A/B measurement.
-        self.defer_tok0 = os.environ.get("LMRS_DEFER_TOK0", "1") != "0"
+        self.defer_tok0 = env_bool("LMRS_DEFER_TOK0", True)
         ps = engine_cfg.page_size
         max_pages_per_slot = -(-self.max_len // ps)
         # Pool sizing: an explicit num_pages (> 1) is an HBM budget and is
@@ -157,7 +157,7 @@ class ContinuousScheduler:
         # LMRS_FORCE_KERNELS=interpret: run the Pallas kernels in interpret
         # mode regardless of platform — the CPU-mesh test path for the
         # shard_map-wrapped kernels (tests can't see a real TPU)
-        self._interpret = (os.environ.get("LMRS_FORCE_KERNELS", "").lower()
+        self._interpret = (env_str("LMRS_FORCE_KERNELS").lower()
                            == "interpret")
         self._use_ragged = self._pick_kernel()
         # Multi-row decode page walk (ops/paged_attention.py): G batch rows
@@ -169,7 +169,7 @@ class ContinuousScheduler:
         # the kill switch (exact per-row grid + unpermuted dispatch, the
         # LMRS_PACK_PREFILL A/B convention).
         self._row_group = 1
-        if os.environ.get("LMRS_MULTIROW", "1") != "0":
+        if env_bool("LMRS_MULTIROW", True):
             self._row_group = max(1, min(engine_cfg.decode_row_group, self.B))
         # flash prefill: same tp-only-mesh limit as the ragged gate (under a
         # mesh the kernel runs via shard_map over the tp head axis); also
@@ -180,7 +180,7 @@ class ContinuousScheduler:
         # run on real tokens only instead of ~pow2-bucket padding per prompt
         # (measured ~43% padded q rows at the bench shape).  LMRS_PACK_PREFILL=0
         # restores per-prompt prefill for A/B measurement.
-        self._pack_prefill = os.environ.get("LMRS_PACK_PREFILL", "1") != "0"
+        self._pack_prefill = env_bool("LMRS_PACK_PREFILL", True)
         # int8 KV composes with packing since r4 (VERDICT r3 item 3): the
         # packed program computes per-SEGMENT scales and scatters them into
         # each segment's slot row — no gate needed
@@ -228,7 +228,7 @@ class ContinuousScheduler:
         # the chunked-prefill path at the match boundary.  LMRS_PREFIX_CACHE=0
         # is the A/B kill switch (same convention as LMRS_PACK_PREFILL).
         pc_on = (engine_cfg.prefix_cache
-                 and os.environ.get("LMRS_PREFIX_CACHE", "1") != "0")
+                 and env_bool("LMRS_PREFIX_CACHE", True))
         if pc_on and self._kv_quant:
             # int8 KV scales are per-SLOT, frozen at prefill: a hit slot
             # would dequantize donor-quantized pages with its own scales
@@ -384,9 +384,10 @@ class ContinuousScheduler:
         # _run_live under the same lock before its first allocation)
         # can never overlap it.  audit() accounts both classes as
         # pinned-for-export holders.
-        self._pinned: dict[int, dict] = {}
+        self._pinned: dict[int, dict] = {}  # guarded-by: _pinned_lock
+        # guarded-by: _pinned_lock
         self._release_deferred: list[tuple[int, dict, bool]] = []
-        self._run_live = False
+        self._run_live = False  # guarded-by: _pinned_lock
         self._pinned_lock = threading.Lock()
         self._c_handoff_exports = c("lmrs_handoff_exports_total",
                                     "requests pinned for prefill→decode "
@@ -503,10 +504,7 @@ class ContinuousScheduler:
         if not thresh or not warm or wall_s <= thresh:
             return
         self._slow_step_fired = True
-        try:
-            dur = float(os.environ.get("LMRS_PROFILE_CAPTURE_S", "3") or 3)
-        except ValueError:
-            dur = 3.0
+        dur = env_float("LMRS_PROFILE_CAPTURE_S", 3.0, lo=0.1, hi=60.0)
         ok, msg = start_profile_capture(default_profile_dir(), dur)
         logger.warning("slow decode block (%.3fs > %.3fs threshold): "
                        "profiler capture %s (%s)", wall_s, thresh,
@@ -1275,10 +1273,7 @@ class ContinuousScheduler:
         # expired work — freeze the evidence (no-op when the flight
         # recorder is unarmed)
         if expired:
-            try:
-                storm = int(os.environ.get("LMRS_DEADLINE_STORM", "3") or 3)
-            except ValueError:
-                storm = 3
+            storm = env_int("LMRS_DEADLINE_STORM", 3, lo=0)
             if storm > 0 and expired >= storm:
                 dump_postmortem("deadline_storm", metrics=self.metrics,
                                 extra={"expired_this_sweep": expired,

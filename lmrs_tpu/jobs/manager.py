@@ -170,7 +170,7 @@ class JobManager:
         self.jobs_cfg = jobs_config or self.config.jobs
         self.dir = Path(jobs_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
-        self._jobs: dict[str, Job] = {}
+        self._jobs: dict[str, Job] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self._queue: queue.Queue[str | None] = queue.Queue()
         self._stopped = False
@@ -448,7 +448,8 @@ class JobManager:
 
     # ---------------------------------------------------------- internals
 
-    def _register(self, jid: str, params: dict, fingerprint: str) -> Job:
+    def _register(self, jid: str, params: dict,
+                  fingerprint: str) -> Job:  # holds-lock: _lock
         job = Job(job_id=jid, params=params, fingerprint=fingerprint,
                   req_path=self.dir / f"{jid}.req.json",
                   wal_path=self.dir / f"{jid}.wal")

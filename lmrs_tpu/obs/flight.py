@@ -33,6 +33,7 @@ import time
 from pathlib import Path
 
 from lmrs_tpu.obs.trace import get_tracer, validate_trace_events
+from lmrs_tpu.utils.env import env_float, env_str
 
 logger = logging.getLogger("lmrs.obs.flight")
 
@@ -45,16 +46,15 @@ _last_dump: dict[str, float] = {}  # reason -> monotonic time of last dump
 
 def postmortem_dir() -> Path | None:
     """The armed dump directory, or None when the recorder is disabled."""
-    d = os.environ.get("LMRS_POSTMORTEM_DIR", "").strip()
+    d = env_str("LMRS_POSTMORTEM_DIR")
     return Path(d) if d else None
 
 
 def _min_interval_s() -> float:
-    try:
-        return max(0.0, float(os.environ.get("LMRS_POSTMORTEM_MIN_S",
-                                             "5") or 5))
-    except ValueError:
-        return 5.0
+    # the shared parser owns the hard cases: "" means the documented 5 s
+    # default, and a NaN can never reach the throttle comparison (NaN
+    # compares False against the elapsed time, i.e. an unthrottled storm)
+    return env_float("LMRS_POSTMORTEM_MIN_S", 5.0, lo=0.0)
 
 
 def dump_postmortem(reason: str, *, metrics: dict | None = None,

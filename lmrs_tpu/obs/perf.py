@@ -42,6 +42,8 @@ import os
 import threading
 import time
 
+from lmrs_tpu.utils.env import env_float, env_str
+
 logger = logging.getLogger("lmrs.obs.perf")
 
 
@@ -258,7 +260,7 @@ def default_profile_dir() -> str:
     capture paths can never write to different places."""
     import tempfile
 
-    return (os.environ.get("LMRS_PROFILE_DIR")
+    return (env_str("LMRS_PROFILE_DIR")
             or os.path.join(tempfile.gettempdir(), "lmrs_profile"))
 
 
@@ -320,8 +322,4 @@ def slow_step_threshold_s() -> float:
     """The ``LMRS_PROFILE_ON_SLOW_STEP`` trigger threshold (seconds);
     0 = disabled.  Read per call so tests can arm it without rebuilding
     the engine."""
-    try:
-        return max(0.0, float(os.environ.get("LMRS_PROFILE_ON_SLOW_STEP",
-                                             "0") or 0))
-    except ValueError:
-        return 0.0
+    return env_float("LMRS_PROFILE_ON_SLOW_STEP", 0.0, lo=0.0)

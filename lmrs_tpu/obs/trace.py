@@ -71,14 +71,19 @@ class Tracer:
     def __init__(self, capacity: int = 262_144):
         self.capacity = capacity
         self._events: deque[dict] = deque(maxlen=capacity)
-        self.recorded = 0  # total ever recorded (recorded - len = dropped)
+        # total ever recorded (recorded - len = dropped).  The int += is a
+        # read-modify-write — scheduler, HTTP handler, and sweeper threads
+        # record concurrently, so it counts under the trace lock (a bare
+        # increment was measured losing updates under concurrent spans;
+        # the race detector's guarded-by annotation keeps it fixed).
+        self.recorded = 0  # guarded-by: _trace_lock
         self._track_names: dict[tuple[int, int], str] = {}
         self._process_names: dict[int, str] = {
             PID_ENGINE: "lmrs-engine", PID_PIPELINE: "lmrs-pipeline"}
         # trace-id -> allocated tid (track_for): the per-request track key
         # for distributed traces — stable within a process, named
         # ``trace:<id>`` so the stitcher can match tracks across hosts
-        self._trace_tids: dict[str, int] = {}
+        self._trace_tids: dict[str, int] = {}  # guarded-by: _trace_lock
         self._trace_lock = threading.Lock()
         self.name_track(PID_ENGINE, TID_SCHED, "scheduler dispatches")
         self.name_track(PID_PIPELINE, TID_SCHED, "stages")
@@ -95,7 +100,8 @@ class Tracer:
         if args:
             ev["args"] = args
         self._events.append(ev)
-        self.recorded += 1
+        with self._trace_lock:
+            self.recorded += 1
 
     def complete(self, name: str, t0: float, t1: float, *,
                  tid: int = TID_SCHED, pid: int = PID_ENGINE,
@@ -106,7 +112,8 @@ class Tracer:
         if args:
             ev["args"] = args
         self._events.append(ev)
-        self.recorded += 1
+        with self._trace_lock:
+            self.recorded += 1
 
     def name_track(self, pid: int, tid: int, name: str) -> None:
         """Label a track (kept outside the ring so names survive overflow)."""
@@ -134,7 +141,8 @@ class Tracer:
 
     def clear(self) -> None:
         self._events.clear()
-        self.recorded = 0
+        with self._trace_lock:
+            self.recorded = 0
 
     # --------------------------------------------------------------- reading
 

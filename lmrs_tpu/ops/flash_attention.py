@@ -18,12 +18,14 @@ see tests/test_kernels.py.  Falls back to interpret mode off-TPU.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from lmrs_tpu.utils.env import env_int
+from lmrs_tpu.utils.jax_compat import shard_map, tpu_compiler_params
 
 NEG_INF = -1e30
 
@@ -34,7 +36,7 @@ NEG_INF = -1e30
 # on the kernel at the bench packed shape (S=4096: 1.79 -> 1.20 ms, 19.5 ->
 # 29.1% MFU; S=2048: 1.8x).  The wrapper clamps blocks to the sequence, so
 # small buckets degrade gracefully.  Env knob for A/B sweeps.
-_DEFAULT_BLOCK = int(os.environ.get("LMRS_FLASH_BLOCK", "1024"))
+_DEFAULT_BLOCK = env_int("LMRS_FLASH_BLOCK", 1024, lo=128)
 
 
 def _flash_kernel(
@@ -215,7 +217,7 @@ def flash_attention(
             pltpu.VMEM((q_block, 128), jnp.float32),
             pltpu.VMEM((q_block, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -245,7 +247,7 @@ def flash_attention_sharded(
 
     head4 = P(None, None, "tp", None)
     if segment_ids is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(flash_attention, interpret=interpret),
             mesh=mesh,
             in_specs=(head4, head4, head4, P(None)),
@@ -253,7 +255,7 @@ def flash_attention_sharded(
             check_vma=False,
         )
         return fn(q, k, v, lengths)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q_, k_, v_, l_, s_: flash_attention(
             q_, k_, v_, l_, interpret=interpret, segment_ids=s_),
         mesh=mesh,

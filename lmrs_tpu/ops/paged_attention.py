@@ -51,6 +51,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from lmrs_tpu.utils.jax_compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -1227,7 +1229,7 @@ def paged_decode_fused_sharded(
             q_, kn_, vn_, kp_, vp_, pt_, kl_, interpret=interpret,
             kscale=ks_, vscale=vs_, row_group=row_group)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         call,
         mesh=mesh,
         in_specs=(head, head, head, pool, pool, P(None, None), P(None),

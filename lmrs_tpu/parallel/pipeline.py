@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from lmrs_tpu.config import ModelConfig
 from lmrs_tpu.models.transformer import decoder_layer, embed_tokens, lm_head
 from lmrs_tpu.ops.rope import rope_table
+from lmrs_tpu.utils.jax_compat import shard_map
 
 
 def _stage_scan(layers_local, cfg: ModelConfig, x, positions, sin, cos):
@@ -147,7 +148,7 @@ def pipeline_causal_lm_loss(
             loss = loss + cfg.router_aux_coef * aux_sum / jnp.maximum(aux_count, 1)
         return loss
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(param_specs, P(dp_axis)),

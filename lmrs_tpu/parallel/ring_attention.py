@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from lmrs_tpu.ops.attention import NEG_INF, _repeat_kv
+from lmrs_tpu.utils.jax_compat import shard_map
 
 
 def ring_attention(
@@ -116,7 +117,7 @@ def ring_attention_sharded(
     """
     qkv_spec = P(batch_axis, seq_axis, head_axis, None)
     pos_spec = P(batch_axis, seq_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=seq_axis, logit_softcap=logit_softcap),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, pos_spec, pos_spec),

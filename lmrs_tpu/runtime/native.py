@@ -81,7 +81,9 @@ def _set_loaded(lib: ctypes.CDLL | None) -> None:
 
 
 def _try_load() -> ctypes.CDLL | None:
-    if os.environ.get("LMRS_NATIVE", "1").strip().lower() in ("0", "false", "off"):
+    from lmrs_tpu.utils.env import env_bool
+
+    if not env_bool("LMRS_NATIVE", True):
         return None
     if not _SRC.exists():
         return None

@@ -15,6 +15,7 @@ import logging
 
 from lmrs_tpu.config import EngineConfig, parse_mesh
 from lmrs_tpu.engine.api import make_engine
+from lmrs_tpu.utils.env import env_bool
 from lmrs_tpu.utils.logging import setup_logging
 
 logger = logging.getLogger("lmrs.serving")
@@ -71,14 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
-    import os
-
     args = build_parser().parse_args(argv)
     setup_logging(quiet=args.quiet)
     from lmrs_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()
-    if args.trace or os.environ.get("LMRS_TRACE", "") not in ("", "0"):
+    if args.trace or env_bool("LMRS_TRACE", False):
         # before the engine builds: the scheduler captures the tracer per
         # run, and serving spans must cover the first request
         from lmrs_tpu.obs import enable_tracing

@@ -112,7 +112,7 @@ class TicketRegistry:
     def __init__(self, clock=time.time):
         self._clock = clock
         self._lock = threading.Lock()
-        self._tickets: dict[str, dict] = {}
+        self._tickets: dict[str, dict] = {}  # guarded-by: _lock
 
     def create(self, request_id: int, deadline_t: float,
                trace_id: str | None = None) -> str:
@@ -174,7 +174,8 @@ class ImportLog:
     def __init__(self, cap: int = 4096):
         self._cap = cap
         self._lock = threading.Lock()
-        self._seen: dict[str, None] = {}  # insertion-ordered
+        # insertion-ordered FIFO
+        self._seen: dict[str, None] = {}  # guarded-by: _lock
 
     def seen(self, ticket: str) -> bool:
         with self._lock:

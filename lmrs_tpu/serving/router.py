@@ -96,12 +96,27 @@ class _Host:
         self.netloc = u.netloc or u.path  # tolerate bare host:port
         self.url = f"http://{self.netloc}"
         self.role = role
+        # ``healthy`` is a bare bool STORE (atomic under the GIL, last
+        # writer wins — an acceptable belief flag); the request counters
+        # are read-modify-writes and increment under the per-host lock:
+        # _one() runs per request on the dispatch pool, and bare ``+=``
+        # from concurrent legs was losing updates (the same class as the
+        # PR 6 handoff-counter fix, now machine-checked via guarded-by).
         self.healthy = True
-        self.served = 0
-        self.failed = 0
+        self._count_lock = threading.Lock()
+        self.served = 0  # guarded-by: _count_lock
+        self.failed = 0  # guarded-by: _count_lock
         # earliest clock time the next recovery probe may launch (probe
         # pacing lives in RouterEngine._launch_probes; 0 = probe freely)
         self.next_probe_t = 0.0
+
+    def note_served(self) -> None:
+        with self._count_lock:
+            self.served += 1
+
+    def note_failed(self) -> None:
+        with self._count_lock:
+            self.failed += 1
 
     def connect(self, timeout: float) -> http.client.HTTPConnection:
         # injection site: a connection-phase fault, raised AS the
@@ -159,9 +174,9 @@ class RouterEngine:
         # handoff accounting (Prometheus via prometheus_metrics).  _one
         # runs concurrently on the dispatch pool, so increments go through
         # _count (a bare += is a read-modify-write that loses updates)
-        self._handoffs = 0          # tickets successfully followed
-        self._handoff_retries = 0   # failed decode-leg attempts
-        self._handoff_fallbacks = 0  # disagg flows degraded to colocated
+        self._handoffs = 0          # guarded-by: _stats_lock
+        self._handoff_retries = 0   # guarded-by: _stats_lock
+        self._handoff_fallbacks = 0  # guarded-by: _stats_lock
         self._stats_lock = threading.Lock()
         # Durable-job forwarding (docs/ROBUSTNESS.md § Durable jobs): the
         # front server calls job_request() for /v1/jobs traffic; jobs
@@ -170,10 +185,10 @@ class RouterEngine:
         # the fleet on the first GET/DELETE of an unknown id — so it is
         # bounded (oldest-pinned evicted; an evicted id just re-scans),
         # same pattern as the handoff ImportLog.
-        self._job_hosts: dict[str, str] = {}   # job id -> netloc
+        self._job_hosts: dict[str, str] = {}   # guarded-by: _job_lock
         self._job_hosts_max = 4096
         self._job_lock = threading.Lock()
-        self._jobs_forwarded = 0
+        self._jobs_forwarded = 0  # guarded-by: _stats_lock
         # per-recv socket timeout: must exceed the worst-case SILENT wait —
         # a non-streamed generation sends nothing until it completes
         self.timeout_s = timeout_s
@@ -198,18 +213,30 @@ class RouterEngine:
         # SSE, so conn.sock is None exactly when a hangup matters most);
         # the lock guards the dict, not the sockets: shutting down a
         # socket another thread is reading is the POINT
-        self._inflight: dict[int, object] = {}
+        self._inflight: dict[int, object] = {}  # guarded-by: _inflight_lock
         self._inflight_lock = threading.Lock()
         # cancel ids are WAVE-scoped (created per _wave, dropped with it):
         # a persistent set would let a stale cancel for a rid that never
         # appears poison an identically-numbered request in a LATER wave,
         # violating the unknown-ids-no-op contract.  A cancel landing
         # between waves no-ops — same contract as an already-finished id.
-        self._wave_cancelled: set[int] | None = None
+        # A LIST of the live waves' sets, not a singleton: waves can run
+        # concurrently (routers fronting routers, the jobs facade), and a
+        # singleton slot would let wave B's registration clobber wave A's
+        # — a cancel for an A-rid would land only in B's set and A would
+        # misclassify its own hangup as a host failure.  cancel() adds
+        # the rid to every wave live AT CANCEL TIME (a rid matches checks
+        # only in the wave that owns it, so foreign sets are inert), and
+        # waves created later never see it — the staleness contract above
+        # holds per wave.
+        self._wave_cancel_sets: list[set[int]] = []  # guarded-by: _stats_lock
         # round-robin base advances ACROSS waves: a wave-local index would
         # pin every single-request wave (hierarchical reduce tails) onto
-        # hosts[0] while the rest of the fleet idles
-        self._rr_base = 0
+        # hosts[0] while the rest of the fleet idles.  Engine-protocol
+        # callers may run waves concurrently (a router can front other
+        # routers, and the jobs facade shares the dispatch pool), so the
+        # advance is a locked fetch-add, not a bare +=.
+        self._rr_base = 0  # guarded-by: _stats_lock
 
     def _count(self, attr: str) -> None:
         """Increment a handoff counter atomically (dispatch-pool threads)."""
@@ -232,9 +259,9 @@ class RouterEngine:
         contract).  Non-streamed cancels lose any partly generated text
         (the only copy was on the hung-up socket); streamed cancels keep
         the deltas already received."""
-        wave = self._wave_cancelled
-        if wave is not None:
-            wave.add(request_id)
+        with self._stats_lock:
+            for wave in self._wave_cancel_sets:
+                wave.add(request_id)
         with self._inflight_lock:
             target = self._inflight.get(request_id)
         if target is None:
@@ -472,7 +499,7 @@ class RouterEngine:
                     status, payload = self._job_call(host, method, path,
                                                      body, trace_id)
                 except Exception as e:  # noqa: BLE001 - next host
-                    host.failed += 1
+                    host.note_failed()
                     last = (502, {"error": {
                         "message": f"{host.netloc}: {type(e).__name__}: {e}",
                         "type": "job_error"}})
@@ -588,9 +615,11 @@ class RouterEngine:
 
     def _wave(self, requests: list[GenerationRequest],
               on_tokens) -> list[GenerationResult]:
-        self._wave_cancelled = cancelled = set()
-        base = self._rr_base
-        self._rr_base += len(requests)
+        cancelled: set[int] = set()
+        with self._stats_lock:
+            self._wave_cancel_sets.append(cancelled)
+            base = self._rr_base
+            self._rr_base += len(requests)
         # recovery probes run CONCURRENTLY with the wave, on unhealthy
         # hosts only — a restarted worker re-admits without waiting for
         # total fleet failure (ReplicatedEngine's probe loop, ported);
@@ -605,7 +634,8 @@ class RouterEngine:
             ]
             return [f.result() for f in futures]
         finally:
-            self._wave_cancelled = None
+            with self._stats_lock:
+                self._wave_cancel_sets.remove(cancelled)
 
     def _launch_probes(self) -> list[_Host]:
         """Submit a /healthz probe for each unhealthy host whose pacing
@@ -697,7 +727,7 @@ class RouterEngine:
             streamed = [0]  # deltas already forwarded on THIS request
             try:
                 res = self._post(host, req, on_tokens, streamed, cancelled)
-                host.served += 1
+                host.note_served()
                 host.healthy = True
                 return res
             except Exception as e:  # noqa: BLE001 - degrade per request
@@ -705,7 +735,7 @@ class RouterEngine:
                     # the hangup WE caused: report the abort, not an error
                     return GenerationResult(request_id=rid,
                                             finish_reason="cancelled")
-                host.failed += 1
+                host.note_failed()
                 if isinstance(e, _HostConnectError):
                     # only a connect-phase failure condemns the host: a
                     # slow completion's socket timeout or a truncated
@@ -753,7 +783,7 @@ class RouterEngine:
                 if rid in cancelled:
                     return GenerationResult(request_id=rid,
                                             finish_reason="cancelled")
-                host.failed += 1
+                host.note_failed()
                 if isinstance(e, _HostConnectError):
                     host.healthy = False
                 logger.warning("prefill leg for %d failed on %s: %s: %s",
@@ -762,16 +792,16 @@ class RouterEngine:
             host.healthy = True
             if kind == "result":
                 if out.finish_reason == "error":
-                    host.failed += 1
+                    host.note_failed()
                     continue  # next prefill host, then colocated fallback
                 # first token was terminal (EOS/stop/1-token budget) or a
                 # deadline outcome: the prefill response IS the completion
-                host.served += 1
+                host.note_served()
                 if on_tokens is not None and out.text:
                     on_tokens(rid, out.text)
                 return out
             ticket = out  # {"ticket", "source", "first_text", ...}
-            host.served += 1  # a minted ticket IS a served prefill leg
+            host.note_served()  # a minted ticket IS a served prefill leg
             break
         if ticket is None:
             return None  # no prefill pod could mint a ticket: fall back
@@ -806,7 +836,7 @@ class RouterEngine:
                 if rid in cancelled:
                     return GenerationResult(request_id=rid,
                                             finish_reason="cancelled")
-                host.failed += 1
+                host.note_failed()
                 if isinstance(e, _HostConnectError):
                     host.healthy = False
                 self._count("_handoff_retries")
@@ -823,14 +853,14 @@ class RouterEngine:
                 # marked handoff failure (410 gone, duplicate, transfer
                 # fault, import failure): try a sibling decode host while
                 # the ticket may still be live, then fall back
-                host.failed += 1
+                host.note_failed()
                 self._count("_handoff_retries")
                 logger.warning("decode leg for %d rejected on %s: %s",
                                rid, host.netloc, res.error)
                 if streamed[0]:
                     return res
                 continue
-            host.served += 1
+            host.note_served()
             host.healthy = True
             return res
         return None if not streamed[0] else GenerationResult(

@@ -285,9 +285,9 @@ def injected(plan: FaultPlan):
 # plane for the whole process (every call site imports this module, so the
 # env var alone reaches server/router/engine without config plumbing).
 def _install_from_env() -> None:
-    import os
+    from lmrs_tpu.utils.env import env_str
 
-    spec = os.environ.get("LMRS_FAULT_PLAN", "")
+    spec = env_str("LMRS_FAULT_PLAN")
     if spec:
         try:
             install_spec(spec)

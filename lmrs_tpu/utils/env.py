@@ -1,0 +1,139 @@
+"""Validated parser for ``LMRS_*`` environment knobs — the ONE env read path.
+
+Every ``LMRS_*`` read in the tree routes through these helpers; the
+``lmrs-lint`` env pass (``lmrs_tpu/analysis/envpass.py``) enforces that no
+new ``os.environ``/``getenv`` call site for an ``LMRS_`` name appears
+outside this module.  The rules exist because ad-hoc parsing produced real
+production bugs (PR 8's review round):
+
+* **empty means default** — ``LMRS_POSTMORTEM_MIN_S=""`` silently parsed
+  to an unthrottled ``0``; an ``export NAME=`` must behave like unset;
+* **numbers must be finite** — a NaN ``duration_s`` survived ``min``/
+  ``max`` clamps and wedged the profiler's capture flag forever; NaN/inf
+  never escape these helpers;
+* **bad values degrade, never crash** — ``LMRS_FLASH_BLOCK=""`` used to
+  raise ``ValueError`` at *module import*; here a warning is logged once
+  per knob and the default is used;
+* **bounds clamp** — callers state the valid range once, next to the
+  default.
+
+Reads are recorded in :data:`KNOWN_READS` (name -> kind) so tooling — the
+lint pass and the ``docs/KNOBS.md`` drift checker — can enumerate the live
+knob surface of whatever modules are imported.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+
+logger = logging.getLogger("lmrs.env")
+
+# knob name -> kind ("str" | "bool" | "int" | "float" | "list"), recorded
+# at read time; the analysis drift checker enumerates env reads statically
+# (AST), this runtime map is the debugging/introspection view
+KNOWN_READS: dict[str, str] = {}
+
+_TRUTHY = frozenset(("1", "true", "yes", "on"))
+_FALSY = frozenset(("0", "false", "no", "off"))
+
+_warned: set[str] = set()
+
+
+def _warn_once(name: str, message: str) -> None:
+    if name not in _warned:
+        _warned.add(name)
+        logger.warning("%s: %s", name, message)
+
+
+def _raw(name: str, kind: str) -> str | None:
+    """The raw value, with unset / empty / whitespace-only folded to None
+    (the empty-string-means-default rule)."""
+    KNOWN_READS[name] = kind
+    raw = os.environ.get(name)
+    if raw is None:
+        return None
+    raw = raw.strip()
+    return raw or None
+
+
+def env_str(name: str, default: str = "", *,
+            choices: tuple[str, ...] | None = None) -> str:
+    """String knob; values outside ``choices`` (when given, compared
+    case-insensitively) warn and fall back to the default."""
+    raw = _raw(name, "str")
+    if raw is None:
+        return default
+    if choices is not None and raw.lower() not in choices:
+        _warn_once(name, f"unknown value {raw!r} (choices: "
+                         f"{', '.join(choices)}); using {default!r}")
+        return default
+    return raw
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Boolean knob: 1/true/yes/on vs 0/false/no/off (case-insensitive).
+    Anything else warns and keeps the default — a typo'd kill switch must
+    be visible, not silently truthy."""
+    raw = _raw(name, "bool")
+    if raw is None:
+        return default
+    low = raw.lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    _warn_once(name, f"not a boolean: {raw!r}; using {default}")
+    return default
+
+
+def env_int(name: str, default: int, *, lo: int | None = None,
+            hi: int | None = None) -> int:
+    raw = _raw(name, "int")
+    if raw is None:
+        return default
+    try:
+        val = int(raw, 10)
+    except ValueError:
+        _warn_once(name, f"not an integer: {raw!r}; using {default}")
+        return default
+    return _clamp(name, val, lo, hi)
+
+
+def env_float(name: str, default: float, *, lo: float | None = None,
+              hi: float | None = None) -> float:
+    """Float knob with the finite guard: NaN and ±inf are rejected (they
+    survive min/max clamps and poison downstream arithmetic — the wedged-
+    profiler bug class)."""
+    raw = _raw(name, "float")
+    if raw is None:
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        _warn_once(name, f"not a number: {raw!r}; using {default}")
+        return default
+    if not math.isfinite(val):
+        _warn_once(name, f"non-finite value {raw!r}; using {default}")
+        return default
+    return _clamp(name, val, lo, hi)
+
+
+def env_list(name: str, default: tuple[str, ...] = ()) -> tuple[str, ...]:
+    """Comma-separated list knob (``LMRS_HOSTS=a:1,b:2``); empty items
+    dropped."""
+    raw = _raw(name, "list")
+    if raw is None:
+        return tuple(default)
+    return tuple(item.strip() for item in raw.split(",") if item.strip())
+
+
+def _clamp(name: str, val, lo, hi):
+    if lo is not None and val < lo:
+        _warn_once(name, f"value {val} below minimum {lo}; clamping")
+        return lo
+    if hi is not None and val > hi:
+        _warn_once(name, f"value {val} above maximum {hi}; clamping")
+        return hi
+    return val

@@ -1,0 +1,48 @@
+"""Version-bridging wrappers for JAX APIs that moved between releases.
+
+The repo targets the modern public surface (``jax.shard_map`` with its
+``check_vma`` kwarg) but must also run on the pinned CPU build
+(jax 0.4.37), where ``shard_map`` still lives in ``jax.experimental``
+under the older ``check_rep`` spelling — accessing ``jax.shard_map``
+there raises ``AttributeError`` from the deprecation registry.
+
+All call sites import from HERE (the lmrs-lint deprecated-API sub-pass
+flags direct ``jax.shard_map`` / ``jax.experimental.shard_map`` use
+anywhere else), so the day the old build is dropped this module shrinks
+to one line instead of a five-file sweep.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):  # modern surface (jax >= 0.6)
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:  # pinned 0.4.x: experimental home, check_rep spelling
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+shard_map.__doc__ = """``jax.shard_map`` on every supported jax.
+
+Keyword-only, mirroring the modern signature; ``check_vma`` maps onto the
+legacy ``check_rep`` on 0.4.x builds (same meaning: verify per-axis value
+replication instead of trusting ``out_specs``)."""
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across the rename: modern Pallas calls it
+    ``CompilerParams``, 0.4.x ``TPUCompilerParams`` — same fields
+    (``dimension_semantics`` et al.)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

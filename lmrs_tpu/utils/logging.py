@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
+
+from lmrs_tpu.utils.env import env_bool
 
 _FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
 
@@ -54,7 +55,7 @@ def setup_logging(quiet: bool = False, level: int | None = None,
     Safe to call repeatedly — later calls update level/stream/format."""
     root = logging.getLogger("lmrs")
     formatter: logging.Formatter = (
-        JsonFormatter() if os.environ.get("LMRS_LOG_JSON") == "1"
+        JsonFormatter() if env_bool("LMRS_LOG_JSON", False)
         else logging.Formatter(_FORMAT))
     handler = _managed_handler(root)
     if handler is None:

@@ -7,7 +7,6 @@ LMRS_AB_KV=int8: both arms run int8 KV pools (the r4 composition row —
 packed+int8 vs unpacked+int8, VERDICT r3 item 3).
 """
 import _pathfix  # noqa: F401  (repo-root import shim)
-import os
 import sys
 import time
 
@@ -19,12 +18,14 @@ from lmrs_tpu.utils.logging import setup_logging
 
 from _bench_common import wave
 
+from lmrs_tpu.utils.env import env_str
+
 
 def main():
     max_new = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     setup_logging(quiet=True)
     model = model_preset("bench-1b")
-    kv = os.environ.get("LMRS_AB_KV") or None
+    kv = env_str("LMRS_AB_KV") or None
     eng = JaxEngine(EngineConfig(
         backend="jax", max_tokens=max_new, max_batch_slots=24,
         retry_delay=0.0, seed=0, page_size=512, num_pages=1,

@@ -25,7 +25,6 @@ LMRS_ROWCOST_INTERPRET=1 (Pallas interpret mode — the CPU-only stand-in
 harness: us/kernel numbers then measure the emulator and are only
 meaningful RELATIVE to each other per arm, never absolutely).
 """
-import os
 
 import _pathfix  # noqa: F401
 import jax
@@ -36,13 +35,14 @@ from lmrs_tpu.ops.paged_attention import (
     paged_decode_pallas,
     paged_decode_pallas_fused,
 )
+from lmrs_tpu.utils.env import env_bool, env_list
 from lmrs_tpu.utils.perf_model import time_chain
 
 KH, NREP, HD, PS = 8, 2, 128, 512   # bench-1b attention shape
 LIVE = 64
 LO, HI = 64, 2048
 REPS = 5
-INTERPRET = os.environ.get("LMRS_ROWCOST_INTERPRET", "") == "1"
+INTERPRET = env_bool("LMRS_ROWCOST_INTERPRET", False)
 
 
 def make_chain(arm, iters, kn, vn, pt, kl, row_group=1):
@@ -70,8 +70,8 @@ def main():
     lo, hi, reps = LO, HI, REPS
     if INTERPRET:  # emulator chains are ~1000x slower; keep the harness usable
         lo, hi, reps = 2, 8, 2
-    groups = [int(g) for g in
-              os.environ.get("LMRS_ROWCOST_GROUPS", "2,4,8").split(",") if g]
+    groups = [int(g) for g in env_list("LMRS_ROWCOST_GROUPS",
+                                       ("2", "4", "8"))]
     arms = [("walk", 1), ("fused", 1)]
     for g in groups:
         arms += [(f"walk_g{g}", g), (f"fused_g{g}", g)]

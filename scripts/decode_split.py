@@ -9,7 +9,6 @@ LMRS_SPLIT_GROUP (decode_row_group, default 4; LMRS_MULTIROW=0 is the
 per-row A/B control — the refreshed-intercept measurement for the
 multi-row page walk is this script run with both settings).
 """
-import os
 import time
 
 
@@ -22,18 +21,19 @@ from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 from lmrs_tpu.utils.perf_model import decode_step_bytes, weight_bytes
+from lmrs_tpu.utils.env import env_int, env_str
 
 
 def main():
     setup_logging(quiet=True)
-    model = model_preset(os.environ.get("LMRS_SPLIT_MODEL", "bench-1b"))
-    quant = os.environ.get("LMRS_SPLIT_QUANT", "")
+    model = model_preset(env_str("LMRS_SPLIT_MODEL", "bench-1b"))
+    quant = env_str("LMRS_SPLIT_QUANT")
     eng = JaxEngine(EngineConfig(
         backend="jax", max_tokens=128, max_batch_slots=24,
         retry_delay=0.0, seed=0,
-        page_size=int(os.environ.get("LMRS_SPLIT_PS", "512")), num_pages=1,
+        page_size=env_int("LMRS_SPLIT_PS", 512, lo=8), num_pages=1,
         decode_block=128, prefill_chunk=4096, tokenizer="byte",
-        decode_row_group=int(os.environ.get("LMRS_SPLIT_GROUP", "4")),
+        decode_row_group=env_int("LMRS_SPLIT_GROUP", 4, lo=1),
         quantize=quant or None, kv_quantize=quant or None), model)
     sched = eng._scheduler
     print(f"decode_row_group={sched._row_group} "

@@ -2,12 +2,13 @@
 bench-1b int8 W+KV at decode_block=16 — the TTFT / per-block-gap numbers a
 streaming client sees, from the scheduler's always-on samples.
 LMRS_SERVE_MODEL overrides the preset (e.g. bench-8b)."""
-import json, os, sys, time
+import json, sys, time
 sys.path.insert(0, "/root/repo")
 import numpy as np
 from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.utils.env import env_str
 
-MODEL = os.environ.get("LMRS_SERVE_MODEL", "bench-1b")
+MODEL = env_str("LMRS_SERVE_MODEL", "bench-1b")
 from lmrs_tpu.engine.api import GenerationRequest
 from lmrs_tpu.engine.jax_engine import JaxEngine
 

@@ -527,9 +527,6 @@ def test_multirow_balanced_row_order():
                                   np.argsort(-np.array([3, 3]), kind="stable"))
 
 
-@pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                    reason="this jax build has no jax.shard_map (same env "
-                           "gap as the pre-existing sharded-kernel tests)")
 def test_multirow_sharded_fused_matches_xla():
     """The shard_map-wrapped fused kernel with row grouping under a tp=2
     mesh keeps the XLA reference contract (per-shard group walks)."""
@@ -561,8 +558,11 @@ def test_multirow_sharded_fused_matches_xla():
         _tp_mesh(), interpret=True, row_group=2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
-    np.testing.assert_array_equal(np.asarray(k_out), np.asarray(k_ref))
-    np.testing.assert_array_equal(np.asarray(v_out), np.asarray(v_ref))
+    # page 0 is the reserved null page (engine contract: never read as
+    # data); with b % G != 0 the grouped kernel's padded row RMWs it as
+    # scratch, so the pool comparison starts at page 1
+    np.testing.assert_array_equal(np.asarray(k_out)[1:], np.asarray(k_ref)[1:])
+    np.testing.assert_array_equal(np.asarray(v_out)[1:], np.asarray(v_ref)[1:])
 
 
 def test_multi_token_verify_out_of_span_skips_on_both_paths():
