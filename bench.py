@@ -385,7 +385,20 @@ def _scheduler_window(sched, before: dict) -> dict:
             "decode": m["decode_tokens"] - before["decode_tokens"],
         },
         "ttft_ms": report["ttft_ms"],
-        "decode_block_gap_ms": report["decode_block_gap_ms"],
+        # WAVE-LEVEL gaps (docs/PERF.md "two block-gap numbers"): on this
+        # batch workload the samples include whole admission/prefill
+        # waves between decode dispatches (BENCH8B_r05's 7.65 s p50 is
+        # queueing, NOT serving cadence); the steady-state per-block
+        # number a streaming client sees is serving_latency.py's
+        # decode_block_gap_ms_steady_state.  Named distinctly so a
+        # verdict can never compare the two as if they measured the same
+        # thing.
+        "decode_block_gap_ms_wave": report["decode_block_gap_ms"],
+        # SARATHI mixed batches over the timed window (ISSUE 11): fused
+        # dispatches, budget fill, and the prompt tokens that rode decode
+        # steps instead of dedicated prefill waves — plus the wave gap
+        # percentiles above, the MULTICHIP/BENCH tracking trio
+        "mixed_batch": sched._mixed_report(before),
         # disaggregated handoff over the timed window: export/import
         # counts and orphaned pages are zero on a colocated bench by
         # construction — the block exists so MULTICHIP_* rounds that run

@@ -185,6 +185,24 @@ class EngineConfig:
     # LMRS_DECODE_ROW_GROUP overrides the group size.
     decode_row_group: int = field(
         default_factory=lambda: _env("LMRS_DECODE_ROW_GROUP", 4, int))
+    # SARATHI-style mixed batches (PAPERS.md): while a prompt is mid-
+    # prefill, each scheduler step dispatches ONE fused batch carrying all
+    # live decode rows (one token each) plus a chunked-prefill slice
+    # clipped to `mixed_token_budget - decode_tokens`, through the ragged
+    # multi-token row-group path — decode cadence never pauses for an
+    # admission and prefill rides the decode step's spare FLOPs (the
+    # block-gap / TTFT coupling ROADMAP item 1 measured).  LMRS_MIXED=0 is
+    # the kill switch (exact alternating prefill/decode dispatch — same
+    # A/B convention as LMRS_PACK_PREFILL / LMRS_MULTIROW).  Auto-disabled
+    # with kv_quantize (a mixed chunk cannot own its slot's frozen
+    # prefill scales) and under sp>1 meshes (ring prefill replaces
+    # chunking there, so there is no slice to piggyback).
+    mixed_batch: bool = True
+    # Token budget of one mixed step: live decode tokens first, the
+    # remainder is the prefill slice (clipped; a budget the decode rows
+    # already exhaust falls back to alternating dispatch for that step).
+    mixed_token_budget: int = field(
+        default_factory=lambda: _env("LMRS_MIXED_TOKEN_BUDGET", 256, int))
     # prompt-lookup speculative decoding: draft length per step (0 = off).
     # Exact-distribution verify (ops/speculative.py) — output quality is
     # unchanged; latency drops when summaries quote their source.
@@ -257,6 +275,11 @@ class EngineConfig:
             raise ValueError(f"decode_row_group must be >= 1 "
                              f"(got {self.decode_row_group}); use "
                              "LMRS_MULTIROW=0 to disable row grouping")
+        if self.mixed_token_budget < 32:
+            raise ValueError(f"mixed_token_budget must be >= 32 "
+                             f"(got {self.mixed_token_budget}); use "
+                             "mixed_batch=False / LMRS_MIXED=0 to disable "
+                             "mixed dispatch")
         if self.request_deadline_s < 0:
             raise ValueError(f"request_deadline_s must be >= 0 "
                              f"(got {self.request_deadline_s}); 0 disables "

@@ -255,7 +255,9 @@ def make_engine(
         from lmrs_tpu.engine.mock import MockEngine
 
         return MockEngine(seed=engine_cfg.seed,
-                          handoff_ttl_s=engine_cfg.handoff_ttl_s)
+                          handoff_ttl_s=engine_cfg.handoff_ttl_s,
+                          mixed_batch=engine_cfg.mixed_batch,
+                          mixed_token_budget=engine_cfg.mixed_token_budget)
     if engine_cfg.backend == "jax":
         from lmrs_tpu.config import ModelConfig, model_preset
 

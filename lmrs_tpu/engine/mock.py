@@ -49,11 +49,33 @@ class MockEngine:
 
     def __init__(self, seed: int = 0, latency_s: float = 0.0,
                  fail_pattern: str | None = None,
-                 handoff_ttl_s: float = 60.0):
+                 handoff_ttl_s: float = 60.0,
+                 mixed_batch: bool | None = None,
+                 mixed_token_budget: int = 256):
+        from lmrs_tpu.utils.env import env_bool
+
         self.seed = seed
         self.latency_s = latency_s
         self.fail_pattern = fail_pattern
         self.handoff_ttl_s = handoff_ttl_s
+        # SARATHI mixed-batch emulation (the scheduler's admission
+        # interleave, on the no-device arm): the mock generates each
+        # request instantly, so nothing can actually stall — what CI
+        # needs is the same KNOB surface and accounting the jax engine
+        # exposes.  When armed, every same-batch request admitted behind
+        # the first is accounted as prefilling in budget-clipped slices
+        # that ride the earlier requests' decode steps; deterministic,
+        # text-identical either way (serving/jobs tests exercise the A/B
+        # arms and the metrics block on CPU).  The LMRS_MIXED kill switch
+        # composes with the config flag exactly as in the scheduler: env
+        # 0 always disarms, config False always disarms.
+        self.mixed_batch = (env_bool("LMRS_MIXED", True)
+                            and (mixed_batch is None or bool(mixed_batch)))
+        self.mixed_token_budget = max(32, int(mixed_token_budget))
+        self._mixed_lock = threading.Lock()
+        self._mixed_dispatches = 0  # guarded-by: _mixed_lock
+        self._mixed_piggybacked = 0  # guarded-by: _mixed_lock
+        self._mixed_fill_sum = 0.0  # guarded-by: _mixed_lock
         self._tok = ApproxTokenizer()
         # ids cancel() was called for — generation is instantaneous here, so
         # the hook only records (tests assert the server propagated a
@@ -96,6 +118,7 @@ class MockEngine:
                 on_tokens(res.request_id, res.text)
             return res
 
+        self._note_mixed_batch(requests)
         try:
             if on_result is not None:
                 from lmrs_tpu.engine.api import drain_with_callback
@@ -105,6 +128,29 @@ class MockEngine:
             return [one(r) for r in requests]
         finally:
             self.cancelled.clear()
+
+    def _note_mixed_batch(self, requests: list[GenerationRequest]) -> None:
+        """Mixed-batch accounting on the no-device arm: requests admitted
+        behind the first in a batch are accounted as chunked prefills
+        riding the earlier requests' decode steps, slice-clipped to the
+        step budget — the same counters (dispatches, piggybacked tokens,
+        fill) the scheduler's fused dispatcher reports, so serving/jobs
+        CI can assert the knob surface end-to-end without a device.
+        Deterministic and output-free: the mock's text is untouched."""
+        if not self.mixed_batch or len(requests) < 2:
+            return
+        n_decode = len(requests) - 1  # rows decoding while the rest admit
+        slice_cap = max(16, self.mixed_token_budget - n_decode)
+        with self._mixed_lock:
+            for req in requests[1:]:
+                remaining = self._tok.count(req.prompt)
+                while remaining > 0:
+                    c = min(remaining, slice_cap)
+                    self._mixed_dispatches += 1
+                    self._mixed_piggybacked += c
+                    self._mixed_fill_sum += min(
+                        (n_decode + c) / self.mixed_token_budget, 1.0)
+                    remaining -= c
 
     def shutdown(self) -> None:
         pass
@@ -116,7 +162,20 @@ class MockEngine:
         self.cancelled.add(request_id)
 
     def engine_metrics(self) -> dict:
-        return {}
+        with self._mixed_lock:
+            d, p, f = (self._mixed_dispatches, self._mixed_piggybacked,
+                       self._mixed_fill_sum)
+        if not d:
+            # no mixed work recorded (fresh engine, or mixed off): the
+            # mock reports no engine metrics, as it always has
+            return {}
+        return {"mixed_batch": {
+            "enabled": self.mixed_batch,
+            "token_budget": self.mixed_token_budget,
+            "dispatches": d,
+            "fill_ratio": round(f / d, 3) if d else 0.0,
+            "prefill_tokens_piggybacked": p,
+        }}
 
     # ---------------------------------------- disaggregated handoff hooks
 

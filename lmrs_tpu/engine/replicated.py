@@ -245,11 +245,30 @@ class ReplicatedEngine:
         secs = max((m.get("scheduler_seconds", 0.0) for m in per), default=0.0)
         prefill = sum(m.get("prefill_tokens", 0) for m in per)
         decode = sum(m.get("decode_tokens", 0) for m in per)
+        # mixed-batch fleet view: per-replica fused dispatchers compile
+        # their own bucketed mixed shapes; the fleet block sums their
+        # work and averages budget fill (same shape as one scheduler's
+        # mixed_batch block, minus the per-replica knobs)
+        mixed = [m.get("mixed_batch") for m in per]
+        mixed = [b for b in mixed if b]
+        mixed_block = {}
+        if mixed:
+            disp = sum(b.get("dispatches", 0) for b in mixed)
+            mixed_block = {"mixed_batch": {
+                "enabled": any(b.get("enabled") for b in mixed),
+                "dispatches": disp,
+                "fill_ratio": round(
+                    sum(b.get("fill_ratio", 0.0) * b.get("dispatches", 0)
+                        for b in mixed) / disp, 3) if disp else 0.0,
+                "prefill_tokens_piggybacked": sum(
+                    b.get("prefill_tokens_piggybacked", 0) for b in mixed),
+            }}
         return {
             "replicas": len(per),
             "healthy_replicas": sum(self._healthy),
             "prefill_tokens": prefill,
             "decode_tokens": decode,
+            **mixed_block,
             "prefill_tokens_per_sec": round(prefill / max(secs, 1e-9), 1),
             "decode_tokens_per_sec": round(decode / max(secs, 1e-9), 1),
             "mean_decode_occupancy": round(

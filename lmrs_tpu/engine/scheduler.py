@@ -241,6 +241,30 @@ class ContinuousScheduler:
             # sequence-sharded)
             logger.info("prefix cache disabled under sp>1 mesh")
             pc_on = False
+        # SARATHI-style mixed batches (config.EngineConfig.mixed_batch):
+        # while any slot is mid-prefill AND any slot is decoding, each
+        # step dispatches ONE fused multi-token batch — every live decode
+        # row carries one real token, one prefilling slot carries a prompt
+        # slice clipped to `mixed_token_budget - decode_tokens` — through
+        # the ragged multi-token path (paged_decode_pallas_multi /
+        # paged_decode_multi_xla; the row-group kernels already
+        # parametrize per-row token counts).  Decode cadence never pauses
+        # for an admission and prefill rides the decode step's spare
+        # FLOPs.  LMRS_MIXED=0 is the kill switch (exact alternating
+        # dispatch, the LMRS_PACK_PREFILL A/B convention).  Gated off:
+        #  * int8 KV — a mixed chunk dispatches through the frozen-scale
+        #    decode path and could never OWN its slot's prefill scales;
+        #  * sp>1 meshes — ring prefill replaced chunking, so there is no
+        #    prompt slice to piggyback.
+        # Speculation yields during mixed steps: decode rows advance one
+        # token per step (drafting needs the device history buffer
+        # appended in-scan; mixed steps re-seed it instead) and full spec
+        # blocks resume once the admission wave's prefill drains — greedy
+        # outputs are identical either way (exact-distribution verify).
+        self._mixed = (engine_cfg.mixed_batch and env_bool("LMRS_MIXED", True)
+                       and not self._kv_quant and not self._use_ring)
+        self.mixed_token_budget = max(32, engine_cfg.mixed_token_budget)
+        self._mixed_fns: dict[tuple[int, int], object] = {}
         self._prefix_cache: PrefixCache | None = None
         if pc_on:
             self._prefix_cache = PrefixCache(
@@ -263,6 +287,11 @@ class ContinuousScheduler:
         self._decode_fns: dict[int, object] = {}
         self._ran_ok: set = set()  # fn-cache keys that have executed once
         self._spec_buf = None  # device token-history buffer (speculation)
+        # rows whose device history row went stale during mixed steps
+        # (decode advanced outside the spec scan): re-seeded LAZILY at
+        # the next spec block, once per row per mixed window — an eager
+        # per-step seed would be O(B*max_len) host uploads per token
+        self._spec_stale: set[int] = set()
         self._on_tokens = None  # per-block streaming callback (run()-scoped)
         self._streamed: dict[int, str] = {}
         # Engine metrics (SURVEY.md §5.5: tokens/s, occupancy, HBM analog),
@@ -325,6 +354,16 @@ class ContinuousScheduler:
         self._c_prefix_tokens = c("lmrs_prefix_tokens_reused_total",
                                   "prompt tokens served from cached pages",
                                   "tokens")
+        # mixed-batch dispatch: real tokens (decode + piggybacked prefill
+        # slice) over the step's token budget, and the prompt tokens whose
+        # prefill rode a decode step instead of a dedicated prefill wave
+        self._h_mixed_fill = h("lmrs_mixed_batch_fill_ratio",
+                               buckets=RATIO_BUCKETS,
+                               help="real tokens over mixed_token_budget "
+                                    "per mixed fused dispatch")
+        self._c_piggybacked = c("lmrs_prefill_tokens_piggybacked_total",
+                                "prompt tokens prefilled inside mixed "
+                                "decode steps", "tokens")
         self._g_peak_pages = g("lmrs_peak_pages_in_use",
                                "max KV pages simultaneously allocated",
                                "pages")
@@ -457,6 +496,9 @@ class ContinuousScheduler:
             "handoff_imports": int(self._c_handoff_imports.value),
             "handoff_orphaned_pages": int(self._c_handoff_orphaned.value),
             "handoff_pinned_pages": int(self._g_pinned_pages.value),
+            "mixed_dispatches": int(self._h_mixed_fill.count),
+            "mixed_fill_sum": self._h_mixed_fill.sum,
+            "prefill_tokens_piggybacked": int(self._c_piggybacked.value),
         }
 
     def metrics_registry(self) -> MetricsRegistry:
@@ -551,12 +593,47 @@ class ContinuousScheduler:
             "peak_active_slots": m["peak_active_slots"],
             "ttft_ms": self._h_ttft.percentile_report(),
             "decode_block_gap_ms": self._h_block_gap.percentile_report(),
+            # Gap-scope label (docs/PERF.md "two block-gap numbers"):
+            # gaps are sampled between consecutive decode dispatches
+            # WITHIN each run().  On a steady serving stream that is the
+            # per-block cadence a client sees; on a batch/bench workload
+            # the same samples include whole admission/prefill waves
+            # between decode dispatches (BENCH8B_r05's 7.65 s p50 is
+            # wave-level queueing, NOT serving cadence — the capture's
+            # 363 ms is).  Consumers must not compare across scopes.
+            "decode_block_gap_scope": "within-run dispatch gaps "
+                                      "(wave-level on batch workloads; "
+                                      "steady-state only on serving "
+                                      "captures)",
             "queue_wait_ms": self._h_queue_wait.percentile_report(),
+            "mixed_batch": self._mixed_report(),
             "perf_attribution": self._perf.report(),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
             **({"prefix_cache": self._prefix_cache_report()}
                if self._prefix_cache is not None else {}),
+        }
+
+    def _mixed_report(self, before: dict | None = None) -> dict:
+        """Mixed-batch block of metrics_report() / bench detail / the
+        serving A/B harness: whether mixed dispatch is armed, how many
+        fused steps ran, budget fill, and the prompt tokens that rode
+        decode steps.  With ``before`` (a ``metrics`` snapshot) the work
+        fields are WINDOWED to the delta since the snapshot — the one
+        implementation of the windowed fill formula, so bench and the
+        A/B harness can never drift apart."""
+        m = self.metrics
+        b = before or {}
+        disp = m["mixed_dispatches"] - b.get("mixed_dispatches", 0)
+        fill = m["mixed_fill_sum"] - b.get("mixed_fill_sum", 0.0)
+        return {
+            "enabled": self._mixed,
+            "token_budget": self.mixed_token_budget,
+            "dispatches": disp,
+            "fill_ratio": round(fill / disp, 3) if disp else 0.0,
+            "prefill_tokens_piggybacked": (
+                m["prefill_tokens_piggybacked"]
+                - b.get("prefill_tokens_piggybacked", 0)),
         }
 
     def _prefix_cache_report(self) -> dict:
@@ -683,6 +760,9 @@ class ContinuousScheduler:
         # finally below) drops ids that were never matched.
         self._on_tokens = on_tokens
         self._streamed: dict[int, str] = {}  # rid -> text already emitted
+        # slot rows don't survive runs: stale-history marks from a prior
+        # run's mixed window mean nothing for this run's occupants
+        self._spec_stale.clear()
         # queue entries: (req, prefill_ids, max_new, n_prompt,
         # prior_generated, t_start) — the last three are preemption-
         # continuation state (len(ids), [], None for fresh requests)
@@ -900,6 +980,20 @@ class ContinuousScheduler:
                 if not (queue or any(s is not None for s in slots)):
                     break
                 admit()
+                # SARATHI mixed step: when a prompt is mid-prefill WHILE
+                # other slots decode, fuse one prompt slice into the
+                # decode step as a single multi-token dispatch — decode
+                # cadence continues through the admission instead of
+                # draining behind a packed prefill wave.  Falls through to
+                # the alternating path when there is nothing to mix (pure
+                # prefill / pure decode iterations are unchanged, so
+                # LMRS_MIXED=0 restores today's dispatch byte-for-byte).
+                if self._mixed:
+                    did, last_block_t = self._mixed_iteration(
+                        slots, queue, results, fresh, kv_lens, last_tok,
+                        active, temps, top_k, top_p, t_enq, last_block_t)
+                    if did:
+                        continue
                 # advance every prefilling slot by ONE prompt chunk, then give
                 # decode a turn — long prompts never monopolize the device.
                 # Same-shape chunks batch into one dispatch (a [N,S] prefill
@@ -1970,9 +2064,12 @@ class ContinuousScheduler:
     # ------------------------------------------- page growth / preemption
 
     def _ensure_decode_capacity(self, slots, queue, kv_lens, last_tok,
-                                active) -> list[int]:
+                                active, extra_tokens: int | None = None
+                                ) -> list[int]:
         """Grow each active decode slot's pages to cover the coming decode
-        block (admission reserved prompt pages only).  On pool exhaustion,
+        block — ``extra_tokens`` overrides the default block growth (a
+        mixed fused step advances decode rows by ONE token, so it grows by
+        one).  On pool exhaustion,
         preempt the YOUNGEST decode slot — free its pages and requeue it at
         the queue head as a continuation (prompt + generated-so-far
         re-prefills once pages free up) — and retry.  When no OTHER decode
@@ -1984,7 +2081,8 @@ class ContinuousScheduler:
         Deadlock-free: the pool holds at least one full-length sequence
         (pool sizing in __init__), so a slot alone in the pool always
         grows, and prefill slots always finish without growth."""
-        block = self.decode_block + self.spec_k
+        block = (self.decode_block + self.spec_k if extra_tokens is None
+                 else extra_tokens)
         stalled: list[int] = []
         for b in range(self.B):
             st = slots[b]
@@ -2145,6 +2243,284 @@ class ContinuousScheduler:
             finish = "stop" if (hit_eos or stop_hit) else "length"
             self._finish_slot(b, slots, results, active, fresh, kv_lens,
                               last_tok, gen, text, stop_hit, finish)
+
+    # ------------------------------------------------- mixed dispatch
+
+    def _pick_mixed_prefill(self, slots) -> int | None:
+        """The prefilling slot whose slice rides this mixed step: oldest
+        admission first (FIFO — every admitted prompt advances within a
+        bounded number of steps), ties on slot index.  ONE slot per step
+        by design (SARATHI): the slice is clipped to the step budget
+        anyway, and a single contiguous slice keeps the fused program's
+        shape zoo to (slice bucket, page window) pairs."""
+        best, best_t = None, float("inf")
+        for b in range(self.B):
+            st = slots[b]
+            if st is None or st.phase != "prefill":
+                continue
+            if st.t_admit < best_t:
+                best, best_t = b, st.t_admit
+        return best
+
+    def _mixed_iteration(self, slots, queue, results, fresh, kv_lens,
+                         last_tok, active, temps, top_k, top_p, t_enq,
+                         last_block_t):
+        """One SARATHI mixed step: every live decode row advances ONE
+        token and one prefilling slot's next prompt slice (clipped to
+        ``mixed_token_budget - decode_tokens``) rides the SAME fused
+        multi-token dispatch — decode cadence continues through the
+        admission.  Returns ``(handled, last_block_t)``; ``handled=False``
+        (nothing to mix, or the budget left no room for a slice) falls
+        back to the alternating path with no state disturbed beyond
+        capacity growth.
+
+        Speculation note: decode rows advance un-speculated during mixed
+        steps (the device history buffer is re-seeded per advanced row so
+        full spec blocks resume cleanly once the prefill drains); greedy
+        outputs are unchanged either way — exact-distribution verify
+        emits exactly the greedy tokens."""
+        pf = self._pick_mixed_prefill(slots)
+        has_decode = any(
+            slots[b] is not None and active[b]
+            and slots[b].phase == "decode" for b in range(self.B))
+        if pf is None or not has_decode:
+            return False, last_block_t
+
+        def rearm(stalled):
+            for b in stalled:  # stalled rows rejoin the next dispatch
+                if slots[b] is not None:
+                    active[b] = True
+
+        # grow decode rows by the ONE token this step appends; under pool
+        # pressure the youngest decode slot preempts, exactly as a block
+        # dispatch would (prefill-phase slots are never victims)
+        stalled = self._ensure_decode_capacity(slots, queue, kv_lens,
+                                               last_tok, active,
+                                               extra_tokens=1)
+        rows = [b for b in range(self.B)
+                if slots[b] is not None and active[b]
+                and slots[b].phase == "decode"]
+        budget_left = self.mixed_token_budget - len(rows)
+        if not rows or budget_left < 16:
+            # every decode row stalled (alternating path owns the stall
+            # recovery) or the live rows already exhaust the budget
+            # (budget misconfigured below the slot count): alternate this
+            # step rather than dispatch a degenerate slice
+            rearm(stalled)
+            return False, last_block_t
+
+        st_pf = slots[pf]
+        pos = st_pf.prefill_pos
+        c = min(len(st_pf.prompt_ids) - pos, budget_left,
+                self.prefill_chunk)
+        t_bucket = min(_pow2_bucket(c, 16), self.max_len)
+        c = min(c, t_bucket)  # pow2 bucket >= c whenever max_len is pow2
+        is_final = pos + c >= len(st_pf.prompt_ids)
+
+        # [B, T] operands: decode rows carry their pending token at index
+        # 0, the prefill row its slice at 0..C-1.  Padding tokens write at
+        # positions past each row's live length — the row's own not-yet-
+        # reached positions (overwritten by the next real token at that
+        # position) or, past its allocated pages/table span, the null page
+        # — and the per-token causal limit (position < base + j + 1)
+        # masks them from every real query, so no ragged per-row width is
+        # needed.  Rows carrying no work keep lens 0: the kernel's
+        # n_pages==0 fast path zeroes their output without a walk.
+        T = t_bucket
+        tokens = np.zeros((self.B, T), np.int32)
+        base = np.zeros((self.B,), np.int32)
+        lens_inc = np.zeros((self.B,), np.int32)
+        last_idx = np.zeros((self.B,), np.int32)
+        table_rows = [None] * self.B
+        max_pages = 1
+        live_tokens = 0
+        for b in rows:
+            st = slots[b]
+            tokens[b, 0] = last_tok[b]
+            base[b] = st.kv_len
+            lens_inc[b] = st.kv_len + T
+            table_rows[b] = st.seq
+            live_tokens += st.kv_len
+            max_pages = max(max_pages,
+                            self.cache.pages_needed(st.kv_len + 1))
+        tokens[pf, :c] = st_pf.prompt_ids[pos: pos + c]
+        base[pf] = pos
+        lens_inc[pf] = pos + T
+        last_idx[pf] = c - 1
+        table_rows[pf] = st_pf.seq
+        max_pages = max(max_pages, self.cache.pages_needed(pos + c))
+        w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
+        table = self.cache.page_table_array(table_rows)
+
+        self._h_occupancy.observe(len(rows) / self.B)
+        self._c_decode_dispatches.inc()
+        self._h_mixed_fill.observe(
+            (len(rows) + c) / self.mixed_token_budget)
+        self._c_piggybacked.inc(c)
+        self._c_prefill_tokens.inc(c)
+        self._h_prefill_batch.observe(c)
+        if (self._row_group > 1 and self._use_ragged
+                and self._kernel_mesh() is None):
+            # same convention as the spec block: rows dispatch in slot
+            # order (no balanced permutation — the mixed shape is B-wide
+            # and the prefill row pins its slot anyway)
+            g = self._row_group
+            self._h_group_occupancy.observe(
+                (len(rows) + 1) / (-(-self.B // g) * g))
+        now = time.time()
+        if last_block_t is not None:
+            self._h_block_gap.observe(now - last_block_t)
+        last_block_t = now
+        flops = self._perf.prefill_flops(c, kv_start=pos)
+        if self._tr:
+            self._tr.instant("prefill_dispatch",
+                             args={"rows": 1, "tokens": c, "bucket": T,
+                                   "mixed": True,
+                                   "flops_g": round(flops / 1e9, 3)})
+        st_pf.prefill_pos = pos + c
+
+        self._key, sub = jax.random.split(self._key)
+        args = (self.params, self.cache.k, self.cache.v,
+                jnp.asarray(tokens), jnp.asarray(base),
+                jnp.asarray(lens_inc), jnp.asarray(last_idx),
+                jnp.asarray(table[:, :w]), sub, jnp.asarray(temps),
+                jnp.asarray(top_k), jnp.asarray(top_p))
+        key_ = ("mixed", T, w)
+        warm = key_ in self._ran_ok
+        t_disp = time.time()
+        try:
+            nxt, self.cache.k, self.cache.v = \
+                self._get_mixed_fn(T, w)(*args)
+        except Exception:
+            # same contract as the decode/spec fallbacks: degrade only on
+            # a first-run lowering failure of the multi-token kernel
+            # (donation happens at execution, args still valid); a
+            # failure on a proven shape re-raises
+            if not self._use_ragged or key_ in self._ran_ok:
+                raise
+            logger.warning("mixed multi-token kernel failed to lower; "
+                           "falling back to XLA multi decode",
+                           exc_info=True)
+            self._use_ragged = False
+            self._decode_fns.clear()
+            self._mixed_fns.clear()
+            nxt, self.cache.k, self.cache.v = \
+                self._get_mixed_fn(T, w)(*args)
+        self._ran_ok.add(key_)
+        nxt = np.asarray(self._timed_get(nxt))
+        t_done = time.time()
+
+        # exact-split attribution: the fused step's per-row token counts
+        # are known, so no decode-share estimate is involved (note_block's
+        # EMA decomposition stays for the sequenced-prefill block path)
+        extra_flops, cold_pf = self._consume_prefill_attr()
+        self._attr_last_gb = round(self._perf.note_mixed_step(
+            t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
+            warm=warm and not cold_pf) / 1e9, 3)
+
+        for b in rows:
+            st = slots[b]
+            tok = int(nxt[b])
+            st.generated.append(tok)
+            st.kv_len += 1
+            kv_lens[b] = st.kv_len
+            last_tok[b] = tok
+            self._c_decode_tokens.inc(1)
+            if self._tr:
+                self._tr.instant("decode_block", ts=now,
+                                 tid=self._tid(st.req),
+                                 args={"tokens": 1})
+            self._maybe_finish(b, slots, results, active, fresh,
+                               kv_lens, last_tok)
+            if self.spec_k:
+                self._spec_stale.add(b)
+        if is_final:
+            # the slice completed the prompt: enter decode with the first
+            # token this very step sampled (index C-1 = the last prompt
+            # token's row — the fresh-prefill sampling contract)
+            st = st_pf
+            st.phase = "decode"
+            st.t_decode_start = time.time()
+            if self._tr:
+                self._tr.complete("prefill", st.t_admit,
+                                  st.t_decode_start, tid=self._tid(st.req),
+                                  args={"prompt_tokens":
+                                        len(st.prompt_ids)})
+            st.kv_len = len(st.prompt_ids)
+            kv_lens[pf] = st.kv_len
+            active[pf] = True
+            self._cache_insert(st)
+            tok0 = int(nxt[pf])
+            st.generated.append(tok0)
+            self._note_first_token(st, t_enq)
+            last_tok[pf] = tok0
+            if self.spec_k:
+                self._spec_stale.add(pf)
+            self._maybe_finish(pf, slots, results, active, fresh,
+                               kv_lens, last_tok)
+        if self._tr:
+            self._tr.complete("decode_block", now, time.time(),
+                              args={"active": len(rows),
+                                    "tokens": len(rows),
+                                    "hbm_gb": self._attr_last_gb,
+                                    "mixed": True,
+                                    "prefill_tokens": c})
+        rearm(stalled)
+        return True, last_block_t
+
+    def _get_mixed_fn(self, t: int, w: int):
+        """Fused mixed-step program: one [B, T] multi-token dispatch where
+        decode rows carry ONE real token (index 0) and the piggybacked
+        prefill row its slice (indices 0..C-1), through the ragged
+        multi-token row-group path — the kernel already parametrizes
+        per-row token counts via per-token causal limits, so decode and
+        prefill rows differ only in how many of their T positions are
+        real.  Samples one token per row at its host-provided last real
+        index (the LM head runs on that row only — at real vocabularies a
+        full [B, T, V] head would be the packing win given back).
+        Compiled per (slice bucket, page window): the bounded mixed shape
+        zoo (log2 slice buckets x log2 windows)."""
+        key_ = (t, w)
+        if key_ in self._mixed_fns:
+            return self._mixed_fns[key_]
+        cfg = self.model_cfg
+        max_len = self.max_len
+        rope_max = self.max_len
+        # same gate as the spec verify fn: the multi-token kernel has no
+        # shard_map wrapper, so under a real multi-device mesh the XLA
+        # multi path serves (one window gather — still not the per-layer
+        # window_prefill gather)
+        use_ragged = self._use_ragged and self._kernel_mesh() is None
+        interp = self._interpret
+        row_group = self._row_group
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def mixed_step(params, k_pages, v_pages, tokens, base, lens_inc,
+                       last_idx, table, key, temps, tk, tp):
+            # rope positions: each row's tokens sit at consecutive
+            # absolute positions from its own base (kv_len for decode
+            # rows, the slice start for the prefill row); the write span
+            # derives from lens_inc inside the multi path (UNclamped per
+            # its contract — max_pos masks any overhang)
+            positions = jnp.minimum(
+                base[:, None] + jnp.arange(t)[None, :], max_len - 1)
+            out = forward_paged(
+                params, cfg, tokens, positions, k_pages, v_pages, table,
+                lens_inc, rope_max, use_ragged_kernel=use_ragged,
+                multi_decode=True, interpret=interp, last_pos=last_idx,
+                decode_row_group=row_group,
+            )
+            logits, k_pages, v_pages = out[:3]
+            # single step, no scan/vmap wrapper: sample_logits' lax.cond
+            # fast paths are safe here (ops/sampling.py NOTE)
+            nxt = sample_logits(logits[:, 0], key, temps, tk, tp)
+            return nxt, k_pages, v_pages
+
+        logger.info("compiling mixed step: B=%d slice_bucket=%d window=%d "
+                    "pages (ragged_kernel=%s row_group=%d)", self.B, t, w,
+                    use_ragged, row_group)
+        self._mixed_fns[key_] = mixed_step
+        return mixed_step
 
     # ------------------------------------------------------------- prefill
 
@@ -2651,6 +3027,7 @@ class ContinuousScheduler:
                            "falling back to XLA paged decode", exc_info=True)
             self._use_ragged = False
             self._decode_fns.clear()
+            self._mixed_fns.clear()  # mixed fns captured use_ragged too
             out = self._get_decode_fn(w)(*args)
         self._ran_ok.add(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
@@ -2755,6 +3132,14 @@ class ContinuousScheduler:
         token lists.  The token-history buffer lives on device (seeded per
         row at decode admission, appended by the device inside the block) —
         no per-dispatch O(B*max_len) upload."""
+        if self._spec_stale:
+            # rows advanced by mixed steps since the last spec block:
+            # their history rows missed the in-scan appends — re-seed
+            # once per row here, at spec resumption, not per mixed step
+            for b in sorted(self._spec_stale):
+                if slots[b] is not None and slots[b].phase == "decode":
+                    self.seed_history(b, slots[b])
+            self._spec_stale.clear()
         w, table = self._decode_window(slots,
                                        self.decode_block + self.spec_k)
         # the verify kernel passes the grouping but not the balanced
@@ -2790,6 +3175,7 @@ class ContinuousScheduler:
                            "falling back to XLA multi decode", exc_info=True)
             self._use_ragged = False
             self._decode_fns.clear()  # spec fns cache here too
+            self._mixed_fns.clear()  # mixed fns captured use_ragged too
             out = self._get_spec_decode_fn(w)(*args)
         self._ran_ok.add(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out

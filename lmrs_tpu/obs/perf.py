@@ -25,6 +25,10 @@ signal on the serving path:
     decomposed: the decode share is estimated from the running
     utilization, the remainder is charged to prefill → an MFU sample.
     No clean decode sample yet → the mixed block only counts bytes/FLOPs;
+  - FUSED mixed steps (SARATHI mixed batches) are split EXACTLY: their
+    per-row token counts are known, so the wall apportions proportionally
+    to each phase's roofline time — no EMA estimate involved
+    (``note_mixed_step``);
   - first-run (compiling) shapes never produce samples;
   - speculative-decode blocks contribute step gaps only (their byte
     model differs; spec is off on the bench and default-off in serving).
@@ -196,6 +200,44 @@ class DispatchAttribution:
         if 0.0 < mfu < 4.0:
             self.h_mfu.observe(mfu)
             self.g_mfu.set(mfu)
+        return nbytes
+
+    def note_mixed_step(self, t_start: float, t_end: float, n_live: int,
+                        live_tokens: int, prefill_flops: float,
+                        warm: bool) -> float:
+        """One FUSED mixed dispatch (SARATHI mixed batches): ``n_live``
+        decode rows advance one token and a prefill slice of known size
+        rides the SAME program.  Unlike the sequenced-prefill decode
+        blocks (``note_block``, whose decode share must be ESTIMATED from
+        the clean-sample EMA), the fused step's per-row token counts are
+        exact, so the split needs no estimate: the wall is apportioned
+        proportionally to each phase's own roofline time
+        (``bytes/peak_bw`` vs ``flops/peak_flops``), under which both
+        phase samples equal the step's combined roofline utilization —
+        the assumption-free number for a step whose two phases share one
+        kernel launch (they cannot be timed apart host-side).  Clean
+        decode samples alone keep feeding the EMA.  Returns the step's
+        model byte cost (the ``hbm_gb`` trace-span arg)."""
+        self.note_gap(t_start, t_end)
+        nbytes = self.decode_bytes(1, n_live, live_tokens)
+        self.c_bytes.inc(nbytes)
+        if prefill_flops > 0:
+            self.c_flops.inc(prefill_flops)
+        if not warm:
+            return nbytes
+        spec = self._spec()
+        t = (t_end - t_start) - self.ensure_rtt()
+        if t <= 1e-6:
+            return nbytes
+        t_model = (nbytes / spec.peak_hbm_bw
+                   + max(prefill_flops, 0.0) / spec.peak_flops)
+        util = t_model / t
+        if 0.0 < util < 4.0:  # same garbage guard as note_block
+            self.h_hbm.observe(util)
+            self.g_hbm.set(util)
+            if prefill_flops > 0:
+                self.h_mfu.observe(util)
+                self.g_mfu.set(util)
         return nbytes
 
     def note_prefill_sync(self, flops: float, t_start: float,

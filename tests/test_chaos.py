@@ -150,6 +150,55 @@ def test_chaos_step_fault_recovery_is_deterministic(jax_engine):
     assert sched.audit() == []
 
 
+def test_chaos_fault_inside_mixed_step(jax_engine):
+    """A fault landing INSIDE the mixed-dispatch window (ISSUE 11): the
+    workload staggers budgets so a long prompt is admitted while another
+    request still decodes — its prefill rides fused mixed steps — and the
+    fault plan fires OutOfPages during the mixed capacity growth plus one
+    scheduler-step fault mid-run.  Decode rows survive (stall/preempt,
+    then run-recovery + executor retry — every request completes with a
+    valid reason, token-identical to the fault-free run) and the
+    interrupted prefill slice retries; auditor clean."""
+    sched = jax_engine._scheduler
+    assert sched._mixed, "mixed dispatch must be armed on the chaos engine"
+
+    def reqs():
+        return [
+            GenerationRequest(prompt="mixed chaos steady", request_id=0,
+                              temperature=0.0, max_new_tokens=24),
+            GenerationRequest(prompt="early finisher", request_id=1,
+                              temperature=0.0, max_new_tokens=4),
+            # admitted when slot 1 frees, while request 0 still decodes:
+            # its ~120-token prompt prefills via mixed slices
+            GenerationRequest(prompt="late long admission words " * 5,
+                              request_id=2, temperature=0.0,
+                              max_new_tokens=6),
+        ]
+
+    def run(plan_faults):
+        ex = MapExecutor(jax_engine, EngineConfig(retry_attempts=3,
+                                                  retry_delay=0.01))
+        before = sched.metrics["mixed_dispatches"]
+        with faults.injected(FaultPlan(seed=91, faults=plan_faults)):
+            out = ex.run_requests(reqs())
+        assert sched.metrics["mixed_dispatches"] > before, \
+            "scenario never entered the mixed window"
+        for res in out:
+            assert res.finish_reason in VALID_REASONS, res
+        assert sched.audit() == []
+        return [(r.request_id, r.finish_reason, r.text) for r in out]
+
+    baseline = run([])
+    # OutOfPages pressure inside mixed capacity growth: decode rows
+    # stall/preempt but never error, the prefill slice is re-dispatched
+    faulted = run([{"site": "kv_cache.allocate", "p": 0.4, "max_fires": 6}])
+    assert faulted == baseline
+    # a step fault killing an iteration mid-mix: pool recovery + executor
+    # retry reproduce the same greedy output
+    faulted = run([{"site": "scheduler.step", "at": [6], "max_fires": 1}])
+    assert faulted == baseline
+
+
 def test_chaos_identical_seeds_identical_outcomes():
     """Same workload seed + same plan seed => identical outcome tuples
     (the replayability contract chaos triage depends on)."""
