@@ -417,6 +417,11 @@ def _scheduler_window(sched, before: dict) -> dict:
         # admissions and the prompt tokens whose prefill was skipped
         # entirely (the map preamble re-use win; engine/prefix_cache.py)
         "prefix_cache": _prefix_window(m, before),
+        # host-RAM spill tier over the timed window (engine/host_kv.py):
+        # zero on a roomy-pool bench by construction — the block exists
+        # so pressure rounds (budgeted num_pages) can track the
+        # spill/prefetch traffic the tier converts re-prefills into
+        "host_kv": sched._host_kv_report(before),
         # live per-phase roofline attribution (obs/perf.py): MFU / HBM
         # utilization / step-gap percentiles from the serving path's own
         # dispatch walls — what future BENCH_r* rounds record alongside

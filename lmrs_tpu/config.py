@@ -231,6 +231,20 @@ class EngineConfig:
     # pages stay bounded by the pool, drained on demand by the OutOfPages
     # back-pressure eviction)
     prefix_cache_max_pages: int = 0
+    # Host-RAM KV spill tier (engine/host_kv.py, ROADMAP item 3): evicted
+    # refcount-zero prefix-cache pages capture their content into a
+    # bounded host-memory pool and prefetch back on a later radix match
+    # instead of re-prefilling — the fleet's HBM + host RAM become one
+    # cache hierarchy.  LMRS_HOST_KV=0 (or host_kv=False) is the kill
+    # switch: eviction means gone, byte-for-byte today's behavior.  Only
+    # meaningful with prefix_cache on (and therefore never with int8 KV,
+    # which disables the prefix cache).
+    host_kv: bool = field(
+        default_factory=lambda: _env("LMRS_HOST_KV", True, bool))
+    # host pool budget in GiB (LRU over spilled subtrees past it); an
+    # entry bigger than the whole budget skips the spill entirely
+    host_kv_gb: float = field(
+        default_factory=lambda: _env("LMRS_HOST_KV_GB", 1.0, float))
     # engine-side tokenizer spec ("" = model default: byte for random-init
     # vocabs, the checkpoint's tokenizer for real ones).  Accepts the same
     # forms as data.tokenizer.get_tokenizer: "byte", a *.model SentencePiece
@@ -280,6 +294,10 @@ class EngineConfig:
                              f"(got {self.mixed_token_budget}); use "
                              "mixed_batch=False / LMRS_MIXED=0 to disable "
                              "mixed dispatch")
+        if self.host_kv_gb < 0:
+            raise ValueError(f"host_kv_gb must be >= 0 "
+                             f"(got {self.host_kv_gb}); use host_kv=False / "
+                             "LMRS_HOST_KV=0 to disable the spill tier")
         if self.request_deadline_s < 0:
             raise ValueError(f"request_deadline_s must be >= 0 "
                              f"(got {self.request_deadline_s}); 0 disables "

@@ -78,6 +78,36 @@ class GenerationRequest:
     trace_id: str | None = None
 
 
+def preamble_text(system_prompt: str | None, prompt: str,
+                  cache_prefix: int | None) -> str:
+    """A request's SHARED-PREAMBLE text region: the system prompt plus
+    the ``cache_prefix``-hinted head of the prompt — exactly the region
+    the scheduler donates to the radix tree (scheduler._cache_insert).
+    The ONE definition shared by ``preamble_key`` (the router's routing
+    hash), the scheduler's summary tokenization, and the mock's
+    deterministic emulation, so the three can never drift apart.  Empty
+    (or any value, ignored) when the hint is negative — the request
+    declares nothing shared."""
+    if cache_prefix is not None and cache_prefix < 0:
+        return ""
+    head = prompt[:cache_prefix] if cache_prefix is not None else ""
+    return ((system_prompt + "\n\n") if system_prompt else "") + head
+
+
+def preamble_key(system_prompt: str | None, prompt: str,
+                 cache_prefix: int | None) -> str | None:
+    """Stable hash of ``preamble_text`` — the prefix-aware placement key.
+    Pure text, so the router needs no tokenizer: both sides hash what
+    the wire already carries.  None when the request declares nothing
+    shared (negative hint, or the preamble text is empty)."""
+    text = preamble_text(system_prompt, prompt, cache_prefix)
+    if not text:
+        return None
+    import hashlib
+
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:16]
+
+
 def remaining_budget(req: GenerationRequest,
                      now: float | None = None) -> float | None:
     """Seconds of deadline budget left (negative = expired); None when the
@@ -257,7 +287,10 @@ def make_engine(
         return MockEngine(seed=engine_cfg.seed,
                           handoff_ttl_s=engine_cfg.handoff_ttl_s,
                           mixed_batch=engine_cfg.mixed_batch,
-                          mixed_token_budget=engine_cfg.mixed_token_budget)
+                          mixed_token_budget=engine_cfg.mixed_token_budget,
+                          prefix_cache=engine_cfg.prefix_cache,
+                          host_kv=engine_cfg.host_kv,
+                          host_kv_gb=engine_cfg.host_kv_gb)
     if engine_cfg.backend == "jax":
         from lmrs_tpu.config import ModelConfig, model_preset
 

@@ -1,0 +1,112 @@
+"""Host-RAM KV spill tier: the capacity layer behind the HBM prefix cache.
+
+Today an HBM eviction throws a refcount-zero radix node's pages away and a
+later request re-prefills the whole preamble from scratch.  This module is
+the second tier of the cache hierarchy (ROADMAP item 3): evicted page
+CONTENT is captured device→host into a bounded host-memory pool and the
+radix node stays in the tree as a *spilled* node — on a later match the
+payload prefetches back into freshly allocated device pages (one scatter,
+issued asynchronously on the scheduler thread so the transfer overlaps the
+dispatch cadence) instead of re-prefilling.  The packing-prefetch result in
+the long-context acceleration paper (PAPERS.md) is the motivating shape:
+KV prefetch from a slower tier hides almost entirely under ongoing compute
+for exactly this long-preamble summarization workload.
+
+Design notes
+------------
+* The pool stores *references to radix nodes* (engine/prefix_cache.py);
+  the payload arrays live on the node itself (``_Node.spill``).  The pool
+  is pure accounting: bytes used, LRU victim selection against a budget
+  (``LMRS_HOST_KV_GB``), counters.  Single-threaded by contract — every
+  caller runs on the scheduler thread, like the prefix cache itself.
+* "Pinned" host memory is aspirational on this runtime: jax has no public
+  pinned-allocation API, so payloads are plain numpy buffers.  The scatter
+  path (``PagedKVCache.import_pages``) still overlaps: ``jnp.asarray`` +
+  ``.at[].set`` dispatch asynchronously and the device sequences the copy
+  before the next dispatch that consumes the pool.
+* Victim selection respects a ``keep`` set (node ids): mid-insert the walk
+  path is pinned exactly like HBM eviction pins it — dropping an ancestor
+  of the node being attached would orphan the new leaf.
+* An entry larger than the whole budget is refused (``fits`` is checked
+  by the caller BEFORE capture, so an oversized node skips the device→host
+  gather entirely and frees exactly as with the tier off).
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("lmrs.host_kv")
+
+
+class HostKVPool:
+    """Bounded host-RAM pool of spilled KV page payloads (accounting only;
+    payload arrays live on the owning radix nodes).  All methods run on
+    the scheduler thread — no locking, same contract as PrefixCache."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = max(0, int(budget_bytes))
+        self.used_bytes = 0
+        # id(node) -> (node, nbytes).  Recency is the node's own radix
+        # ``tick`` (one LRU clock across both tiers — a prefetch-hit or
+        # re-match bumps it exactly like a resident hit).
+        self.entries: dict[int, tuple[object, int]] = {}
+        # cumulative counters (PrefixCache.stats / metrics_report feed)
+        self.spilled_pages_total = 0
+        self.prefetched_pages_total = 0
+        self.dropped_pages_total = 0
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def fits(self, nbytes: int) -> bool:
+        """Whether an entry of ``nbytes`` can ever be admitted."""
+        return 0 < nbytes <= self.budget_bytes
+
+    def add(self, node, nbytes: int, n_pages: int) -> None:
+        """Admit a spilled node (caller guarantees ``fits``); budget
+        enforcement is a separate pass (``victims``) because victim
+        subtree drops need the tree, which the pool does not know."""
+        self.entries[id(node)] = (node, int(nbytes))
+        self.used_bytes += int(nbytes)
+        self.spilled_pages_total += n_pages
+
+    def remove(self, node, n_pages: int = 0, dropped: bool = False) -> None:
+        """Forget a node (prefetch promotion, subtree drop, or budget
+        eviction).  ``dropped=True`` counts the pages as lost from the
+        tier (budget LRU / subtree drop) rather than promoted back."""
+        ent = self.entries.pop(id(node), None)
+        if ent is None:
+            return
+        self.used_bytes -= ent[1]
+        if dropped:
+            self.dropped_pages_total += n_pages
+
+    def note_prefetch(self, n_pages: int) -> None:
+        self.prefetched_pages_total += n_pages
+
+    def over_budget(self) -> bool:
+        return self.used_bytes > self.budget_bytes
+
+    def victim(self, keep=None):
+        """The LRU spilled node (min radix tick) outside ``keep`` (a set
+        of ``id(node)`` the caller has pinned), or None.  The caller
+        drops the victim's subtree and calls ``remove`` for every spilled
+        node in it — the pool never mutates the tree."""
+        best = None
+        for node, _nbytes in self.entries.values():
+            if keep and id(node) in keep:
+                continue
+            if best is None or node.tick < best.tick:
+                best = node
+        return best
+
+    def stats(self) -> dict:
+        return {
+            "host_pool_entries": len(self.entries),
+            "host_pool_bytes": self.used_bytes,
+            "host_pool_budget_bytes": self.budget_bytes,
+            "spilled_pages_total": self.spilled_pages_total,
+            "prefetched_pages_total": self.prefetched_pages_total,
+            "host_dropped_pages_total": self.dropped_pages_total,
+        }

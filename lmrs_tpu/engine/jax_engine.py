@@ -225,6 +225,14 @@ class JaxEngine:
     def engine_metrics(self) -> dict:
         return self._scheduler.metrics_report() if self._scheduler else {}
 
+    def prefix_summary(self, top_k: int = 16) -> list[dict]:
+        """Optional Engine hook (getattr convention): the compact radix
+        summary the router routes on (docs/SERVING.md § prefix-aware
+        routing); [] for the static scheduler or with the cache off."""
+        if self._scheduler is None:
+            return []
+        return self._scheduler.prefix_summary(top_k)
+
     # ---------------------------------------- disaggregated handoff hooks
     # (optional Engine surface, same getattr convention as ``cancel``):
     # the continuous scheduler implements the real page pin/export/import

@@ -307,6 +307,63 @@ class PagedKVCache:
             "v": v.reshape(L, n, kh, ps, hd),
         }
 
+    def export_pages(self, pages: list[int]) -> dict:
+        """Host capture of an arbitrary page set's contents, all layers —
+        the spill tier's device→host path (engine/prefix_cache.py).  Same
+        single batched gather over the layer-flattened pool as
+        ``export_sequence`` (one RTT on a tunneled chip), minus the
+        sequence framing: the prefix cache's radix node carries the token
+        labels, so the payload is just raw page content + dtype."""
+        phys = jnp.asarray(self._phys_ids(pages))
+        k, v = (np.asarray(a)
+                for a in jax.device_get((self.k[phys], self.v[phys])))
+        kh, ps, hd = (int(x) for x in self.k.shape[1:])
+        L, n = self.n_layers, len(pages)
+        return {
+            "k": k.reshape(L, n, kh, ps, hd),
+            "v": v.reshape(L, n, kh, ps, hd),
+            "dtype": str(self.k.dtype),
+        }
+
+    def import_pages(self, pages: list[int], payload: dict,
+                     sync: bool = False) -> None:
+        """Scatter a spilled payload back into freshly allocated pages —
+        the prefetch half of the host-RAM tier.  Issued ASYNCHRONOUSLY by
+        default: ``jnp.asarray`` + ``.at[].set`` dispatch without a host
+        sync, the device sequences the copy before the next dispatch that
+        consumes the pool, and the transfer overlaps the scheduler
+        thread's host-side bookkeeping (the packing-prefetch overlap,
+        PAPERS.md).  ``sync=True`` blocks until the scatter lands
+        (``LMRS_HOST_KV_SYNC`` A/B fallback).  Geometry/dtype mismatches
+        raise ``ValueError`` — same rejection discipline as
+        ``import_sequence``; the caller re-prefills."""
+        n = len(pages)
+        if payload.get("dtype") != str(self.k.dtype):
+            raise ValueError(
+                f"spill payload dtype {payload.get('dtype')!r} != pool "
+                f"{self.k.dtype}")
+        kh, ps, hd = (int(x) for x in self.k.shape[1:])
+        shape = (self.n_layers, n, kh, ps, hd)
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        if k.shape != shape or v.shape != shape:
+            raise ValueError(
+                f"spill payload shape {k.shape} != expected {shape}")
+        phys = jnp.asarray(self._phys_ids(pages))
+        flat = (self.n_layers * n, kh, ps, hd)
+        self.k = self.k.at[phys].set(
+            jnp.asarray(k.reshape(flat), self.k.dtype))
+        self.v = self.v.at[phys].set(
+            jnp.asarray(v.reshape(flat), self.v.dtype))
+        if sync:
+            jax.block_until_ready((self.k, self.v))
+
+    def page_payload_bytes(self) -> int:
+        """Host bytes one spilled page costs (k + v, all layers) — the
+        spill tier's budget/fits arithmetic."""
+        kh, ps, hd = (int(x) for x in self.k.shape[1:])
+        return 2 * self.n_layers * kh * ps * hd * self.k.dtype.itemsize
+
     def import_sequence(self, payload: dict) -> SequencePages:
         """Scatter an exported page set into freshly allocated local pages
         and return the live sequence (``length`` = the payload's kv_len).

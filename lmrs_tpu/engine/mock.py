@@ -18,7 +18,8 @@ import time
 
 from lmrs_tpu.data.tokenizer import ApproxTokenizer
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
-                                 apply_stop_sequences)
+                                 apply_stop_sequences, preamble_key,
+                                 preamble_text)
 from lmrs_tpu.obs import get_tracer, req_tid
 from lmrs_tpu.testing import faults
 
@@ -47,11 +48,22 @@ class MockEngine:
     # of the two-process topology gate)
     supports_handoff = True
 
+    # the mock's emulated cache geometry (deterministic; no device):
+    # "HBM" holds this many preamble tokens resident before LRU entries
+    # spill to the emulated host pool, and each token claims this many
+    # host-pool bytes against ``host_kv_gb``
+    EMU_RESIDENT_TOKENS = 2048
+    EMU_BYTES_PER_TOKEN = 1024
+    EMU_PAGE_TOKENS = 128
+
     def __init__(self, seed: int = 0, latency_s: float = 0.0,
                  fail_pattern: str | None = None,
                  handoff_ttl_s: float = 60.0,
                  mixed_batch: bool | None = None,
-                 mixed_token_budget: int = 256):
+                 mixed_token_budget: int = 256,
+                 prefix_cache: bool = True,
+                 host_kv: bool | None = None,
+                 host_kv_gb: float = 1.0):
         from lmrs_tpu.utils.env import env_bool
 
         self.seed = seed
@@ -72,6 +84,32 @@ class MockEngine:
         self.mixed_batch = (env_bool("LMRS_MIXED", True)
                             and (mixed_batch is None or bool(mixed_batch)))
         self.mixed_token_budget = max(32, int(mixed_token_budget))
+        # Prefix-cache + host-RAM spill tier emulation (the scheduler's
+        # knob surface on the no-device arm, same composition rules:
+        # LMRS_PREFIX_CACHE / LMRS_HOST_KV env always disarm, config
+        # always disarms).  Deterministic and output-free — the mock's
+        # text never changes; what CI gets is the same accounting,
+        # radix-summary publication, and budget behavior the jax engine
+        # exposes, so the full routing+spill flow runs deviceless.
+        self.prefix_cache = (env_bool("LMRS_PREFIX_CACHE", True)
+                             and bool(prefix_cache))
+        self.host_kv = (self.prefix_cache
+                        and env_bool("LMRS_HOST_KV", True)
+                        and (host_kv is None or bool(host_kv))
+                        and host_kv_gb > 0)
+        self.host_kv_budget_bytes = int(max(0.0, host_kv_gb) * 2**30)
+        self._prefix_lock = threading.Lock()
+        # key -> {"tokens", "tier" ("resident"|"spilled"), "tick"}
+        self._prefix: dict[str, dict] = {}  # guarded-by: _prefix_lock
+        self._prefix_tick = 0               # guarded-by: _prefix_lock
+        self._prefix_queries = 0            # guarded-by: _prefix_lock
+        self._prefix_hits = 0               # guarded-by: _prefix_lock
+        self._prefix_tokens_reused = 0      # guarded-by: _prefix_lock
+        self._spilled_hits = 0              # guarded-by: _prefix_lock
+        self._tokens_prefetched = 0         # guarded-by: _prefix_lock
+        self._spill_pages = 0               # guarded-by: _prefix_lock
+        self._prefetch_pages = 0            # guarded-by: _prefix_lock
+        self._host_dropped_pages = 0        # guarded-by: _prefix_lock
         self._mixed_lock = threading.Lock()
         self._mixed_dispatches = 0  # guarded-by: _mixed_lock
         self._mixed_piggybacked = 0  # guarded-by: _mixed_lock
@@ -152,6 +190,101 @@ class MockEngine:
                         (n_decode + c) / self.mixed_token_budget, 1.0)
                     remaining -= c
 
+    def _note_prefix(self, req: GenerationRequest) -> None:
+        """Deterministic prefix-cache + spill-tier accounting for one
+        generated request (no output effect; see __init__).  First sight
+        of a preamble inserts it resident; a later request with the same
+        preamble is a hit (tokens_reused += preamble tokens); a hit on a
+        SPILLED entry additionally accounts a prefetch and promotes it
+        back.  Resident capacity is ``EMU_RESIDENT_TOKENS`` LRU — over
+        it, oldest entries spill (tier armed) or drop (tier off), and
+        the emulated host pool drops LRU entries past ``host_kv_gb``."""
+        if not self.prefix_cache:
+            return
+        key = preamble_key(req.system_prompt, req.prompt, req.cache_prefix)
+        if key is None:
+            return
+        tokens = self._tok.count(preamble_text(
+            req.system_prompt, req.prompt, req.cache_prefix))
+        pages = -(-tokens // self.EMU_PAGE_TOKENS)
+        with self._prefix_lock:
+            self._prefix_tick += 1
+            self._prefix_queries += 1
+            ent = self._prefix.get(key)
+            if ent is not None:
+                self._prefix_hits += 1
+                self._prefix_tokens_reused += ent["tokens"]
+                if ent["tier"] == "spilled":
+                    self._spilled_hits += 1
+                    self._tokens_prefetched += ent["tokens"]
+                    self._prefetch_pages += pages
+                    ent["tier"] = "resident"
+            else:
+                ent = {"tokens": tokens, "tier": "resident", "tick": 0}
+                self._prefix[key] = ent
+            ent["tick"] = self._prefix_tick
+            self._enforce_emulated_budgets()
+
+    def _enforce_emulated_budgets(self) -> None:  # holds-lock: _prefix_lock
+        """Caller holds self._prefix_lock."""
+        def lru(tier: str):
+            cands = [(e["tick"], k) for k, e in self._prefix.items()
+                     if e["tier"] == tier]
+            return min(cands)[1] if cands else None
+
+        def resident_tokens() -> int:
+            return sum(e["tokens"] for e in self._prefix.values()
+                       if e["tier"] == "resident")
+
+        while resident_tokens() > self.EMU_RESIDENT_TOKENS:
+            key = lru("resident")
+            if key is None:
+                break
+            ent = self._prefix[key]
+            pages = -(-ent["tokens"] // self.EMU_PAGE_TOKENS)
+            if (self.host_kv and ent["tokens"] * self.EMU_BYTES_PER_TOKEN
+                    <= self.host_kv_budget_bytes):
+                ent["tier"] = "spilled"
+                self._spill_pages += pages
+            else:
+                del self._prefix[key]
+
+        def spilled_bytes() -> int:
+            return sum(e["tokens"] * self.EMU_BYTES_PER_TOKEN
+                       for e in self._prefix.values()
+                       if e["tier"] == "spilled")
+
+        while spilled_bytes() > self.host_kv_budget_bytes:
+            key = lru("spilled")
+            if key is None:
+                break
+            ent = self._prefix.pop(key)
+            self._host_dropped_pages += -(-ent["tokens"]
+                                          // self.EMU_PAGE_TOKENS)
+
+    def prefix_summary(self, top_k: int = 16) -> list[dict]:
+        """Deterministic radix-summary publication (the router's routing
+        feed) — same row shape as the scheduler's."""
+        if not self.prefix_cache:
+            return []
+        with self._prefix_lock:
+            rows = sorted(self._prefix.items(),
+                          key=lambda kv: -kv[1]["tick"])[:top_k]
+            out = []
+            for key, ent in rows:
+                res = ent["tier"] == "resident"
+                pages = -(-ent["tokens"] // self.EMU_PAGE_TOKENS)
+                out.append({
+                    "hash": key,
+                    "depth_tokens": ent["tokens"],
+                    "tick": ent["tick"],
+                    "resident_tokens": ent["tokens"] if res else 0,
+                    "resident_pages": pages if res else 0,
+                    "spilled_tokens": 0 if res else ent["tokens"],
+                    "spilled_pages": 0 if res else pages,
+                })
+        return out
+
     def shutdown(self) -> None:
         pass
 
@@ -162,20 +295,43 @@ class MockEngine:
         self.cancelled.add(request_id)
 
     def engine_metrics(self) -> dict:
+        out: dict = {}
         with self._mixed_lock:
             d, p, f = (self._mixed_dispatches, self._mixed_piggybacked,
                        self._mixed_fill_sum)
-        if not d:
-            # no mixed work recorded (fresh engine, or mixed off): the
-            # mock reports no engine metrics, as it always has
-            return {}
-        return {"mixed_batch": {
-            "enabled": self.mixed_batch,
-            "token_budget": self.mixed_token_budget,
-            "dispatches": d,
-            "fill_ratio": round(f / d, 3) if d else 0.0,
-            "prefill_tokens_piggybacked": p,
-        }}
+        if d:
+            out["mixed_batch"] = {
+                "enabled": self.mixed_batch,
+                "token_budget": self.mixed_token_budget,
+                "dispatches": d,
+                "fill_ratio": round(f / d, 3) if d else 0.0,
+                "prefill_tokens_piggybacked": p,
+            }
+        with self._prefix_lock:
+            if self._prefix_queries:
+                out["prefix_cache"] = {
+                    "hit_rate": round(
+                        self._prefix_hits / self._prefix_queries, 3),
+                    "hits": self._prefix_hits,
+                    "queries": self._prefix_queries,
+                    "tokens_reused": self._prefix_tokens_reused,
+                    "prefill_tokens_saved": self._prefix_tokens_reused,
+                    "spilled_hits": self._spilled_hits,
+                    "tokens_prefetched": self._tokens_prefetched,
+                }
+                out["host_kv"] = {
+                    "enabled": self.host_kv,
+                    "budget_gb": round(
+                        self.host_kv_budget_bytes / 2**30, 3),
+                    "spilled_hits": self._spilled_hits,
+                    "tokens_prefetched": self._tokens_prefetched,
+                    "spill_pages": self._spill_pages,
+                    "prefetch_pages": self._prefetch_pages,
+                    "dropped_pages_total": self._host_dropped_pages,
+                }
+        # no work recorded at all: the mock reports no engine metrics,
+        # as it always has
+        return out
 
     # ---------------------------------------- disaggregated handoff hooks
 
@@ -262,6 +418,10 @@ class MockEngine:
                 finish_reason=str(state.get("finish_reason", "stop")),
                 stop_sequence=state.get("stop_sequence"),
             )
+        # prefix-cache/spill accounting: every request that actually
+        # "prefills" here (plain completions and prefill-role exports;
+        # handoff imports resumed above without prefilling)
+        self._note_prefix(req)
         text, stop_hit = apply_stop_sequences(
             self._extractive_sketch(req.prompt), req.stop)
         prompt_tokens = self._tok.count(req.prompt)

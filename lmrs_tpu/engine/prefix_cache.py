@@ -35,17 +35,39 @@ Design
   it does not already cover (first writer wins; content-identical
   duplicates from concurrently-admitted sequences are simply freed when
   their sequence closes).
-* Eviction is LRU over REFCOUNT-ZERO nodes — leaves no live sequence
-  shares (allocator refcount 1 == the cache's own reference) — triggered
-  by an explicit ``max_pages`` budget and by pool back-pressure
+* Eviction is LRU over REFCOUNT-ZERO nodes — nodes no live sequence shares
+  (allocator refcount 1 == the cache's own reference) — triggered by an
+  explicit ``max_pages`` budget and by pool back-pressure
   (PagedKVCache.reclaim_cb -> ``evict``), so caching never deadlocks
   admission: under pressure cached pages drain back to the free list
   before the scheduler resorts to preemption or stalls.
+
+Host-RAM spill tier (engine/host_kv.py, ROADMAP item 3)
+-------------------------------------------------------
+With a :class:`~lmrs_tpu.engine.host_kv.HostKVPool` attached (and a
+``capture_cb`` to gather page contents device→host), an HBM eviction no
+longer throws the KV away: the victim node's page CONTENT is captured
+into the bounded host pool and the node stays in the tree as a *spilled*
+node (``pages == []``, payload on ``_Node.spill``).  A later ``match_hier``
+that walks onto a spilled node reports it to the scheduler, which
+allocates fresh device pages and PREFETCHES the payload back
+(``prefetch_into`` → ``PagedKVCache.import_pages``) instead of
+re-prefilling — the node is promoted back to resident on the new pages.
+``insert`` likewise promotes spilled nodes its walk passes through (the
+inserting sequence just recomputed identical KV on its own pages).  Host
+budget pressure (``LMRS_HOST_KV_GB``) drops LRU spilled subtrees for
+real; capture failure (or the ``prefix.spill`` fault) degrades to
+today's evict-means-gone drop, byte-for-byte.  With no pool attached
+(``LMRS_HOST_KV=0``) nothing here changes behavior at all.
+
+Threading: ALL methods run on the scheduler thread, between dispatches —
+the host pool inherits the same contract.
 """
 
 from __future__ import annotations
 
 import logging
+import time
 
 from lmrs_tpu.testing import faults
 
@@ -56,9 +78,11 @@ class _Node:
     """One radix-tree edge: ``tokens`` (length a multiple of page_size;
     empty at the root) and the KV pages holding them, one per page_size
     tokens.  ``tick`` is the LRU stamp, bumped on every match/insert walk
-    through the node."""
+    through the node.  ``spill`` is the host-RAM payload of a SPILLED
+    node (pages freed, content captured) — exactly one of ``pages`` /
+    ``spill`` is populated on a non-root node."""
 
-    __slots__ = ("tokens", "pages", "children", "parent", "tick")
+    __slots__ = ("tokens", "pages", "children", "parent", "tick", "spill")
 
     def __init__(self, tokens: tuple, pages: list[int], parent: "_Node | None"):
         self.tokens = tokens
@@ -66,6 +90,11 @@ class _Node:
         self.children: dict[tuple, _Node] = {}  # first-page token block -> child
         self.parent = parent
         self.tick = 0
+        self.spill: dict | None = None
+
+
+def _payload_bytes(payload: dict) -> int:
+    return int(payload["k"].nbytes) + int(payload["v"].nbytes)
 
 
 class PrefixCache:
@@ -76,14 +105,26 @@ class PrefixCache:
     them through its normal ``close_sequence`` free).  All methods are
     host-side and O(prefix length); the scheduler calls them between
     dispatches.
+
+    ``spill_pool``/``capture_cb``/``page_bytes`` arm the host-RAM spill
+    tier (module doc); ``metrics`` is an optional dict of registry
+    instruments ({"spill_pages", "spill_dropped", "spill_capture_s",
+    "pool_bytes"}) the spill paths feed — absent keys are skipped, so
+    unit tests need no registry.
     """
 
-    def __init__(self, allocator, page_size: int, max_pages: int = 0):
+    def __init__(self, allocator, page_size: int, max_pages: int = 0,
+                 spill_pool=None, capture_cb=None, page_bytes: int = 0,
+                 metrics: dict | None = None):
         self.allocator = allocator
         self.page_size = page_size
         # 0 = no explicit budget: retained pages are bounded by the pool
         # itself (back-pressure eviction via evict())
         self.max_pages = max_pages
+        self.pool = spill_pool
+        self.capture_cb = capture_cb
+        self.page_bytes = page_bytes  # per-page payload estimate (fits())
+        self.metrics = metrics or {}
         self.root = _Node((), [], None)
         self.cached_pages = 0
         self._tick = 0
@@ -100,15 +141,39 @@ class PrefixCache:
         self._tick += 1
         node.tick = self._tick
 
+    def _metric(self, name: str, op: str, *args) -> None:
+        inst = self.metrics.get(name)
+        if inst is not None:
+            getattr(inst, op)(*args)
+
+    def _note_pool(self) -> None:
+        if self.pool is not None:
+            self._metric("pool_bytes", "set", float(self.pool.used_bytes))
+
     def match(self, ids: list[int]) -> tuple[list[int], int]:
-        """Longest cached prefix of ``ids`` at page granularity.
+        """Longest RESIDENT cached prefix of ``ids`` at page granularity.
 
         Returns ``(pages, n_tokens)`` with one extra allocator reference
         taken on every returned page (the caller owns it; releasing goes
         through the caller's normal page free).  ``n_tokens`` is capped at
         the largest page multiple <= len(ids) - 1 so the final prompt token
-        is always recomputed (see module doc).
+        is always recomputed (see module doc).  The walk stops at a
+        spilled node — its pages live in the host tier; ``match_hier``
+        is the spill-aware probe.
         """
+        pages, matched, _chain = self.match_hier(ids, with_spill=False)
+        return pages, matched
+
+    def match_hier(self, ids: list[int], with_spill: bool = True
+                   ) -> tuple[list[int], int, list[tuple[_Node, int]]]:
+        """Spill-aware prefix probe: the resident prefix (pages incref'd,
+        exactly like ``match``) plus the chain of consecutive WHOLE
+        spilled nodes extending it — ``[(node, n_tokens), ...]`` in
+        positional order.  The caller allocates device pages per spilled
+        node and restores each via ``prefetch_into`` (or re-prefills on
+        failure — no references are held on spilled entries, so dropping
+        the chain costs nothing).  The same usable-prefix cap applies
+        across both tiers."""
         ps = self.page_size
         usable = ((len(ids) - 1) // ps) * ps
         pages: list[int] = []
@@ -117,7 +182,7 @@ class PrefixCache:
         self._touch(node)
         while matched < usable:
             child = node.children.get(tuple(ids[matched: matched + ps]))
-            if child is None:
+            if child is None or child.spill is not None:
                 break
             take = 0
             for off in range(0, len(child.tokens), ps):
@@ -139,19 +204,88 @@ class PrefixCache:
             self._touch(node)
         if matched:
             self.allocator.incref(pages)
-        return pages, matched
+        chain: list[tuple[_Node, int]] = []
+        if with_spill and self.pool is not None:
+            # extend through whole spilled nodes only (a partial spilled
+            # edge would need a payload split mid-match; the lost tail is
+            # at most one node) — resident-under-spilled cannot exist
+            # (promotions run top-down), so the walk shape is [res*][spill*]
+            pos = matched
+            while pos < usable:
+                child = node.children.get(tuple(ids[pos: pos + ps]))
+                if (child is None or child.spill is None
+                        or pos + len(child.tokens) > usable
+                        or tuple(ids[pos: pos + len(child.tokens)])
+                        != child.tokens):
+                    break
+                chain.append((child, len(child.tokens)))
+                pos += len(child.tokens)
+                node = child
+                self._touch(node)
+        return pages, matched, chain
+
+    def peek(self, ids: list[int]) -> dict:
+        """Read-only coverage probe (no incref, no LRU touch): how many
+        leading tokens/pages of ``ids`` are resident vs spilled right now.
+        Feeds the published radix summary (scheduler.prefix_summary) the
+        router routes on; full-page granularity, no usable-1 cap — this
+        is a capacity view, not an admission plan."""
+        ps = self.page_size
+        limit = (len(ids) // ps) * ps
+        out = {"resident_tokens": 0, "resident_pages": 0,
+               "spilled_tokens": 0, "spilled_pages": 0}
+        node = self.root
+        matched = 0
+        in_spill = False
+        while matched < limit:
+            child = node.children.get(tuple(ids[matched: matched + ps]))
+            if child is None:
+                break
+            take = 0
+            for off in range(0, len(child.tokens), ps):
+                if (matched + off + ps > limit
+                        or tuple(ids[matched + off: matched + off + ps])
+                        != child.tokens[off: off + ps]):
+                    break
+                take += ps
+            if take == 0:
+                break
+            in_spill = in_spill or child.spill is not None
+            kind = "spilled" if in_spill else "resident"
+            out[f"{kind}_tokens"] += take
+            out[f"{kind}_pages"] += take // ps
+            if take < len(child.tokens):
+                break
+            matched += take
+            node = child
+        return out
 
     def _split(self, node: _Node, k: int) -> _Node:
         """Split ``node``'s edge after ``k`` tokens (a page multiple):
         the prefix becomes a new parent node; ``node`` keeps the suffix.
-        Returns the new prefix node."""
+        Returns the new prefix node.  Spilled nodes split their host
+        payload too (both halves stay spilled, bytes re-registered)."""
         ps = self.page_size
-        upper = _Node(node.tokens[:k], node.pages[: k // ps], node.parent)
+        kp = k // ps
+        upper = _Node(node.tokens[:k], node.pages[:kp], node.parent)
         upper.tick = node.tick
+        if node.spill is not None:
+            pay = node.spill
+            self.pool.remove(node)
+            upper.spill = {"k": pay["k"][:, :kp].copy(),
+                           "v": pay["v"][:, :kp].copy(),
+                           "dtype": pay.get("dtype")}
+            node.spill = {"k": pay["k"][:, kp:].copy(),
+                          "v": pay["v"][:, kp:].copy(),
+                          "dtype": pay.get("dtype")}
+            # a split is not a new spill event: re-register bytes only
+            self.pool.add(upper, _payload_bytes(upper.spill), 0)
+            self.pool.add(node, _payload_bytes(node.spill), 0)
+            self._note_pool()
         parent = node.parent
         parent.children[node.tokens[:ps]] = upper
         node.tokens = node.tokens[k:]
-        node.pages = node.pages[k // ps:]
+        node.pages = node.pages[kp:]
         node.parent = upper
         upper.children[node.tokens[:ps]] = node
         return upper
@@ -169,7 +303,10 @@ class PrefixCache:
         unique suffixes (chunk bodies) from bloating the tree.
 
         Adopted pages gain one allocator reference (the cache's); the
-        caller keeps its own reference and releases it as usual.
+        caller keeps its own reference and releases it as usual.  Spilled
+        nodes the walk passes through are PROMOTED back to resident on
+        the caller's pages (the sequence just recomputed identical KV):
+        the host payload drops and the tier self-heals.
         """
         # injection site: fires BEFORE any tree/refcount mutation, so a
         # fault here leaves the cache exactly as it was — the scheduler
@@ -184,6 +321,7 @@ class PrefixCache:
         node = self.root
         self._touch(node)
         matched = 0
+        promoted = 0
         while matched < limit:
             child = node.children.get(tuple(ids[matched: matched + ps]))
             if child is None:
@@ -199,6 +337,11 @@ class PrefixCache:
                 break
             if take < len(child.tokens):
                 child = self._split(child, take)
+            if child.spill is not None:
+                # promote on the inserting sequence's own pages for this
+                # token span — identical content, freshly computed
+                promoted += self._promote(
+                    child, pages[matched // ps: (matched + take) // ps])
             matched += take
             node = child
             self._touch(node)
@@ -206,7 +349,7 @@ class PrefixCache:
                 break
         adopt = (limit - matched) // ps
         if adopt <= 0:
-            return 0
+            return promoted
         if self.max_pages:
             over = self.cached_pages + adopt - self.max_pages
             if over > 0:
@@ -222,7 +365,7 @@ class PrefixCache:
             # still over budget (live sequences pin nodes): trim adoption
             adopt = min(adopt, max(self.max_pages - self.cached_pages, 0))
             if adopt <= 0:
-                return 0
+                return promoted
         new_tokens = tuple(ids[matched: matched + adopt * ps])
         new_pages = list(pages[matched // ps: matched // ps + adopt])
         self.allocator.incref(new_pages)
@@ -231,65 +374,202 @@ class PrefixCache:
         self._touch(leaf)
         self.cached_pages += adopt
         self.inserted_pages += adopt
-        return adopt
+        return adopt + promoted
+
+    def _promote(self, node: _Node, dest_pages: list[int]) -> int:
+        """Flip a spilled node back to resident on ``dest_pages`` (the
+        cache takes its own reference; the caller keeps its own).  The
+        host payload drops — the content is in HBM again."""
+        n = len(dest_pages)
+        assert n == len(node.tokens) // self.page_size
+        self.allocator.incref(list(dest_pages))
+        node.pages = list(dest_pages)
+        node.spill = None
+        if self.pool is not None:
+            self.pool.remove(node)
+            self._note_pool()
+        self.cached_pages += n
+        self.inserted_pages += n
+        return n
+
+    # ------------------------------------------------------------- prefetch
+
+    def prefetch_into(self, node: _Node, dest_pages: list[int],
+                      kv_cache, sync: bool = False) -> int:
+        """Restore a spilled node's payload into freshly allocated device
+        pages (``PagedKVCache.import_pages`` — async scatter unless
+        ``sync``) and promote the node to resident on them.  Raises if
+        the entry was dropped between match and prefetch (host budget
+        pressure) — the caller re-prefills that segment instead.  The
+        ``prefix.prefetch`` fault site is the CALLER's (scheduler), fired
+        before any mutation here."""
+        payload = node.spill
+        if payload is None:
+            raise RuntimeError("spilled entry dropped before prefetch")
+        kv_cache.import_pages(dest_pages, payload, sync=sync)
+        n = self._promote(node, dest_pages)
+        # promotion via prefetch is a tier hit, not an insert
+        self.inserted_pages -= n
+        if self.pool is not None:
+            self.pool.note_prefetch(n)
+        self._touch(node)
+        return n
 
     # ------------------------------------------------------------- eviction
 
-    def _evictable(self, node: _Node) -> bool:
-        """A leaf no live sequence shares: every page's only reference is
-        the cache's own."""
-        return (not node.children
-                and all(self.allocator.refcount(p) == 1 for p in node.pages))
-
     def evict(self, n_pages: int) -> int:
-        """Free at least ``n_pages`` pages of refcount-zero cache (LRU node
-        order), or as many as exist.  Returns pages freed.  Wired into the
-        pool's OutOfPages back-pressure path (PagedKVCache.reclaim_cb), so
-        a full cache can never starve admission or decode growth."""
+        """Free at least ``n_pages`` DEVICE pages of refcount-zero cache
+        (LRU node order), or as many as exist.  Returns pages freed.
+        Wired into the pool's OutOfPages back-pressure path
+        (PagedKVCache.reclaim_cb), so a full cache can never starve
+        admission or decode growth.  With the host tier armed the content
+        spills instead of vanishing — the device pages free either way."""
         return self._evict_lru(n_pages)
 
-    def _evict_lru(self, n_pages: int, keep: set | None = None) -> int:
+    def _evict_lru(self, n_pages: int, keep: set | None = None,
+                   spill: bool = True) -> int:
         freed = 0
         while freed < n_pages:
-            victim = None
+            # Victim = LRU RESIDENT node no live sequence shares (every
+            # page's only reference is the cache's own) with no resident
+            # descendants — spilled descendants ride along (they
+            # spill/drop with it).  Resident-descendant exclusion is one
+            # ancestor-marking pass over the resident nodes (amortized
+            # O(N) per scan — the former per-candidate subtree walk was
+            # O(N^2) on exactly the page-starved back-pressure path).
+            resident: list[_Node] = []
             stack = [self.root]
             while stack:
                 node = stack.pop()
                 stack.extend(node.children.values())
-                if (node is self.root or (keep and id(node) in keep)
-                        or not self._evictable(node)):
+                if node is not self.root and node.pages:
+                    resident.append(node)
+            blocked: set[int] = set()
+            for node in resident:
+                cur = node.parent
+                while cur is not None and id(cur) not in blocked:
+                    blocked.add(id(cur))
+                    cur = cur.parent
+            victim = None
+            for node in resident:
+                if (id(node) in blocked or (keep and id(node) in keep)
+                        or not all(self.allocator.refcount(p) == 1
+                                   for p in node.pages)):
                     continue
                 if victim is None or node.tick < victim.tick:
                     victim = node
             if victim is None:
                 break
-            freed += self._drop(victim)
+            freed += self._drop(victim, keep=keep, spill=spill)
         if freed:
             logger.debug("evicted %d cached pages (%d retained)",
                          freed, self.cached_pages)
         return freed
 
-    def _drop(self, node: _Node) -> int:
-        """Remove a leaf: release the cache's page references (pages return
-        to the free list — nothing else holds them) and unlink."""
-        self.allocator.free(node.pages)
+    def _drop(self, node: _Node, keep: set | None = None,
+              spill: bool = True) -> int:
+        """Release a victim's DEVICE pages.  With the host tier armed (and
+        ``spill``), the content is captured host-side first and the node
+        stays in the tree as a spilled node; otherwise — tier off, entry
+        over the whole host budget, or capture failure (incl. the
+        ``prefix.spill`` fault) — the node and its (spilled) descendants
+        drop entirely, exactly today's evict-means-gone behavior."""
         n = len(node.pages)
-        del node.parent.children[node.tokens[: self.page_size]]
-        self.cached_pages -= n
-        self.evicted_pages += n
-        node.parent = None
-        return n
+        if (spill and n and self.pool is not None
+                and self.capture_cb is not None
+                and self.pool.fits(n * self.page_bytes)):
+            payload = self._capture(node)
+            if payload is not None:
+                self.allocator.free(node.pages)
+                node.pages = []
+                node.spill = payload
+                self.cached_pages -= n
+                self.evicted_pages += n
+                self.pool.add(node, _payload_bytes(payload), n)
+                self._metric("spill_pages", "inc", n)
+                self._note_pool()
+                self._enforce_host_budget(keep)
+                return n
+        return self._drop_subtree(node)
+
+    def _capture(self, node: _Node) -> dict | None:
+        """Device→host gather of a victim's page contents (the spill
+        capture).  Any failure — the ``prefix.spill`` fault or a real
+        gather error — returns None: the caller frees the pages exactly
+        as with the tier off; the cache is untouched."""
+        try:
+            faults.fire("prefix.spill")
+            t0 = time.time()
+            payload = self.capture_cb(node.pages)
+            self._metric("spill_capture_s", "observe", time.time() - t0)
+            return payload
+        except Exception:  # noqa: BLE001 - degrade to evict-means-gone
+            logger.warning("KV spill capture failed; pages free uncached",
+                           exc_info=True)
+            return None
+
+    def _drop_subtree(self, node: _Node) -> int:
+        """Remove ``node`` and everything under it: release the cache's
+        device-page references (pages return to the free list — nothing
+        else holds them beyond live sequences' own refs) and drop any
+        spilled descendants' host entries.  Returns DEVICE pages freed."""
+        ps = self.page_size
+        if node.parent is not None:
+            del node.parent.children[node.tokens[:ps]]
+        freed = 0
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            stack.extend(cur.children.values())
+            if cur.pages:
+                self.allocator.free(cur.pages)
+                freed += len(cur.pages)
+                self.cached_pages -= len(cur.pages)
+                self.evicted_pages += len(cur.pages)
+            if cur.spill is not None:
+                if self.pool is not None:
+                    self.pool.remove(cur, n_pages=len(cur.tokens) // ps,
+                                     dropped=True)
+                    self._metric("spill_dropped", "inc",
+                                 len(cur.tokens) // ps)
+                cur.spill = None
+            cur.children = {}
+            cur.parent = None
+        self._note_pool()
+        return freed
+
+    def _enforce_host_budget(self, keep: set | None = None) -> None:
+        """Drop LRU spilled subtrees until the host pool fits its budget.
+        ``keep`` pins the current walk chain (insert/eviction path) —
+        kept nodes form one root-path, so a victim outside the set can
+        never contain one in its subtree."""
+        if self.pool is None:
+            return
+        while self.pool.over_budget():
+            victim = self.pool.victim(keep=keep)
+            if victim is None:
+                break
+            self._drop_subtree(victim)
 
     def clear(self) -> int:
-        """Drop every node no live sequence shares (kill switch / tests)."""
-        return self._evict_lru(self.cached_pages or 0) if self.cached_pages else 0
+        """Drop every node no live sequence shares — HARD, across both
+        tiers (kill switch / pool recovery / tests): resident refcount-
+        zero nodes free their pages without spilling, and every spilled
+        entry drops from the host pool."""
+        freed = (self._evict_lru(self.cached_pages or 0, spill=False)
+                 if self.cached_pages else 0)
+        if self.pool is not None:
+            for node, _nbytes in list(self.pool.entries.values()):
+                if id(node) in self.pool.entries:  # sibling drop may race
+                    self._drop_subtree(node)
+        return freed
 
     # ---------------------------------------------------------------- audit
 
     def retained_pages(self) -> list[int]:
-        """Every page id the tree currently holds a reference on (one entry
-        per retention — duplicates would themselves be a bug ``audit``
-        reports)."""
+        """Every DEVICE page id the tree currently holds a reference on
+        (one entry per retention — duplicates would themselves be a bug
+        ``audit`` reports).  Spilled nodes hold no device pages."""
         out: list[int] = []
         stack = [self.root]
         while stack:
@@ -301,13 +581,19 @@ class PrefixCache:
     def audit(self) -> list[str]:
         """Radix-tree structural invariants, one string per violation:
 
-        * every non-root node labels ``len(pages) * page_size`` tokens;
+        * every non-root RESIDENT node labels ``len(pages) * page_size``
+          tokens; every non-root node is exactly one of resident/spilled
+          (a page retained by both the device tree and a host-pool
+          entry's claim is the double-retention bug class);
         * each child is keyed by its first page's token block and points
           back at its parent;
         * no page is retained twice; ``cached_pages`` matches the walk;
         * every retained page is live in the allocator (refcount >= 1 —
           the cache's own reference; a refcount-0 retained page means the
-          cache is handing out freed pages).
+          cache is handing out freed pages);
+        * host-pool accounting: pool entries and spilled tree nodes are
+          the same set, payload page counts match edge labels, and
+          ``used_bytes`` equals the sum of entry sizes.
 
         Refcount BALANCE (tree + live sequences == allocator refcounts) is
         the scheduler auditor's job — only it knows the live sequences.
@@ -316,14 +602,30 @@ class PrefixCache:
         violations: list[str] = []
         seen: dict[int, int] = {}
         total = 0
+        spilled_nodes: list[_Node] = []
         stack = [self.root]
         while stack:
             node = stack.pop()
             if node is not self.root:
-                if len(node.tokens) != len(node.pages) * ps:
+                if node.pages and node.spill is not None:
+                    violations.append(
+                        "node retained by BOTH tiers (device pages and a "
+                        "host-pool payload)")
+                if not node.pages and node.spill is None:
+                    violations.append(
+                        "non-root node with neither pages nor spill "
+                        "payload")
+                if node.pages and len(node.tokens) != len(node.pages) * ps:
                     violations.append(
                         f"node with {len(node.tokens)} tokens holds "
                         f"{len(node.pages)} pages (page_size {ps})")
+                if node.spill is not None:
+                    spilled_nodes.append(node)
+                    if node.spill["k"].shape[1] * ps != len(node.tokens):
+                        violations.append(
+                            f"spilled node with {len(node.tokens)} tokens "
+                            f"carries {node.spill['k'].shape[1]} payload "
+                            "pages")
                 if not node.tokens:
                     violations.append("non-root node with empty edge label")
             for key, child in node.children.items():
@@ -345,16 +647,42 @@ class PrefixCache:
             violations.append(
                 f"cached_pages counter {self.cached_pages} != {total} "
                 "pages found in the tree")
+        if self.pool is not None:
+            tree_ids = {id(n) for n in spilled_nodes}
+            pool_ids = set(self.pool.entries)
+            if tree_ids != pool_ids:
+                violations.append(
+                    f"host-pool entries ({len(pool_ids)}) and spilled tree "
+                    f"nodes ({len(tree_ids)}) diverge")
+            used = sum(nbytes for _n, nbytes in self.pool.entries.values())
+            if used != self.pool.used_bytes:
+                violations.append(
+                    f"host pool used_bytes {self.pool.used_bytes} != "
+                    f"{used} summed over entries")
+        elif spilled_nodes:
+            violations.append("spilled nodes exist with no host pool "
+                              "attached")
         return violations
 
     # -------------------------------------------------------------- reports
+
+    def spilled_pages(self) -> int:
+        """Pages currently held by the host tier (capacity view)."""
+        if self.pool is None:
+            return 0
+        return sum(len(node.tokens) // self.page_size
+                   for node, _nbytes in self.pool.entries.values())
 
     def stats(self) -> dict:
         """Structural counters (page footprint) for metrics_report()/bench
         detail.  Hit/query/tokens-reused accounting is the scheduler's
         (see __init__)."""
-        return {
+        out = {
             "cached_pages": self.cached_pages,
             "inserted_pages": self.inserted_pages,
             "evicted_pages": self.evicted_pages,
         }
+        if self.pool is not None:
+            out["spilled_pages"] = self.spilled_pages()
+            out.update(self.pool.stats())
+        return out

@@ -37,7 +37,8 @@ import numpy as np
 
 from lmrs_tpu.config import EngineConfig, ModelConfig
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
-                                 apply_stop_sequences, remaining_budget)
+                                 apply_stop_sequences, preamble_key,
+                                 preamble_text, remaining_budget)
 from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
                                       audit_allocator)
 from lmrs_tpu.engine.prefix_cache import PrefixCache
@@ -265,12 +266,26 @@ class ContinuousScheduler:
                        and not self._kv_quant and not self._use_ring)
         self.mixed_token_budget = max(32, engine_cfg.mixed_token_budget)
         self._mixed_fns: dict[tuple[int, int], object] = {}
+        # prefix cache constructed AFTER the metrics registry below (the
+        # host-RAM spill tier feeds registry instruments); _pc_on carries
+        # the gate decision down
         self._prefix_cache: PrefixCache | None = None
-        if pc_on:
-            self._prefix_cache = PrefixCache(
-                self.cache.allocator, ps,
-                max_pages=engine_cfg.prefix_cache_max_pages)
-            self.cache.reclaim_cb = self._prefix_cache.evict
+        self._pc_on = pc_on
+        # Host-RAM KV spill tier (engine/host_kv.py): LMRS_HOST_KV=0 /
+        # host_kv=False restores evict-means-gone byte-for-byte;
+        # LMRS_HOST_KV_SYNC=1 blocks each prefetch scatter (A/B fallback
+        # for the default async overlap).
+        self._host_kv_sync = env_bool("LMRS_HOST_KV_SYNC", False)
+        # Published radix summary (prefix-aware fleet routing,
+        # docs/SERVING.md): distinct request preambles seen by this
+        # engine, keyed by api.preamble_key — the router fetches
+        # ``prefix_summary()`` through /healthz and routes
+        # sticky-by-expected-prefix-hit.  Written by the scheduler thread
+        # (_note_preamble); read by HTTP handler threads through the
+        # guarded, memoized prefix_summary() snapshot.
+        self._preambles: dict[str, dict] = {}
+        self._preamble_tick = 0
+        self._summary_memo: tuple[float, list] | None = None
         self._key = jax.random.PRNGKey(engine_cfg.seed + 17)
         # Request abort (VERDICT r3 item 4): ids land here from any thread
         # (set.add is atomic under the GIL — the HTTP server cancels from a
@@ -354,6 +369,57 @@ class ContinuousScheduler:
         self._c_prefix_tokens = c("lmrs_prefix_tokens_reused_total",
                                   "prompt tokens served from cached pages",
                                   "tokens")
+        # host-RAM spill tier (engine/host_kv.py): device-evicted cache
+        # pages captured host-side and prefetched back on later matches —
+        # present even when the tier is off, so bench windowing can
+        # always delta them (same convention as the prefix counters)
+        self._c_spill_pages = c("lmrs_prefix_spill_pages_total",
+                                "prefix-cache pages captured into the "
+                                "host-RAM spill tier at eviction", "pages")
+        self._c_spill_dropped = c("lmrs_prefix_spill_dropped_pages_total",
+                                  "spilled pages dropped from the host "
+                                  "pool (budget LRU / subtree drops)",
+                                  "pages")
+        self._h_spill_capture = h("lmrs_prefix_spill_capture_seconds",
+                                  help="device→host capture of one "
+                                       "spilled node's pages",
+                                  unit="seconds")
+        self._c_prefetch_pages = c("lmrs_prefix_prefetch_pages_total",
+                                   "spilled pages restored into device "
+                                   "pages on a radix match", "pages")
+        self._c_prefetch_tokens = c("lmrs_prefix_tokens_prefetched_total",
+                                    "prompt tokens restored from the host "
+                                    "tier instead of re-prefilled",
+                                    "tokens")
+        self._c_spilled_hits = c("lmrs_prefix_spilled_hits_total",
+                                 "admissions whose prefix match extended "
+                                 "into spilled segments")
+        self._h_prefetch = h("lmrs_prefix_prefetch_seconds",
+                             help="host→device prefetch issue per "
+                                  "admission (async unless "
+                                  "LMRS_HOST_KV_SYNC)", unit="seconds")
+        self._g_host_pool = g("lmrs_prefix_host_pool_bytes",
+                              "bytes currently held by the host-RAM KV "
+                              "spill pool", "bytes")
+        if self._pc_on:
+            pool = None
+            cb = None
+            pb = 0
+            if engine_cfg.host_kv and engine_cfg.host_kv_gb > 0:
+                from lmrs_tpu.engine.host_kv import HostKVPool
+
+                pool = HostKVPool(int(engine_cfg.host_kv_gb * 2**30))
+                cb = self.cache.export_pages
+                pb = self.cache.page_payload_bytes()
+            self._prefix_cache = PrefixCache(
+                self.cache.allocator, ps,
+                max_pages=engine_cfg.prefix_cache_max_pages,
+                spill_pool=pool, capture_cb=cb, page_bytes=pb,
+                metrics={"spill_pages": self._c_spill_pages,
+                         "spill_dropped": self._c_spill_dropped,
+                         "spill_capture_s": self._h_spill_capture,
+                         "pool_bytes": self._g_host_pool})
+            self.cache.reclaim_cb = self._prefix_cache.evict
         # mixed-batch dispatch: real tokens (decode + piggybacked prefill
         # slice) over the step's token budget, and the prompt tokens whose
         # prefill rode a decode step instead of a dedicated prefill wave
@@ -490,6 +556,10 @@ class ContinuousScheduler:
             "prefix_queries": int(self._c_prefix_queries.value),
             "prefix_hits": int(self._c_prefix_hits.value),
             "prefix_tokens_reused": int(self._c_prefix_tokens.value),
+            "prefix_spilled_hits": int(self._c_spilled_hits.value),
+            "prefix_tokens_prefetched": int(self._c_prefetch_tokens.value),
+            "prefix_spill_pages": int(self._c_spill_pages.value),
+            "prefix_prefetch_pages": int(self._c_prefetch_pages.value),
             "group_occupancy_sum": self._h_group_occupancy.sum,
             "group_dispatches": int(self._h_group_occupancy.count),
             "handoff_exports": int(self._c_handoff_exports.value),
@@ -607,6 +677,7 @@ class ContinuousScheduler:
                                       "captures)",
             "queue_wait_ms": self._h_queue_wait.percentile_report(),
             "mixed_batch": self._mixed_report(),
+            "host_kv": self._host_kv_report(),
             "perf_attribution": self._perf.report(),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
@@ -650,9 +721,41 @@ class ContinuousScheduler:
             "queries": m["prefix_queries"],
             "tokens_reused": m["prefix_tokens_reused"],
             "prefill_tokens_saved": m["prefix_tokens_reused"],
+            "spilled_hits": m["prefix_spilled_hits"],
+            "tokens_prefetched": m["prefix_tokens_prefetched"],
             "cached_pages": s["cached_pages"],
             "evicted_pages": s["evicted_pages"],
         }
+
+    def _host_kv_report(self, before: dict | None = None) -> dict:
+        """Host-RAM spill tier block of metrics_report() / bench detail:
+        whether the tier is armed, its budget and occupancy, and the
+        spill/prefetch work counters.  With ``before`` (a ``metrics`` snapshot) the work
+        fields are WINDOWED to the delta since the snapshot — one
+        implementation for bench and the report, same convention as
+        ``_mixed_report``."""
+        pc = self._prefix_cache
+        armed = pc is not None and pc.pool is not None
+        m = self.metrics
+        b = before or {}
+        out = {
+            "enabled": armed,
+            "budget_gb": round(self.cfg.host_kv_gb, 3) if armed else 0.0,
+            "spilled_hits": (m["prefix_spilled_hits"]
+                             - b.get("prefix_spilled_hits", 0)),
+            "tokens_prefetched": (m["prefix_tokens_prefetched"]
+                                  - b.get("prefix_tokens_prefetched", 0)),
+            "spill_pages": (m["prefix_spill_pages"]
+                            - b.get("prefix_spill_pages", 0)),
+            "prefetch_pages": (m["prefix_prefetch_pages"]
+                               - b.get("prefix_prefetch_pages", 0)),
+        }
+        if armed:
+            out["spilled_pages_resident"] = pc.spilled_pages()
+            out["pool_bytes"] = pc.pool.used_bytes
+            out["pool_entries"] = len(pc.pool)
+            out["dropped_pages_total"] = pc.pool.dropped_pages_total
+        return out
 
     def reset_latency_stats(self) -> None:
         """Drop accumulated TTFT / block-gap / queue-wait observations.
@@ -841,13 +944,19 @@ class ContinuousScheduler:
                     continue
                 # Prefix-cache probe: clone the longest cached page prefix
                 # (ref-counted, read-only) and start prefill at the match
-                # boundary.  match() always leaves >= 1 prompt token to
-                # prefill (the sampled-first-token chunk), so a "full" hit
-                # is a one-chunk tail prefill straight into decode.
+                # boundary.  match_hier() always leaves >= 1 prompt token
+                # to prefill (the sampled-first-token chunk), so a "full"
+                # hit is a one-chunk tail prefill straight into decode.
+                # ``spill_chain`` is the host-tier extension: spilled
+                # segments that will PREFETCH into freshly allocated pages
+                # instead of re-prefilling (no references held — dropping
+                # the chain on back-pressure costs nothing).
                 cached_pages: list[int] = []
                 cached_tokens = 0
+                spill_chain: list = []
                 if self._prefix_cache is not None:
-                    cached_pages, cached_tokens = self._prefix_cache.match(ids)
+                    cached_pages, cached_tokens, spill_chain = \
+                        self._prefix_cache.match_hier(ids)
                 # Admission reserves PROMPT pages only; decode capacity is
                 # grown per block (_ensure_decode_capacity), with youngest-
                 # slot preemption under pressure.  No fail-fast branch here:
@@ -876,8 +985,9 @@ class ContinuousScheduler:
                         break  # back-pressure: wait for pages to free up
                 queue.popleft()
                 try:
-                    seq = SequencePages(
-                        pages=cached_pages + self.cache.alloc_pages(need))
+                    # NB: named fresh_pages, not fresh — admit() closes
+                    # over run()'s ``fresh`` results deque
+                    fresh_pages = self.cache.alloc_pages(need)
                 except OutOfPages:
                     # pressure raced (or was injected) past the free-count
                     # check above: release the match references, requeue at
@@ -886,6 +996,18 @@ class ContinuousScheduler:
                         self.cache.allocator.free(cached_pages)
                     queue.appendleft((req, ids, max_new, n_prompt, prior, t0))
                     break
+                prefetched_tokens = 0
+                if spill_chain:
+                    # spilled hit: restore each segment into its share of
+                    # the fresh pages (async scatter, overlapped with the
+                    # dispatch cadence); a failed/dropped segment truncates
+                    # the match there and its pages become prefill tail —
+                    # admission never wedges on the host tier
+                    (cached_pages, fresh_pages, cached_tokens,
+                     prefetched_tokens) = self._prefetch_spilled(
+                        spill_chain, cached_pages, fresh_pages,
+                        cached_tokens)
+                seq = SequencePages(pages=cached_pages + fresh_pages)
                 # counted at ADMISSION, not per probe: a back-pressured
                 # request re-probes every scheduler tick until pages free
                 # up, and retry ticks must not dilute the hit rate
@@ -925,7 +1047,9 @@ class ContinuousScheduler:
                     if cached_tokens:
                         tr.instant("prefix_match", ts=now,
                                    tid=self._tid(req),
-                                   args={"tokens_reused": cached_tokens})
+                                   args={"tokens_reused": cached_tokens,
+                                         "tokens_prefetched":
+                                             prefetched_tokens})
                 # a cache hit enters the existing chunked-prefill machinery
                 # at the match boundary: the first chunk dispatches as a
                 # windowed continuation attending the cloned pages
@@ -2118,6 +2242,106 @@ class ContinuousScheduler:
                 best, best_t = b, st.t_start
         return best
 
+    def _prefetch_spilled(self, chain, cached_pages: list[int],
+                          fresh: list[int], cached_tokens: int):
+        """Restore the matched spilled segments (host tier → device) into
+        their share of the freshly allocated pages, in positional order.
+        Each successful segment promotes its radix node back to resident
+        on those pages (prefix_cache.prefetch_into) and extends the
+        usable match; the FIRST failure — the ``prefix.prefetch`` fault,
+        or an entry the host budget dropped between match and here —
+        truncates the match at that segment, whose pages (and every later
+        segment's) simply become prefill tail.  Returns
+        ``(cached_pages, fresh_tail, cached_tokens, prefetched_tokens)``."""
+        ps = self.cfg.page_size
+        used = 0
+        got_tokens = 0
+        t0 = time.time()
+        for node, n_tok in chain:
+            npg = n_tok // ps
+            dest = fresh[used: used + npg]
+            try:
+                # injection site: fires BEFORE any mutation for this
+                # segment — a fault costs exactly the segment's reuse,
+                # never a wedged admission
+                faults.fire("prefix.prefetch")
+                self._prefix_cache.prefetch_into(node, dest, self.cache,
+                                                 sync=self._host_kv_sync)
+            except Exception:  # noqa: BLE001 - degrade to re-prefill
+                logger.warning("KV prefetch failed; re-prefilling the "
+                               "spilled segment", exc_info=True)
+                break
+            used += npg
+            got_tokens += n_tok
+        if used:
+            self._h_prefetch.observe(time.time() - t0)
+            self._c_prefetch_pages.inc(used)
+            self._c_prefetch_tokens.inc(got_tokens)
+            self._c_spilled_hits.inc()
+            # perf attribution: the scatter's HBM bytes ride into the
+            # next block's wall — count them and keep that block from
+            # polluting the clean-sample EMA
+            self._perf.note_prefetch(used * self.cache.page_payload_bytes())
+        return (cached_pages + fresh[:used], fresh[used:],
+                cached_tokens + got_tokens, got_tokens)
+
+    def _note_preamble(self, req: GenerationRequest) -> None:
+        """Record a request's shared preamble for the published radix
+        summary (prefix_summary): key = api.preamble_key over the same
+        text region _cache_insert donates; the encoded token ids are kept
+        so summary publication can re-probe LIVE resident/spilled
+        coverage against the tree.  Bounded LRU (32 preambles — a fleet
+        shares a handful of map/reduce/system preambles by design)."""
+        key = preamble_key(req.system_prompt, req.prompt, req.cache_prefix)
+        if key is None:
+            return
+        self._preamble_tick += 1
+        ent = self._preambles.get(key)
+        if ent is None:
+            text = preamble_text(req.system_prompt, req.prompt,
+                                 req.cache_prefix)
+            ids = tuple([self.tokenizer.bos_id]
+                        + self.tokenizer.encode(text))
+            # tick stamped BEFORE the LRU trim: a zero-tick insert would
+            # make the brand-new entry the min-by-tick victim and the
+            # summary would stop learning past 32 preambles
+            ent = {"ids": ids, "tick": self._preamble_tick}
+            self._preambles[key] = ent
+            while len(self._preambles) > 32:
+                oldest = min(self._preambles,
+                             key=lambda k: self._preambles[k]["tick"])
+                del self._preambles[oldest]
+        ent["tick"] = self._preamble_tick
+
+    def prefix_summary(self, top_k: int = 16) -> list[dict]:
+        """Compact radix summary for the control plane (served through
+        /healthz and the JSON /metrics page): the top-K recently seen
+        preamble hashes with their depth and LIVE resident/spilled
+        coverage (prefix_cache.peek — full-page capacity view).  The
+        router routes sticky-by-expected-prefix-hit on these
+        (serving/router.py).  Callable from HTTP handler threads while
+        the scheduler runs: reads are guarded snapshots, memoized for
+        1 s, and degrade to the previous summary on a raced mutation."""
+        if self._prefix_cache is None:
+            return []
+        now = time.time()
+        memo = self._summary_memo
+        if memo is not None and now - memo[0] < 1.0:
+            return memo[1]
+        out: list[dict] = []
+        try:
+            entries = sorted(self._preambles.items(),
+                             key=lambda kv: -kv[1]["tick"])[:top_k]
+            for key, ent in entries:
+                cov = self._prefix_cache.peek(list(ent["ids"]))
+                out.append({"hash": key,
+                            "depth_tokens": len(ent["ids"]),
+                            "tick": ent["tick"], **cov})
+        except RuntimeError:  # dict/tree resized mid-walk: keep the last
+            return memo[1] if memo is not None else []
+        self._summary_memo = (now, out)
+        return out
+
     def _cache_insert(self, st: _SlotState) -> None:
         """Donate a fully-prefilled slot's prompt-page prefix to the prefix
         cache.  The ``cache_prefix`` request hint (leading PROMPT chars
@@ -2128,16 +2352,20 @@ class ContinuousScheduler:
         either is there nothing to cache."""
         if self._prefix_cache is None:
             return
+        # summary bookkeeping rides the donation point: the preamble just
+        # became (or refreshed as) cached content worth routing onto
+        self._note_preamble(st.req)
         cap = None
         hint = st.req.cache_prefix
         if hint is not None:
             if hint < 0:
                 return
             # token-level cap: bos + encoded system preamble + shared prompt
-            # head.  Approximate at the char boundary by design (the cap
-            # rounds up to a page inside insert) — see GenerationRequest.
-            text = ((st.req.system_prompt + "\n\n")
-                    if st.req.system_prompt else "") + st.req.prompt[:hint]
+            # head (api.preamble_text — the SAME region the routing key
+            # hashes, so placement and donation can never drift apart).
+            # Approximate at the char boundary by design (the cap rounds
+            # up to a page inside insert) — see GenerationRequest.
+            text = preamble_text(st.req.system_prompt, st.req.prompt, hint)
             if not text:
                 return  # hint 0 and no system prompt: nothing shared
             cap = 1 + len(self.tokenizer.encode(text))

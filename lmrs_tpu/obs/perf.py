@@ -88,6 +88,14 @@ class DispatchAttribution:
         self.c_bytes = c("lmrs_decode_model_bytes_total",
                          "model-accounted decode HBM bytes dispatched",
                          "bytes")
+        # host-RAM KV prefetch (engine/host_kv.py): scatter bytes issued
+        # asynchronously ride into the NEXT decode block's wall, so that
+        # block must not feed the clean-sample EMA — the pending flag
+        # marks it dirty and the bytes are counted here
+        self.c_prefetch_bytes = c("lmrs_prefix_prefetch_bytes_total",
+                                  "host→HBM bytes restored by KV spill "
+                                  "prefetch", "bytes")
+        self._prefetch_pending = False
 
     # ------------------------------------------------------------ plumbing
 
@@ -146,6 +154,15 @@ class DispatchAttribution:
             kv /= 2
         return steps * weight_bytes(self.model_cfg, self._quantized) + kv
 
+    def note_prefetch(self, nbytes: float) -> None:
+        """A KV spill prefetch was issued (async scatter): count its HBM
+        bytes and mark the next decode block dirty — its wall includes
+        the transfer, so it must count work but never sample utilization
+        (same discipline as compiling shapes)."""
+        if nbytes > 0:
+            self.c_prefetch_bytes.inc(nbytes)
+        self._prefetch_pending = True
+
     # ------------------------------------------------------------- samples
 
     def note_gap(self, t_start: float, t_end: float) -> None:
@@ -173,6 +190,11 @@ class DispatchAttribution:
         self.c_bytes.inc(nbytes)
         if prefill_flops > 0:
             self.c_flops.inc(prefill_flops)
+        if self._prefetch_pending:
+            # the wall includes an async spill-prefetch scatter sequenced
+            # before this block: count the work, skip the samples
+            self._prefetch_pending = False
+            warm = False
         if not warm:
             return nbytes
         spec = self._spec()
@@ -223,6 +245,9 @@ class DispatchAttribution:
         self.c_bytes.inc(nbytes)
         if prefill_flops > 0:
             self.c_flops.inc(prefill_flops)
+        if self._prefetch_pending:  # same contract as note_block
+            self._prefetch_pending = False
+            warm = False
         if not warm:
             return nbytes
         spec = self._spec()
@@ -250,6 +275,9 @@ class DispatchAttribution:
         if flops <= 0:
             return
         self.c_flops.inc(flops)
+        if self._prefetch_pending:  # the wave's wall includes the scatter
+            self._prefetch_pending = False
+            warm = False
         if not warm:
             return
         t = (t_end - t_start) - self.ensure_rtt()

@@ -47,9 +47,11 @@ from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import urlsplit
 
 from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
-                                 drain_with_callback, remaining_budget)
+                                 drain_with_callback, preamble_key,
+                                 remaining_budget)
 from lmrs_tpu.obs import new_trace_id, stitch_traces
 from lmrs_tpu.testing import faults
+from lmrs_tpu.utils.env import env_bool, env_float
 
 logger = logging.getLogger("lmrs.router")
 
@@ -74,6 +76,13 @@ def _request_body(req: GenerationRequest) -> dict:
         # absolute wall-clock never crosses a host boundary (clock skew),
         # and a retry on a later host automatically forwards less budget
         body["deadline_s"] = max(0.0, remaining_budget(req))
+    if req.cache_prefix is not None:
+        # the prefix-cache hint must reach the backend radix tree: it
+        # caps what the backend donates (scheduler._cache_insert) and
+        # keys the published radix summary this router routes on — a
+        # dropped hint silently bloats the remote tree with per-chunk
+        # unique bodies
+        body["cache_prefix"] = int(req.cache_prefix)
     return body
 
 
@@ -155,7 +164,9 @@ class RouterEngine:
     def __init__(self, hosts: list[str], timeout_s: float = 600.0,
                  probe_floor_s: float = 5.0, probe_jitter_s: float = 2.5,
                  clock=time.monotonic, prefill_hosts: list[str] = (),
-                 decode_hosts: list[str] = ()):
+                 decode_hosts: list[str] = (),
+                 prefix_route: bool | None = None,
+                 summary_ttl_s: float | None = None):
         # Per-role pools (disaggregated serving, docs/SERVING.md): when
         # BOTH the prefill and decode pools have members, requests run the
         # two-tier handoff — admission to the prefill pool, KV-page ticket
@@ -237,6 +248,32 @@ class RouterEngine:
         # routers, and the jobs facade shares the dispatch pool), so the
         # advance is a locked fetch-add, not a bare +=.
         self._rr_base = 0  # guarded-by: _stats_lock
+        # Prefix-aware placement (docs/SERVING.md § routing policy): a
+        # request with a shareable preamble (api.preamble_key over
+        # system prompt + cache_prefix head) routes sticky onto the host
+        # whose published radix summary predicts the deepest hit — or,
+        # with no fresh summary predicting one, onto a deterministic
+        # rendezvous-hash host so same-preamble traffic converges from
+        # cold start instead of scattering round-robin.  The preferred
+        # host goes FIRST in the failover order; everything else about
+        # dispatch (health, retry, pools) is unchanged, so greedy outputs
+        # are placement-invariant.  LMRS_PREFIX_ROUTE=0 restores pure
+        # load/health ordering (the A/B arm).
+        self.prefix_route = (env_bool("LMRS_PREFIX_ROUTE", True)
+                             if prefix_route is None else bool(prefix_route))
+        self.summary_ttl_s = (env_float("LMRS_PREFIX_SUMMARY_TTL", 10.0,
+                                        lo=0.5, hi=300.0)
+                              if summary_ttl_s is None
+                              else float(summary_ttl_s))
+        # netloc -> {"at": clock, "map": {hash -> summary row}}; refreshed
+        # from /healthz on the dispatch pool (control-plane, bare
+        # connections like probes), at most every ttl/2 per host
+        self._summaries: dict[str, dict] = {}  # guarded-by: _summary_lock
+        self._summary_inflight: set[str] = set()  # guarded-by: _summary_lock
+        self._summary_lock = threading.Lock()
+        self._prefix_routed = 0     # guarded-by: _stats_lock
+        self._prefix_predicted = 0  # guarded-by: _stats_lock
+        self._prefix_fallback = 0   # guarded-by: _stats_lock
 
     def _count(self, attr: str) -> None:
         """Increment a handoff counter atomically (dispatch-pool threads)."""
@@ -307,6 +344,10 @@ class RouterEngine:
                 if conn is not None:
                     conn.close()
             per.append(row)
+        with self._summary_lock:
+            now = self._clock()
+            ages = {netloc: round(now - s["at"], 1)
+                    for netloc, s in self._summaries.items()}
         return {"hosts": len(self.hosts),
                 "healthy_hosts": sum(h.healthy for h in self.hosts),
                 "pools": {role: {"size": len(pool),
@@ -315,6 +356,11 @@ class RouterEngine:
                 "handoff": {"handoffs": self._handoffs,
                             "retries": self._handoff_retries,
                             "fallbacks": self._handoff_fallbacks},
+                "prefix_route": {"enabled": self.prefix_route,
+                                 "routed": self._prefix_routed,
+                                 "predicted": self._prefix_predicted,
+                                 "fallback": self._prefix_fallback,
+                                 "summary_age_s": ages},
                 "per_host": per}
 
     def prometheus_metrics(self) -> str:
@@ -415,6 +461,15 @@ class RouterEngine:
         hreg.counter("lmrs_router_jobs_forwarded_total",
                      "durable-job API calls forwarded to backends"
                      ).inc(self._jobs_forwarded)
+        hreg.counter("lmrs_router_prefix_routed_total",
+                     "requests placed sticky-by-prefix (summary-predicted "
+                     "or rendezvous)").inc(self._prefix_routed)
+        hreg.counter("lmrs_router_prefix_hit_predicted_total",
+                     "prefix placements backed by a fresh radix summary "
+                     "predicting a hit").inc(self._prefix_predicted)
+        hreg.counter("lmrs_router_prefix_fallback_total",
+                     "prefix-eligible requests that degraded to plain "
+                     "load/health ordering").inc(self._prefix_fallback)
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
 
@@ -626,10 +681,16 @@ class RouterEngine:
         # paced per host so heavy traffic cannot turn a dead host into a
         # probe storm (_launch_probes)
         self._launch_probes()
+        # radix-summary refresh rides the same wave cadence (prefix-aware
+        # placement reads whatever is fresh; never blocks), and placement
+        # is PLANNED per wave so same-preamble fan-outs split fairly
+        self._refresh_summaries()
+        prefers = self._plan_prefix_placement(
+            requests, "prefill" if self._disagg_ready() else "full")
         try:
             futures = [
                 self._pool.submit(self._one, base + i, req, on_tokens,
-                                  cancelled)
+                                  cancelled, prefers[i])
                 for i, req in enumerate(requests)
             ]
             return [f.result() for f in futures]
@@ -657,7 +718,13 @@ class RouterEngine:
             self._pool.submit(host.probe)
         return probed
 
-    def _targets(self, start: int, role: str = "full") -> list[_Host]:
+    def _role_pool(self, role: str) -> list[_Host]:
+        if role == "full":
+            return self.hosts
+        return self.pools.get(role) or self.pools["both"] or self.hosts
+
+    def _targets(self, start: int, role: str = "full",
+                 prefer: _Host | None = None) -> list[_Host]:
         """Hosts eligible for ``role`` in round-robin order from
         ``start``, healthy first — every eligible host when none is
         marked healthy (a transient fault must not brick the fleet — same
@@ -667,15 +734,171 @@ class RouterEngine:
         from that pool, falling back to the "both" pool when the role
         pool is empty; role "full" (colocated dispatch) draws from EVERY
         host — pool membership is routing policy, not capability, so a
-        degraded tier still serves from whatever survives."""
-        if role == "full":
-            pool = self.hosts
-        else:
-            pool = self.pools.get(role) or self.pools["both"] or self.hosts
+        degraded tier still serves from whatever survives.
+
+        ``prefer`` (prefix-aware placement, _prefix_target) moves one
+        host to the FRONT of the order; failover past it is unchanged."""
+        pool = self._role_pool(role)
         n = len(pool)
         order = [pool[(start + k) % n] for k in range(n)]
         healthy = [h for h in order if h.healthy]
-        return healthy or order
+        out = healthy or order
+        if prefer is not None and prefer in out:
+            out = [prefer] + [h for h in out if h is not prefer]
+        return out
+
+    # ------------------------------------------------- prefix-aware routing
+
+    def _refresh_summaries(self) -> None:
+        """Queue a radix-summary fetch (``GET /healthz`` — the probe-path
+        control plane) for every healthy host whose cached summary is
+        older than half the TTL.  Stale summaries only degrade placement
+        quality; they never block a wave — fetches ride the dispatch
+        pool, results land under the summary lock."""
+        if not self.prefix_route:
+            return
+        now = self._clock()
+        due: list[_Host] = []
+        with self._summary_lock:
+            for h in self.hosts:
+                if not h.healthy or h.netloc in self._summary_inflight:
+                    continue
+                s = self._summaries.get(h.netloc)
+                if s is None or now - s["at"] >= self.summary_ttl_s / 2:
+                    self._summary_inflight.add(h.netloc)
+                    due.append(h)
+        for host in due:
+            self._pool.submit(self._fetch_summary, host)
+
+    def _fetch_summary(self, host: _Host) -> None:
+        """One summary fetch (pool thread).  A failed fetch still stamps
+        ``at`` so a dark host is re-probed at the normal cadence, not
+        hammered; its empty map simply predicts no hits."""
+        doc = None
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(host.netloc, timeout=2.0)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            if resp.status == 200:
+                doc = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - control-plane best effort
+            logger.debug("summary fetch failed for %s: %s: %s",
+                         host.netloc, type(e).__name__, e)
+        finally:
+            if conn is not None:
+                conn.close()
+        smap: dict[str, dict] | None = None
+        if isinstance(doc, dict):
+            smap = {}
+            for ent in doc.get("prefix_summary") or ():
+                if isinstance(ent, dict) and ent.get("hash"):
+                    smap[str(ent["hash"])] = ent
+        with self._summary_lock:
+            if smap is None:
+                # transient fetch failure: keep the last-known-good map
+                # (stale-but-recent beats empty — an empty overwrite
+                # would bounce same-preamble traffic off the warm host
+                # for a whole TTL) and stamp the time only, so the host
+                # is re-probed at the normal cadence, not hammered
+                prev = self._summaries.get(host.netloc)
+                smap = prev["map"] if prev else {}
+            self._summaries[host.netloc] = {"at": self._clock(),
+                                            "map": smap}
+            self._summary_inflight.discard(host.netloc)
+
+    def _prefix_target(self, req: GenerationRequest, role: str = "full"
+                       ) -> tuple[_Host | None, bool, bool]:
+        """Sticky-by-expected-prefix-hit placement for one request:
+        ``(host, predicted, eligible)``.  ``eligible`` is False when the
+        request declares no shared preamble (no placement opinion at
+        all).  Among healthy hosts of the role pool, the one whose FRESH
+        radix summary predicts the deepest hit wins (resident coverage
+        weighted over spilled — a resident hit skips even the prefetch);
+        with no fresh summary predicting a hit, a deterministic
+        rendezvous hash of (preamble, host) places the request so
+        same-preamble traffic converges on one host from cold start.
+        Host health always wins: an unhealthy pick degrades to the
+        normal load/health ordering (``predicted=False, host=None``)."""
+        if not self.prefix_route:
+            return None, False, False
+        key = preamble_key(req.system_prompt, req.prompt, req.cache_prefix)
+        if key is None:
+            return None, False, False
+        healthy = [h for h in self._role_pool(role) if h.healthy]
+        if not healthy:
+            return None, False, True
+        now = self._clock()
+        with self._summary_lock:
+            views = {h.netloc: self._summaries.get(h.netloc)
+                     for h in healthy}
+        best, best_score = None, 0
+        for h in healthy:
+            s = views[h.netloc]
+            if s is None or now - s["at"] > self.summary_ttl_s:
+                continue  # stale: this host predicts nothing
+            ent = s["map"].get(key)
+            if not ent:
+                continue
+            try:
+                score = (2 * int(ent.get("resident_tokens") or 0)
+                         + int(ent.get("spilled_tokens") or 0))
+            except (TypeError, ValueError):
+                continue
+            if score > best_score:
+                best, best_score = h, score
+        if best is not None:
+            return best, True, True
+        best = max(healthy, key=lambda h: hashlib.sha256(
+            f"{key}|{h.netloc}".encode()).digest())
+        return best, False, True
+
+    def _note_prefix_placement(self, prefer: _Host | None, predicted: bool,
+                               eligible: bool) -> None:
+        if not eligible:
+            return
+        with self._stats_lock:
+            if prefer is not None:
+                self._prefix_routed += 1
+                if predicted:
+                    self._prefix_predicted += 1
+            else:
+                self._prefix_fallback += 1
+
+    def _plan_prefix_placement(self, requests: list[GenerationRequest],
+                               role: str) -> list[_Host | None]:
+        """Wave-scoped prefix placement: group the wave's requests by
+        preamble key and give each group's sticky host only its FAIR
+        SHARE — ``ceil(group / healthy_hosts)`` members; the rest spread
+        through the normal rotation.  Locality for steady single-request
+        streams (a group of 1 is fully sticky), parallelism for batch
+        fan-outs: a 24-chunk map wave sharing one preamble must NOT
+        serialize onto one backend — each host prefills the preamble
+        once and the group's remainder hits intra-host, which is exactly
+        what round-robin cost before, while cross-WAVE traffic still
+        converges on warm hosts.  Placement metrics are counted here
+        (spread members count as fallback: they deliberately degraded to
+        load ordering)."""
+        out: list[_Host | None] = [None] * len(requests)
+        if not self.prefix_route:
+            return out
+        healthy_n = max(1, sum(h.healthy for h in self._role_pool(role)))
+        groups: dict[str, list[int]] = {}
+        for idx, req in enumerate(requests):
+            key = preamble_key(req.system_prompt, req.prompt,
+                               req.cache_prefix)
+            if key is not None:
+                groups.setdefault(key, []).append(idx)
+        for members in groups.values():
+            prefer, predicted, eligible = self._prefix_target(
+                requests[members[0]], role)
+            share = -(-len(members) // healthy_n)
+            for k, idx in enumerate(members):
+                sticky = prefer if k < share else None
+                out[idx] = sticky
+                self._note_prefix_placement(
+                    sticky, predicted and sticky is not None, eligible)
+        return out
 
     def _disagg_ready(self) -> bool:
         """True while the two-tier handoff path is viable: both role
@@ -689,7 +912,8 @@ class RouterEngine:
                 and any(h.healthy for h in self.pools["decode"]))
 
     def _one(self, i: int, req: GenerationRequest, on_tokens,
-             cancelled: set[int]) -> GenerationResult:
+             cancelled: set[int],
+             prefer: _Host | None = None) -> GenerationResult:
         # trace ingress for engine-protocol callers (the executor, a
         # fronting server hands requests that already carry one): every
         # forward, retry, and handoff leg re-sends the id via the
@@ -697,7 +921,7 @@ class RouterEngine:
         if req.trace_id is None:
             req.trace_id = new_trace_id()
         if self._disagg_ready():
-            res = self._one_disagg(i, req, on_tokens, cancelled)
+            res = self._one_disagg(i, req, on_tokens, cancelled, prefer)
             if res is not None:
                 return res
             # the two-tier flow degraded (no ticket, decode pool dark,
@@ -707,13 +931,15 @@ class RouterEngine:
             self._count("_handoff_fallbacks")
             logger.warning("request %d: handoff degraded; re-prefilling "
                            "colocated", req.request_id)
-        return self._one_colocated(i, req, on_tokens, cancelled)
+        return self._one_colocated(i, req, on_tokens, cancelled, prefer)
 
     def _one_colocated(self, i: int, req: GenerationRequest, on_tokens,
-                       cancelled: set[int]) -> GenerationResult:
+                       cancelled: set[int],
+                       prefer: _Host | None = None) -> GenerationResult:
         rid = req.request_id
         last_err = "no healthy backend"
-        for attempt, host in enumerate(self._targets(i, "full")[:2]):
+        for attempt, host in enumerate(
+                self._targets(i, "full", prefer=prefer)[:2]):
             if rid in cancelled:
                 return GenerationResult(request_id=rid,
                                         finish_reason="cancelled")
@@ -754,7 +980,8 @@ class RouterEngine:
                                 error=last_err)
 
     def _one_disagg(self, i: int, req: GenerationRequest, on_tokens,
-                    cancelled: set[int]) -> GenerationResult | None:
+                    cancelled: set[int],
+                    prefer: _Host | None = None) -> GenerationResult | None:
         """Two-tier dispatch: prefill pool mints a KV handoff ticket, the
         decode pool follows it.  Returns None to fall back to colocated
         re-prefill (no ticket obtainable, decode attempts exhausted, or
@@ -768,8 +995,11 @@ class RouterEngine:
         pages at the ticket deadline while we re-prefill elsewhere."""
         rid = req.request_id
         # ---- stage 1: prefill + ticket ---------------------------------
+        # the radix tree lives with prefill work: prefix placement
+        # (planned per wave, _plan_prefix_placement) steers the PREFILL
+        # leg; the decode leg stays load/health ordered
         ticket = None
-        for host in self._targets(i, "prefill")[:2]:
+        for host in self._targets(i, "prefill", prefer=prefer)[:2]:
             if rid in cancelled:
                 return GenerationResult(request_id=rid,
                                         finish_reason="cancelled")

@@ -428,6 +428,16 @@ def _clamp_max_tokens(value, cap: int) -> int:
     return min(max(n, 0), cap)
 
 
+def _cache_prefix(body: dict) -> int | None:
+    """The wire's ``cache_prefix`` hint (router-forwarded prefix-cache
+    extension field): an integer char count, or None when absent/garbage.
+    Bools are rejected — ``true`` is not a prefix length."""
+    raw = body.get("cache_prefix")
+    if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+        return None
+    return int(raw)
+
+
 def _flatten_messages(messages: list) -> tuple[list[str], list[str]]:
     """Shared messages[] collapse for both wire formats: system messages join
     the system prompt; user/tool turns concatenate in order; assistant turns
@@ -468,6 +478,7 @@ def _chat_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
         top_k=int(body.get("top_k", 0)),
         stop=tuple(stop),
         seed=body.get("seed"),
+        cache_prefix=_cache_prefix(body),
     )
 
 
@@ -493,6 +504,7 @@ def _messages_to_request(body: dict, max_tokens_cap: int) -> GenerationRequest:
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", 0)),  # native Anthropic param
         stop=tuple(stop),
+        cache_prefix=_cache_prefix(body),
     )
 
 
@@ -594,8 +606,22 @@ class EngineHTTPServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._send(200, {"status": "ok", "role": outer.role,
-                                     "uptime_s": round(time.time() - outer.started, 1)})
+                    payload = {"status": "ok", "role": outer.role,
+                               "uptime_s": round(
+                                   time.time() - outer.started, 1)}
+                    # compact radix summary (prefix-aware fleet routing,
+                    # docs/SERVING.md): rides the probe path so the
+                    # router's placement refresh costs one existing
+                    # control-plane GET, no new endpoint.  Guarded —
+                    # health must answer even if the summary hook breaks.
+                    summary = getattr(outer.engine, "prefix_summary", None)
+                    if summary is not None:
+                        try:
+                            payload["prefix_summary"] = summary()
+                        except Exception:  # noqa: BLE001 - stay healthy
+                            logger.debug("prefix summary failed",
+                                         exc_info=True)
+                    self._send(200, payload)
                 elif self.path == "/v1/trace":
                     self._get_trace()
                 elif self.path.startswith("/v1/handoff/"):
@@ -625,6 +651,14 @@ class EngineHTTPServer:
                         "http_requests": outer.batcher.requests_served,
                         "handoff": outer.handoff_stats(),
                     }
+                    # the radix summary rides the JSON control plane too
+                    # (operators' view; the router refreshes via /healthz)
+                    summary = getattr(outer.engine, "prefix_summary", None)
+                    if summary is not None:
+                        try:
+                            payload["prefix_summary"] = summary()
+                        except Exception:  # noqa: BLE001 - best effort
+                            pass
                     if outer.jobs is not None:
                         payload["jobs"] = outer.jobs.stats()
                     self._send(200, payload)

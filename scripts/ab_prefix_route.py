@@ -1,0 +1,142 @@
+"""Prefix-aware routing A/B over a mock fleet (ROADMAP item 3 / ISSUE 12).
+
+Two arms over the SAME traffic — a workload of requests sharing a handful
+of system/map preambles, submitted in single-request waves so round-robin
+placement genuinely scatters — against >= 2 mock-backend lmrs-serve
+instances behind a RouterEngine:
+
+* ``round_robin``: ``prefix_route=False`` — today's load/health ordering;
+* ``routed``: prefix-aware placement (summary-predicted + rendezvous,
+  docs/SERVING.md § routing policy) with a short summary TTL so the
+  predicted path engages within the run.
+
+Reported per arm: fleet-aggregate prefix hit rate and prefill-tokens-saved
+(summed over the backends' ``/metrics`` prefix blocks — the mock's
+deterministic emulation, same accounting surface as the jax scheduler),
+per-host placement spread, router placement counters, and client-side
+request latency percentiles (the mock generates instantly, so latency
+deltas here measure routing overhead, not cache wins — the token savings
+are the win; TTFT impact needs the chip arm, docs/PERF.md).
+
+CPU-only and fast (~seconds); the identity guarantee (placement never
+changes outputs) is tier-1 gated in tests/test_router.py.
+"""
+
+from __future__ import annotations
+
+import _pathfix  # noqa: F401
+
+import json
+import time
+
+import numpy as np
+
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.serving.router import RouterEngine
+from lmrs_tpu.serving.server import EngineHTTPServer
+from lmrs_tpu.utils.env import env_int
+
+N_HOSTS = env_int("LMRS_AB_HOSTS", 2, lo=2, hi=8)
+N_REQS = env_int("LMRS_AB_REQUESTS", 24, lo=4)
+N_PREAMBLES = env_int("LMRS_AB_PREAMBLES", 3, lo=1)
+
+PREAMBLES = [
+    ("You are summarizing one section of a much longer transcript. "
+     f"Style {k}: keep every fact, decision, name, and number. ")
+    * 3  # long enough that reuse dominates the per-chunk body
+    for k in range(N_PREAMBLES)
+]
+
+
+def mk_requests() -> list[GenerationRequest]:
+    rng = np.random.default_rng(7)
+    out = []
+    for i in range(N_REQS):
+        pre = PREAMBLES[i % N_PREAMBLES]
+        body = " ".join(f"w{rng.integers(0, 999)}" for _ in range(40))
+        out.append(GenerationRequest(
+            prompt=pre + f"Chunk {i}: {body}", request_id=i,
+            system_prompt="Respond with the summary content only.",
+            cache_prefix=len(pre), temperature=0.0, max_new_tokens=64))
+    return out
+
+
+def host_prefix_metrics(router: RouterEngine) -> list[dict]:
+    per = []
+    for row in router.engine_metrics()["per_host"]:
+        eng = row.get("metrics", {}).get("engine", {})
+        per.append({"host": row["host"], "served": row["served"],
+                    **(eng.get("prefix_cache") or {})})
+    return per
+
+
+def run_arm(routed: bool) -> dict:
+    servers = [EngineHTTPServer(MockEngine(seed=0), port=0)
+               for _ in range(N_HOSTS)]
+    for s in servers:
+        s.start_background()
+    router = RouterEngine([f"127.0.0.1:{s.port}" for s in servers],
+                          timeout_s=30.0, prefix_route=routed,
+                          summary_ttl_s=1.0)
+    lat = []
+    try:
+        for req in mk_requests():
+            t0 = time.time()
+            res = router.generate_batch([req])[0]
+            lat.append(time.time() - t0)
+            assert res.error is None, res.error
+            if routed:
+                time.sleep(0.03)  # let summary fetches land between waves
+        per = host_prefix_metrics(router)
+        hits = sum(p.get("hits", 0) for p in per)
+        queries = sum(p.get("queries", 0) for p in per)
+        saved = sum(p.get("tokens_reused", 0) for p in per)
+        lat_ms = sorted(x * 1e3 for x in lat)
+        pct = lambda q: round(lat_ms[min(len(lat_ms) - 1,
+                                         int(q * len(lat_ms)))], 2)
+        return {
+            "arm": "routed" if routed else "round_robin",
+            "hosts": N_HOSTS,
+            "requests": N_REQS,
+            "preambles": N_PREAMBLES,
+            "fleet_hit_rate": round(hits / queries, 3) if queries else 0.0,
+            "fleet_hits": hits,
+            "fleet_queries": queries,
+            "prefill_tokens_saved": saved,
+            "served_spread": sorted(p["served"] for p in per),
+            "router_prefix_route":
+                router.engine_metrics()["prefix_route"],
+            "request_latency_ms": {"p50": pct(0.50), "p90": pct(0.90)},
+        }
+    finally:
+        router.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def main() -> int:
+    rr = run_arm(routed=False)
+    ro = run_arm(routed=True)
+    out = {
+        "round_robin": rr,
+        "routed": ro,
+        "delta": {
+            "fleet_hit_rate": round(
+                ro["fleet_hit_rate"] - rr["fleet_hit_rate"], 3),
+            "prefill_tokens_saved": (ro["prefill_tokens_saved"]
+                                     - rr["prefill_tokens_saved"]),
+        },
+    }
+    print(json.dumps(out, indent=2))
+    ok = (ro["fleet_hit_rate"] >= rr["fleet_hit_rate"]
+          and ro["prefill_tokens_saved"] >= rr["prefill_tokens_saved"])
+    print(f"\nrouted hit rate {ro['fleet_hit_rate']} vs round-robin "
+          f"{rr['fleet_hit_rate']}; tokens saved "
+          f"{ro['prefill_tokens_saved']} vs {rr['prefill_tokens_saved']} "
+          f"-> {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -935,3 +935,37 @@ def test_chaos_job_jax_sigkill_before_done_token_identical(jax_job_baseline,
     assert job.resumed_chunks == jax_job_baseline["n_chunks"]
     assert job.reduce_nodes_reused == jax_job_baseline["n_nodes"]
     assert job.result["summary"] == jax_job_baseline["summary"]
+
+
+def test_chaos_spill_prefetch_faults_token_identical(jax_engine):
+    """Host-RAM spill tier under fire (ISSUE 12): with real page pressure
+    and forced evictions, ``prefix.spill`` faults degrade captures to
+    evict-means-gone and ``prefix.prefetch`` faults truncate matches back
+    to re-prefill — greedy outputs stay token-identical to fault-free
+    runs and the auditor (including the host-pool accounting cross-check)
+    is clean after every wave."""
+    sched = jax_engine._scheduler
+    pre = "Shared chaos preamble: keep every fact, name, and number. "
+
+    def reqs():
+        return [GenerationRequest(
+            prompt=pre + f"chunk {i}: the team discussed item {i}.",
+            request_id=900 + i, temperature=0.0, max_new_tokens=8,
+            cache_prefix=len(pre)) for i in range(5)]
+
+    baseline = [r.text for r in jax_engine.generate_batch(reqs())]
+    assert sched.audit() == []
+    pc = sched._prefix_cache
+    assert pc is not None and pc.pool is not None
+    plan = [{"site": "prefix.spill", "p": 0.5},
+            {"site": "prefix.prefetch", "p": 0.5}]
+    with faults.injected(FaultPlan(seed=29, faults=plan)):
+        pc.evict(10_000)  # spill wave: some captures fault -> hard drop
+        assert sched.audit() == []
+        mid = [r.text for r in jax_engine.generate_batch(reqs())]
+        assert sched.audit() == []
+        pc.evict(10_000)
+        last = [r.text for r in jax_engine.generate_batch(reqs())]
+    assert sched.audit() == []
+    assert mid == baseline
+    assert last == baseline

@@ -1,0 +1,443 @@
+"""Host-RAM KV spill tier (ISSUE 12 / ROADMAP item 3): spilled radix
+nodes, device→host capture, prefetch-on-match promotion, host budget LRU,
+fault degradation, and the scheduler-level greedy token-identity A/B —
+spill on vs off, bf16 and int8 pools — plus an eviction/spill/prefetch
+interleave fuzz closed on the allocator/radix auditors."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.host_kv import HostKVPool
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.engine.kv_cache import PageAllocator, audit_allocator
+from lmrs_tpu.engine.prefix_cache import PrefixCache
+from lmrs_tpu.testing import faults
+from lmrs_tpu.testing.faults import FaultPlan
+
+PS = 4  # page size for the pure-host tree tests
+PAGE_BYTES = 2 * PS  # fake payload: k+v, one "layer/head/dim" byte per token
+
+
+class _FakeKV:
+    """Stands in for PagedKVCache in pure-host tests: capture returns a
+    content-tagged payload, import records the scatter so tests can
+    assert the round trip without a device."""
+
+    def __init__(self):
+        self.imports: list[tuple[tuple[int, ...], dict]] = []
+
+    def capture(self, pages: list[int]) -> dict:
+        n = len(pages)
+        k = np.asarray(pages, np.uint8).reshape(1, n, 1, 1, 1)
+        k = np.broadcast_to(k, (1, n, 1, PS, 1)).copy()
+        return {"k": k, "v": k.copy(), "dtype": "uint8"}
+
+    def import_pages(self, pages, payload, sync=False):
+        self.imports.append((tuple(pages), payload))
+
+
+def _cache(num_pages: int = 64, budget_pages: int = 1 << 20, **kw):
+    a = PageAllocator(num_pages)
+    pool = HostKVPool(budget_pages * PAGE_BYTES)
+    kv = _FakeKV()
+    c = PrefixCache(a, PS, spill_pool=pool, capture_cb=kv.capture,
+                    page_bytes=PAGE_BYTES, **kw)
+    return a, c, kv
+
+
+def _audit_clean(a: PageAllocator, c: PrefixCache, live: list[list[int]]):
+    holders: dict[int, int] = {}
+    for pages in live:
+        for p in pages:
+            holders[p] = holders.get(p, 0) + 1
+    for p in c.retained_pages():
+        holders[p] = holders.get(p, 0) + 1
+    violations = c.audit() + audit_allocator(a, a.num_pages, holders)
+    assert violations == [], violations
+
+
+# ------------------------------------------------------------- pure tree
+
+
+def test_evict_spills_and_match_prefetches():
+    a, c, kv = _cache()
+    ids = list(range(100, 113))  # 3 full pages + remainder
+    seq = a.alloc(4)
+    c.insert(ids, seq)
+    a.free(seq)  # sequence closes: nodes refcount-zero
+    assert c.evict(10) == 3  # device pages freed...
+    assert c.cached_pages == 0
+    assert c.spilled_pages() == 3  # ...but the content spilled, not gone
+    _audit_clean(a, c, [])
+
+    # a legacy match() sees nothing (resident walk stops at the spill)
+    got, n = c.match(ids)
+    assert (got, n) == ([], 0)
+
+    # the spill-aware probe reports the chain; prefetch promotes it back
+    pages, res_tok, chain = c.match_hier(ids)
+    assert (pages, res_tok) == ([], 0)
+    assert len(chain) == 1 and chain[0][1] == 12
+    node, n_tok = chain[0]
+    dest = a.alloc(3)
+    assert c.prefetch_into(node, dest, kv) == 3
+    assert kv.imports and kv.imports[0][0] == tuple(dest)
+    # the payload round-tripped the original page ids as content
+    assert kv.imports[0][1]["k"][0, :, 0, 0, 0].tolist() == seq[:3]
+    assert c.cached_pages == 3 and c.spilled_pages() == 0
+    _audit_clean(a, c, [dest])  # dest doubles as "the sequence's" pages
+    a.free(dest)
+    # now resident again: a plain match hits
+    got, n = c.match(ids)
+    assert n == 12 and got == dest
+    a.free(got)
+    _audit_clean(a, c, [])
+
+
+def test_insert_promotes_spilled_nodes():
+    """A sequence that re-prefilled a spilled span donates its own pages:
+    the node promotes back to resident and the host entry drops."""
+    a, c, _kv = _cache()
+    ids = [7] * 9  # 2 full pages
+    p1 = a.alloc(3)
+    c.insert(ids, p1)
+    a.free(p1)
+    c.evict(10)
+    assert c.spilled_pages() == 2
+    p2 = a.alloc(3)  # the re-prefilled sequence
+    assert c.insert(ids, p2) == 2  # promotion counts as adoption
+    assert c.cached_pages == 2 and c.spilled_pages() == 0
+    got, n = c.match(ids)
+    assert n == 8 and got == p2[:2]
+    a.free(got)
+    _audit_clean(a, c, [p2])
+    a.free(p2)
+
+
+def test_host_budget_lru_drops_oldest_subtree():
+    a, c, _kv = _cache(budget_pages=4)  # host pool holds 4 pages
+    entries = []
+    for base in (10, 40, 70):  # three disjoint 2-page prefixes
+        ids = [base + i for i in range(9)]
+        pages = a.alloc(2)
+        c.insert(ids, pages)
+        a.free(pages)
+        entries.append(ids)
+    assert c.evict(100) == 6  # all spill; pool budget 4 -> oldest drops
+    assert c.spilled_pages() == 4
+    assert c.pool.dropped_pages_total == 2
+    _audit_clean(a, c, [])
+    # the dropped (oldest) prefix is gone; the two recent ones survive
+    assert c.match_hier(entries[0])[2] == []
+    assert c.match_hier(entries[1])[2] != []
+    assert c.match_hier(entries[2])[2] != []
+
+
+def test_oversized_entry_skips_spill_entirely():
+    a, c, kv = _cache(budget_pages=1)  # nothing with >1 page ever fits
+    ids = list(range(0, 13))
+    pages = a.alloc(4)
+    c.insert(ids, pages)
+    a.free(pages)
+    assert c.evict(10) == 3
+    assert c.spilled_pages() == 0  # dropped, not spilled
+    assert kv.imports == []
+    _audit_clean(a, c, [])
+
+
+def test_spill_fault_degrades_to_plain_drop():
+    a, c, _kv = _cache()
+    ids = [3] * 9
+    pages = a.alloc(3)
+    c.insert(ids, pages)
+    a.free(pages)
+    with faults.injected(FaultPlan(faults=[
+            {"site": "prefix.spill", "p": 1.0}])):
+        assert c.evict(10) == 2
+    assert c.spilled_pages() == 0  # capture faulted: evict-means-gone
+    assert c.match_hier(ids) == ([], 0, [])
+    _audit_clean(a, c, [])
+
+
+def test_prefetch_raises_after_host_drop():
+    """An entry the host budget dropped between match and prefetch must
+    raise (the scheduler then re-prefills) — never import stale state."""
+    a, c, kv = _cache(budget_pages=4)
+    ids = [9] * 9
+    pages = a.alloc(3)
+    c.insert(ids, pages)
+    a.free(pages)
+    c.evict(10)
+    _pages, _tok, chain = c.match_hier(ids)
+    node, _n = chain[0]
+    # host pressure drops the entry under us
+    c.pool.budget_bytes = 0
+    c._enforce_host_budget()
+    dest = a.alloc(2)
+    with pytest.raises(RuntimeError):
+        c.prefetch_into(node, dest, kv)
+    a.free(dest)
+    _audit_clean(a, c, [])
+
+
+def test_shared_nodes_never_spill():
+    a, c, _kv = _cache()
+    ids = list(range(200, 209))
+    pages = a.alloc(3)
+    c.insert(ids, pages)
+    assert c.evict(10) == 0  # live sequence shares the pages
+    assert c.spilled_pages() == 0
+    a.free(pages)
+    assert c.evict(10) == 2
+    assert c.spilled_pages() == 2
+    _audit_clean(a, c, [])
+
+
+def test_clear_drops_both_tiers():
+    a, c, _kv = _cache()
+    for base in (10, 40):
+        ids = [base + i for i in range(9)]
+        pages = a.alloc(2)
+        c.insert(ids, pages)
+        a.free(pages)
+    c.evict(2)  # one prefix spilled, one resident
+    assert c.spilled_pages() == 2 and c.cached_pages == 2
+    c.clear()
+    assert c.spilled_pages() == 0 and c.cached_pages == 0
+    assert c.pool.used_bytes == 0
+    assert a.free_count == a.num_pages - 1
+    _audit_clean(a, c, [])
+
+
+# --------------------------------------------------------- interleave fuzz
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzzed_spill_prefetch_interleave(seed):
+    """Random insert/close/evict/match+prefetch/budget-squeeze interleave:
+    the radix auditor, the host-pool accounting cross-check, and the
+    allocator page-conservation audit stay clean after EVERY op."""
+    rng = np.random.default_rng(seed)
+    a, c, kv = _cache(num_pages=48, budget_pages=8)
+    live: list[list[int]] = []
+    prefixes = [[int(b) + i for i in range(int(rng.integers(5, 14)))]
+                for b in (10, 40, 70, 100)]
+    for _step in range(120):
+        op = rng.integers(0, 5)
+        if op == 0 and a.free_count >= 6:  # open+insert a sharing seq
+            ids = list(prefixes[int(rng.integers(0, len(prefixes)))]) + [
+                int(t) for t in rng.integers(200, 250, 4)]
+            pages = a.alloc(-(-len(ids) // PS))
+            c.insert(ids, pages)
+            live.append(pages)
+        elif op == 1 and live:  # close a live sequence
+            a.free(live.pop(int(rng.integers(0, len(live)))))
+        elif op == 2:  # device pressure
+            c.evict(int(rng.integers(1, 6)))
+        elif op == 3:  # match + prefetch (a spilled-hit admission)
+            ids = list(prefixes[int(rng.integers(0, len(prefixes)))]) + [99]
+            pages, _tok, chain = c.match_hier(ids)
+            got = list(pages)
+            for node, n_tok in chain:
+                need = n_tok // PS
+                if a.free_count < need:
+                    break
+                dest = a.alloc(need)
+                try:
+                    c.prefetch_into(node, dest, kv)
+                except RuntimeError:
+                    a.free(dest)
+                    break
+                got += dest
+            if got:
+                live.append(got)  # the admitted sequence's cloned prefix
+        else:  # host budget squeeze + restore
+            c.pool.budget_bytes = int(rng.integers(0, 8)) * PAGE_BYTES
+            c._enforce_host_budget()
+            c.pool.budget_bytes = 8 * PAGE_BYTES
+        _audit_clean(a, c, live)
+    for pages in live:
+        a.free(pages)
+    c.clear()
+    _audit_clean(a, c, [])
+    assert a.free_count == a.num_pages - 1
+
+
+# ------------------------------------------------- scheduler integration
+
+
+def tiny_model():
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+PREAMBLE = ("You are summarizing one section of a much longer transcript. "
+            "Keep every fact, decision, name, and number. ")
+
+
+def _map_requests(n: int, lo: int = 0) -> list[GenerationRequest]:
+    return [GenerationRequest(
+        prompt=PREAMBLE + f"Chunk {i}: the team discussed milestone {i}.",
+        request_id=lo + i, temperature=0.0, max_new_tokens=8,
+        system_prompt="Respond with the summary content only.",
+        cache_prefix=len(PREAMBLE)) for i in range(n)]
+
+
+def _engine(**kw):
+    cfg = dict(backend="jax", scheduler="continuous", max_tokens=8,
+               max_batch_slots=2, seed=0, page_size=16, decode_block=4)
+    cfg.update(kw)
+    return JaxEngine(EngineConfig(**cfg), tiny_model())
+
+
+def _evict_rerun(eng):
+    """Force a full HBM eviction, then re-run the shared-preamble batch —
+    the spilled-hit path when the tier is armed, a plain re-prefill
+    otherwise.  Returns both runs' texts and prefill-token costs."""
+    reqs = _map_requests(4)
+    sched = eng._scheduler
+    first = [r.text for r in eng.generate_batch(reqs)]
+    pf1 = sched.metrics["prefill_tokens"]
+    sched._prefix_cache.evict(10_000)
+    assert sched.audit() == []
+    second = [r.text for r in eng.generate_batch(reqs)]
+    pf2 = sched.metrics["prefill_tokens"] - pf1
+    assert sched.audit() == []
+    return first, second, pf1, pf2
+
+
+def test_spill_tier_identity_and_accounting():
+    """Greedy outputs token-identical with the spill tier on vs off, the
+    armed arm actually prefetches (re-prefills only the tail), and the
+    kill switch restores evict-means-gone exactly."""
+    on = _engine()
+    sched = on._scheduler
+    assert sched._prefix_cache.pool is not None
+    first_on, second_on, pf1, pf2 = _evict_rerun(on)
+    m = sched.metrics
+    assert m["prefix_spilled_hits"] == 4
+    assert m["prefix_tokens_prefetched"] > 0
+    assert m["prefix_spill_pages"] == m["prefix_prefetch_pages"] > 0
+    # the re-run after eviction prefilled only the per-chunk tails: the
+    # prefetched preamble made it cheaper than the warm first run
+    assert pf2 < pf1
+    rep = sched.metrics_report()
+    assert rep["host_kv"]["enabled"]
+    assert rep["prefix_cache"]["tokens_prefetched"] > 0
+    on.shutdown()
+
+    off = _engine(host_kv=False)
+    assert off._scheduler._prefix_cache.pool is None
+    first_off, second_off, _pf1, pf2_off = _evict_rerun(off)
+    assert off._scheduler.metrics["prefix_spill_pages"] == 0
+    assert not off._scheduler.metrics_report()["host_kv"]["enabled"]
+    # the tier's whole point: the armed arm re-prefilled less
+    assert pf2 < pf2_off
+    off.shutdown()
+
+    assert first_on == first_off, "spill tier changed greedy outputs"
+    assert second_on == second_off, "prefetched KV diverged from re-prefill"
+    assert first_on == second_on
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("LMRS_HOST_KV", "0")
+    eng = _engine()
+    assert eng._scheduler._prefix_cache.pool is None
+    eng.shutdown()
+
+
+def test_int8_pool_arm_disarms_with_prefix_cache():
+    """int8 KV disables the prefix cache (per-slot scales), so the spill
+    tier is vacuously off — outputs stay identical on/off and nothing
+    spills (the documented composition, docs/SERVING.md)."""
+    reqs = _map_requests(3)
+    on = _engine(kv_quantize="int8", page_size=32)
+    assert on._scheduler._prefix_cache is None
+    got = [r.text for r in on.generate_batch(reqs)]
+    assert on._scheduler.metrics["prefix_spill_pages"] == 0
+    assert not on._scheduler.metrics_report()["host_kv"]["enabled"]
+    on.shutdown()
+    off = _engine(kv_quantize="int8", page_size=32, host_kv=False)
+    want = [r.text for r in off.generate_batch(reqs)]
+    off.shutdown()
+    assert got == want
+
+
+def test_budget_pressure_keeps_pool_bounded():
+    """A host budget sized for ~5 pages: spills stay within it, overflow
+    drops for real, auditors clean, outputs unchanged."""
+    budget_pages = 5
+    eng = _engine()
+    page_b = eng._scheduler.cache.page_payload_bytes()
+    eng.shutdown()
+    eng = _engine(host_kv_gb=budget_pages * page_b / 2**30)
+    sched = eng._scheduler
+    first, second, _pf1, _pf2 = _evict_rerun(eng)
+    assert first == second
+    pool = sched._prefix_cache.pool
+    assert pool.used_bytes <= pool.budget_bytes
+    # a second eviction wave spills again within the budget
+    sched._prefix_cache.evict(10_000)
+    assert pool.used_bytes <= pool.budget_bytes
+    assert sched.audit() == []
+    assert (pool.dropped_pages_total > 0
+            or sched._prefix_cache.spilled_pages() <= budget_pages)
+    eng.shutdown()
+
+
+def test_prefetch_fault_reprefills_and_stays_clean():
+    """prefix.prefetch firing on every spilled hit: the match truncates,
+    segments re-prefill, outputs stay identical, auditors clean."""
+    eng = _engine()
+    sched = eng._scheduler
+    reqs = _map_requests(4)
+    first = [r.text for r in eng.generate_batch(reqs)]
+    sched._prefix_cache.evict(10_000)
+    with faults.injected(FaultPlan(faults=[
+            {"site": "prefix.prefetch", "p": 1.0}])):
+        second = [r.text for r in eng.generate_batch(reqs)]
+    assert sched.audit() == []
+    assert first == second
+    assert sched.metrics["prefix_spilled_hits"] == 0  # nothing restored
+    eng.shutdown()
+
+
+def test_prefix_summary_published():
+    eng = _engine()
+    eng.generate_batch(_map_requests(3))
+    rows = eng.prefix_summary()
+    assert rows and rows[0]["resident_tokens"] > 0
+    assert rows[0]["depth_tokens"] >= rows[0]["resident_tokens"]
+    sched = eng._scheduler
+    sched._prefix_cache.evict(10_000)
+    sched._summary_memo = None  # drop the 1 s memo for the re-probe
+    rows = eng.prefix_summary()
+    assert rows[0]["resident_tokens"] == 0
+    assert rows[0]["spilled_tokens"] > 0
+    eng.shutdown()
+
+
+def test_preamble_lru_learns_past_capacity():
+    """The published-summary preamble table must keep learning past its
+    32-entry cap: the NEWEST preamble survives the LRU trim (regression:
+    a zero-tick insert made the new entry its own victim)."""
+    from lmrs_tpu.engine.api import preamble_key
+
+    eng = _engine()
+    sched = eng._scheduler
+    for i in range(40):
+        sched._note_preamble(GenerationRequest(
+            prompt=f"preamble {i} body " * 4, request_id=i,
+            system_prompt=f"sys {i}", cache_prefix=24))
+    assert len(sched._preambles) == 32
+    newest = preamble_key("sys 39", "preamble 39 body " * 4, 24)
+    oldest = preamble_key("sys 0", "preamble 0 body " * 4, 24)
+    assert newest in sched._preambles
+    assert oldest not in sched._preambles
+    eng.shutdown()

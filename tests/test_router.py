@@ -81,7 +81,7 @@ def cluster():
             [sys.executable, "-m", "lmrs_tpu.serving.cli",
              "--backend", "jax", "--model", "quality-tiny",
              "--tokenizer", "byte", "--port", str(p),
-             "--batch-slots", "2", "--max-tokens-cap", "512", "-q"],
+             "--batch-slots", "2", "--max-tokens-cap", "1024", "-q"],
             env=env, cwd="/root/repo",
             stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
         )
@@ -146,15 +146,18 @@ def test_cancel_crosses_process_boundary(cluster):
         result["res"] = router.generate_batch(
             [GenerationRequest(prompt="cancel me over the wire",
                                request_id=77, temperature=0.0,
-                               max_new_tokens=400)])[0]
+                               max_new_tokens=900)])[0]
 
     tokens_before = {u: _host_metrics(u)["engine"]["decode_tokens"]
                      for u in urls}
     t = threading.Thread(target=run)
     t.start()
     # cancel once a worker is provably mid-decode on THIS request: its
-    # decode_tokens counter grows past the pre-test snapshot (400 tokens /
-    # decode_block 16 = 25 block boundaries for the sweep to land on)
+    # decode_tokens counter grows past the pre-test snapshot (900 tokens /
+    # decode_block 16 = 56 block boundaries for the sweep to land on —
+    # the budget is deliberately large so a fast warm decode cannot
+    # complete inside the worker's 0.5 s disconnect-poll window and win
+    # the race against the cancel)
     deadline = time.time() + 120
     while time.time() < deadline and t.is_alive():
         if any(_host_metrics(u)["engine"]["decode_tokens"]
@@ -214,6 +217,40 @@ def test_streamed_cancel_is_cancelled_not_stop(cluster):
     assert res.finish_reason == "cancelled", res
     assert res.text == "".join(deltas)
     assert res.completion_tokens < 400
+
+
+
+def test_prefix_route_identity_on_jax_cluster(cluster):
+    """The jax arm of the routing identity A/B: the same greedy
+    same-preamble workload through the real two-scheduler cluster routed
+    and round-robin — token-identical texts, and the routed arm reports
+    prefix placements."""
+    urls, _procs, _router = cluster
+    hosts = [u.split("//", 1)[1] for u in urls]
+
+    def run(prefix_route: bool) -> list[str]:
+        router = RouterEngine(hosts, timeout_s=300.0,
+                              prefix_route=prefix_route)
+        try:
+            out = []
+            for w in range(3):  # single-request waves: RR scatters
+                res = router.generate_batch([GenerationRequest(
+                    prompt=_SHARED_PRE + "Chunk: facts here.",
+                    request_id=w, temperature=0.0, max_new_tokens=12,
+                    cache_prefix=len(_SHARED_PRE))])[0]
+                assert res.error is None, res.error
+                out.append(res.text)
+            if prefix_route:
+                em = router.engine_metrics()["prefix_route"]
+                assert em["routed"] == 3, em
+            return out
+        finally:
+            router.shutdown()
+
+    routed = run(True)
+    rr = run(False)
+    assert routed == rr
+    assert len(set(routed)) == 1  # same prompt, greedy: one text
 
 
 def test_dead_host_degrades_not_fails(cluster):
@@ -460,3 +497,201 @@ def test_router_recv_fault_surfaces_midstream_error():
     finally:
         router.shutdown()
         srv.shutdown()
+
+
+# ---------------------------------------------- prefix-aware routing (ISSUE 12)
+
+_SHARED_PRE = ("You are summarizing one section of a much longer "
+               "transcript. Keep every fact, decision, name, and number. ")
+
+
+def _preamble_requests(lo: int, n: int) -> list[GenerationRequest]:
+    return [GenerationRequest(
+        prompt=_SHARED_PRE + f"Chunk {i}: the team discussed item {i}.",
+        request_id=lo + i, temperature=0.0,
+        system_prompt="Respond with the summary content only.",
+        cache_prefix=len(_SHARED_PRE)) for i in range(n)]
+
+
+def _mock_fleet(n: int = 2, **router_kw):
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    servers = [EngineHTTPServer(MockEngine(seed=0), port=0,
+                                batch_window_s=0.01) for _ in range(n)]
+    for s in servers:
+        s.start_background()
+    router = RouterEngine([f"127.0.0.1:{s.port}" for s in servers],
+                          timeout_s=30.0, **router_kw)
+    return servers, router
+
+
+def test_request_body_forwards_cache_prefix():
+    """The satellite regression (ISSUE 12): the wire must carry the
+    prefix-cache hint end to end — _request_body emits it and the
+    server's request builders parse it back — or routed requests insert
+    uncapped into the backend radix tree."""
+    from lmrs_tpu.serving.router import _request_body
+    from lmrs_tpu.serving.server import (_chat_to_request,
+                                         _messages_to_request)
+
+    req = _preamble_requests(0, 1)[0]
+    body = _request_body(req)
+    assert body["cache_prefix"] == len(_SHARED_PRE)
+    rebuilt = _chat_to_request(body, max_tokens_cap=4096)
+    assert rebuilt.cache_prefix == len(_SHARED_PRE)
+    assert rebuilt.prompt == req.prompt
+    assert rebuilt.system_prompt == req.system_prompt
+    body2 = dict(body, system=req.system_prompt)
+    assert _messages_to_request(body2, 4096).cache_prefix == len(_SHARED_PRE)
+    # hint-free requests forward no field and parse back None (and
+    # garbage on the wire never crashes the builder)
+    assert "cache_prefix" not in _request_body(
+        GenerationRequest(prompt="p", request_id=1))
+    assert _chat_to_request({"messages": [], "cache_prefix": True},
+                            4096).cache_prefix is None
+
+
+def test_routed_requests_hit_backend_prefix_cache():
+    """Router→server regression: forwarded same-preamble requests REPORT
+    prefix-cache hits on the backend (the hint actually reached the
+    radix accounting), and prefix placement keeps them on ONE host."""
+    servers, router = _mock_fleet(2)
+    try:
+        for w in range(5):  # single-request waves: RR would scatter
+            res = router.generate_batch(_preamble_requests(w * 10, 1))[0]
+            assert res.error is None
+        per = [_host_metrics(f"http://127.0.0.1:{s.port}") for s in servers]
+        blocks = [m["engine"].get("prefix_cache") for m in per
+                  if m["engine"].get("prefix_cache")]
+        assert len(blocks) == 1, "placement scattered across hosts"
+        assert blocks[0]["queries"] == 5
+        assert blocks[0]["hits"] == 4, blocks
+        assert blocks[0]["prefill_tokens_saved"] > 0
+        em = router.engine_metrics()["prefix_route"]
+        assert em["enabled"] and em["routed"] == 5
+    finally:
+        router.shutdown()
+        _shutdown_fleet(servers)
+
+
+def _shutdown_fleet(servers) -> None:
+    for s in servers:
+        s.shutdown()
+
+
+def test_prefix_route_identity_vs_round_robin():
+    """Placement must never change outputs: the same workload through a
+    routed fleet and a round-robin fleet produces identical texts (mock
+    determinism is per (seed, prompt) — host-independent)."""
+    servers, routed = _mock_fleet(2, summary_ttl_s=1.0)
+    rr = RouterEngine([h.netloc for h in routed.hosts], timeout_s=30.0,
+                      prefix_route=False)
+    try:
+        reqs = _preamble_requests(0, 6)
+        t_routed = [r.text for r in routed.generate_batch(reqs)]
+        t_rr = [r.text for r in rr.generate_batch(_preamble_requests(0, 6))]
+        assert t_routed == t_rr
+        assert all(t for t in t_routed)
+        assert rr.engine_metrics()["prefix_route"]["enabled"] is False
+        assert rr.engine_metrics()["prefix_route"]["routed"] == 0
+    finally:
+        routed.shutdown()
+        rr.shutdown()
+        _shutdown_fleet(servers)
+
+
+def test_prefix_route_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("LMRS_PREFIX_ROUTE", "0")
+    router = RouterEngine(["127.0.0.1:1"])
+    try:
+        assert router.prefix_route is False
+        req = _preamble_requests(0, 1)[0]
+        assert router._prefix_target(req) == (None, False, False)
+    finally:
+        router.shutdown()
+
+
+def test_prefix_route_summary_predicted_placement():
+    """With a short summary TTL the predicted path engages: the host that
+    served the preamble publishes it via /healthz and later requests are
+    placed on its summary, not just the rendezvous hash."""
+    servers, router = _mock_fleet(2, summary_ttl_s=0.5)
+    try:
+        for i in range(3):
+            router.generate_batch(_preamble_requests(i * 10, 1))
+            time.sleep(0.4)  # let the wave-path summary refresh land
+        em = router.engine_metrics()["prefix_route"]
+        assert em["predicted"] >= 1, em
+        assert em["routed"] == 3
+    finally:
+        router.shutdown()
+        _shutdown_fleet(servers)
+
+
+def test_prefix_route_ab_beats_round_robin_aggregate():
+    """The acceptance A/B (ISSUE 12): over 2 hosts sharing preambles,
+    routed placement raises the fleet-aggregate hit rate and
+    prefill-tokens-saved vs round-robin (scripts/ab_prefix_route.py is
+    the reporting harness; this is the tier-1 assertion)."""
+    def run(prefix_route: bool) -> tuple[int, int]:
+        servers, router = _mock_fleet(2, prefix_route=prefix_route)
+        try:
+            for w in range(6):
+                res = router.generate_batch(
+                    _preamble_requests(w * 10, 1))[0]
+                assert res.error is None
+            hits = saved = 0
+            for s in servers:
+                pc = _host_metrics(f"http://127.0.0.1:{s.port}")[
+                    "engine"].get("prefix_cache") or {}
+                hits += pc.get("hits", 0)
+                saved += pc.get("prefill_tokens_saved", 0)
+            return hits, saved
+        finally:
+            router.shutdown()
+            _shutdown_fleet(servers)
+
+    rr_hits, rr_saved = run(prefix_route=False)
+    ro_hits, ro_saved = run(prefix_route=True)
+    assert ro_hits > rr_hits, (ro_hits, rr_hits)
+    assert ro_saved > rr_saved, (ro_saved, rr_saved)
+
+
+def test_unhealthy_preferred_host_degrades_to_ordering():
+    """A rendezvous/predicted pick that is unhealthy must degrade to the
+    normal load/health order (the request still completes elsewhere)."""
+    servers, router = _mock_fleet(2)
+    try:
+        req = _preamble_requests(0, 1)[0]
+        prefer, _pred, eligible = router._prefix_target(req)
+        assert eligible and prefer is not None
+        prefer.healthy = False
+        prefer2, _pred2, _el = router._prefix_target(req)
+        assert prefer2 is not prefer
+        res = router.generate_batch(_preamble_requests(0, 1))[0]
+        assert res.error is None
+        em = router.engine_metrics()["prefix_route"]
+        assert em["routed"] >= 1
+    finally:
+        router.shutdown()
+        _shutdown_fleet(servers)
+
+
+def test_prefix_route_fair_share_keeps_fleet_busy():
+    """A same-preamble BATCH wave must not serialize onto the sticky
+    host: the wave planner caps the sticky share at ceil(group/healthy)
+    and spreads the rest, so a map fan-out keeps every host busy while
+    single-request waves stay fully sticky."""
+    servers, router = _mock_fleet(2)
+    try:
+        out = router.generate_batch(_preamble_requests(0, 12))
+        assert all(r.error is None for r in out)
+        served = sorted(h.served for h in router.hosts)
+        assert served[0] > 0, f"fleet imbalance: {served}"
+        em = router.engine_metrics()["prefix_route"]
+        # sticky share = ceil(12/2) = 6; the rest deliberately spread
+        assert em["routed"] == 6 and em["fallback"] == 6, em
+    finally:
+        router.shutdown()
+        _shutdown_fleet(servers)
