@@ -332,6 +332,16 @@ class ReduceConfig:
     streaming: bool = False
     max_levels: int = 4
     temperature: float = 0.2  # reference hardcodes 0.2 (result_aggregator.py:238)
+    # Stable reduce-tree shape for APPEND-ONLY workloads (lmrs_tpu/live/):
+    # fixed-arity (`max_summaries_per_batch`) leaf-aligned batching with
+    # position-free batch metadata, so appending leaves changes only the
+    # last (partial) batch per level and the root path — every sibling
+    # subtree keeps a byte-identical prompt and answers from the node
+    # cache.  The default token-budget shape re-batches the WHOLE level
+    # when sizes drift and bakes "batch i/n" positions into each prompt,
+    # which poisons every cached node on any append.  Off by default: the
+    # batch pipeline keeps its historical tree.
+    stable_tree: bool = False
 
 
 @dataclass
@@ -362,6 +372,45 @@ class JobsConfig:
 
 
 @dataclass
+class LiveConfig:
+    """Live-session knobs (lmrs_tpu/live/: incremental summarization of
+    growing transcripts — docs/SERVING.md § Live sessions).
+
+    ``sessions_dir`` empty = the session API is disabled (lmrs-serve
+    answers 501; batch pipeline and jobs are unaffected).
+    ``refresh_tokens`` > 0 auto-triggers a refresh when a session has
+    accumulated that many appended-but-unsummarized tokens (0 = refresh
+    only on request).  ``class_default`` is the deadline class a refresh
+    runs under when the request names none: ``interactive`` refreshes
+    carry a per-request deadline (``interactive_deadline_s``) and ride
+    PR 5's shed/expiry lifecycle ahead of ``bulk`` backfill, which runs
+    unbounded.
+    """
+
+    sessions_dir: str = field(default_factory=lambda: _env("LMRS_LIVE_DIR", ""))
+    refresh_tokens: int = field(
+        default_factory=lambda: _env("LMRS_LIVE_REFRESH_TOKENS", 0, int))
+    class_default: str = field(
+        default_factory=lambda: _env("LMRS_LIVE_CLASS_DEFAULT", "interactive"))
+    interactive_deadline_s: float = 120.0
+
+    def __post_init__(self) -> None:
+        if self.class_default not in ("interactive", "bulk"):
+            raise ValueError(
+                f"unknown live deadline class {self.class_default!r}; "
+                "want interactive|bulk")
+        if self.refresh_tokens < 0:
+            raise ValueError(
+                f"refresh_tokens must be >= 0 (got {self.refresh_tokens}); "
+                "0 disables auto-refresh")
+        if self.interactive_deadline_s <= 0:
+            raise ValueError(
+                f"interactive_deadline_s must be > 0 "
+                f"(got {self.interactive_deadline_s}); use class 'bulk' "
+                "for unbounded refreshes")
+
+
+@dataclass
 class PipelineConfig:
     """Top-level config: one object wires the whole pipeline."""
 
@@ -372,6 +421,7 @@ class PipelineConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     reduce: ReduceConfig = field(default_factory=ReduceConfig)
     jobs: JobsConfig = field(default_factory=JobsConfig)
+    live: LiveConfig = field(default_factory=LiveConfig)
 
     def replace(self, **kw: Any) -> "PipelineConfig":
         return dataclasses.replace(self, **kw)

@@ -118,56 +118,31 @@ class TranscriptChunker:
     # -- public API ---------------------------------------------------------
 
     def chunk_transcript(self, segments: list[Segment]) -> list[Chunk]:
-        """Pack segments into token-budgeted chunks (big_chunkeroosky.py:46-145)."""
+        """Pack segments into token-budgeted chunks (big_chunkeroosky.py:46-145).
+
+        One-shot chunking IS the incremental state machine fed everything
+        at once (``incremental()``): the live-session tier depends on the
+        two paths never diverging, so there is exactly one packing loop."""
         if not segments:
             return []
-        t0 = min(s["start"] for s in segments)
-        t1 = max(s["end"] for s in segments)
-
-        chunks: list[Chunk] = []
-        current: list[Segment] = []
-        current_tokens = 0
-
-        def flush() -> None:
-            nonlocal current, current_tokens
-            if current:
-                chunks.append(self._finalize_chunk(current, len(chunks), t0, t1))
-                overlap = self._overlap_segments(current)
-                current = overlap
-                current_tokens = sum(self._count(s["text"]) for s in overlap)
-
-        seg_counts = self._count_batch([s["text"] for s in segments])
-        for seg, n in zip(segments, seg_counts):
-            if n > self.effective_max_tokens:
-                # Oversized segment: flush, then split sentence-aware into
-                # its own run of chunks (big_chunkeroosky.py:101-128).
-                flush()
-                if current:  # drop overlap before an oversized split run
-                    current, current_tokens = [], 0
-                for piece in self._chunk_large_segment(seg):
-                    pn = self._count(piece["text"])
-                    if current_tokens + pn > self.effective_max_tokens:
-                        flush()
-                    current.append(piece)
-                    current_tokens += pn
-                continue
-            if current_tokens + n > self.effective_max_tokens:
-                flush()
-                if current_tokens + n > self.effective_max_tokens:
-                    # overlap seeding left no room for this segment — drop
-                    # the overlap rather than exceed the budget
-                    current, current_tokens = [], 0
-            current.append(seg)
-            current_tokens += n
-        if current:
-            chunks.append(self._finalize_chunk(current, len(chunks), t0, t1))
-
-        self.postprocess_chunks(chunks)
+        inc = self.incremental()
+        inc.append(segments)
+        chunks = inc.chunks()
         logger.info(
             "chunked %d segments -> %d chunks (budget %d tok, overlap %d)",
             len(segments), len(chunks), self.effective_max_tokens, self.overlap_tokens,
         )
         return chunks
+
+    def incremental(self) -> "IncrementalChunking":
+        """Append-only chunking state for a transcript that GROWS (live
+        sessions, lmrs_tpu/live/): ``append`` extends the stream,
+        ``chunks`` snapshots the pack so far.  Sealed chunk identities
+        ``(chunk_index, start_time, end_time)`` and text are frozen the
+        moment a later chunk opens; only the open tail chunk extends —
+        the stability every downstream cache key (map summaries, reduce
+        nodes) leans on."""
+        return IncrementalChunking(self)
 
     def postprocess_chunks(self, chunks: list[Chunk]) -> list[Chunk]:
         """Backfill total_chunks + refresh headers (big_chunkeroosky.py:544-567)."""
@@ -270,6 +245,23 @@ class TranscriptChunker:
             f"[POSITION: {chunk.position_percentage:.0f}% through the transcript]\n\n"
         )
 
+    def stable_context_header(self, chunk: Chunk) -> str:
+        """Append-stable variant of the context header (live sessions,
+        lmrs_tpu/live/): no ``of N`` total and no position percentage —
+        both change on every append, so a map prompt carrying them could
+        never be cached across refreshes (the summary a sealed chunk got
+        at 8 chunks would differ from the one a cold run of 31 chunks
+        gives it).  Everything left is a pure function of the chunk
+        itself."""
+        time_range = (
+            f"{format_timestamp(chunk.start_time)} - {format_timestamp(chunk.end_time)}"
+        )
+        return (
+            f"[TRANSCRIPT SECTION {chunk.chunk_index + 1}]\n"
+            f"[TIME RANGE: {time_range}]\n"
+            f"[SPEAKERS: {', '.join(chunk.speakers)}]\n\n"
+        )
+
     def _chunk_large_segment(self, seg: Segment) -> list[Segment]:
         """Split an oversized segment into sentence-level pieces, each under
         the budget, with timestamps interpolated by character position
@@ -338,6 +330,124 @@ class TranscriptChunker:
             for i in range(0, len(words), 20):
                 out.append(" ".join(words[i : i + 20]))
         return [c for c in out if c]
+
+
+class IncrementalChunking:
+    """Append-only chunking state (``TranscriptChunker.incremental``).
+
+    THE packing loop of the repo — ``chunk_transcript`` routes through it
+    — restructured so the greedy cursor survives between appends.  The
+    greedy packer is forward-only (a chunk's contents depend only on
+    segments before it), which is what makes incremental emission
+    byte-identical to a one-shot pack over the same segment prefix:
+
+    * **sealed chunks** (everything before the open tail) froze their
+      segment list, text, token count, and ``(chunk_index, start_time,
+      end_time)`` identity the moment the next chunk opened — an append
+      can never move an emitted boundary;
+    * the **open tail chunk** extends (or flushes and opens successors)
+      exactly as the one-shot loop would have, had the appended segments
+      been present from the start;
+    * snapshot-time fields that depend on the WHOLE transcript so far
+      (``total_chunks``, ``position_percentage``, the context header) are
+      recomputed per ``chunks()`` call — they are presentation, not
+      identity, and the one-shot path recomputes them the same way.
+
+    Not thread-safe: callers (the live session tier) serialize appends
+    per session.
+    """
+
+    def __init__(self, chunker: TranscriptChunker):
+        self._ck = chunker
+        self._sealed: list[Chunk] = []   # identity/text frozen forever
+        self._current: list[Segment] = []  # the open tail's segments
+        self._current_tokens = 0
+        self._t0: float | None = None    # running min(start) over the stream
+        self._t1: float | None = None    # running max(end)
+        self._n_segments = 0
+
+    @property
+    def sealed_count(self) -> int:
+        """Chunks whose identity and text can never change again."""
+        return len(self._sealed)
+
+    @property
+    def chunk_count(self) -> int:
+        """Sealed chunks + the open tail (what ``chunks()`` would return)."""
+        return len(self._sealed) + (1 if self._current else 0)
+
+    @property
+    def chunker(self) -> TranscriptChunker:
+        return self._ck
+
+    @property
+    def n_segments(self) -> int:
+        return self._n_segments
+
+    def append(self, segments: list[Segment]) -> None:
+        """Extend the stream.  Continues the greedy pack exactly where the
+        previous append left it (big_chunkeroosky.py:46-145 loop body)."""
+        ck = self._ck
+        if not segments:
+            return
+        for s in segments:
+            self._t0 = s["start"] if self._t0 is None else min(self._t0, s["start"])
+            self._t1 = s["end"] if self._t1 is None else max(self._t1, s["end"])
+        self._n_segments += len(segments)
+        seg_counts = ck._count_batch([s["text"] for s in segments])
+        for seg, n in zip(segments, seg_counts):
+            if n > ck.effective_max_tokens:
+                # Oversized segment: flush, then split sentence-aware into
+                # its own run of chunks (big_chunkeroosky.py:101-128).
+                self._flush()
+                if self._current:  # drop overlap before an oversized split run
+                    self._current, self._current_tokens = [], 0
+                for piece in ck._chunk_large_segment(seg):
+                    pn = ck._count(piece["text"])
+                    if self._current_tokens + pn > ck.effective_max_tokens:
+                        self._flush()
+                    self._current.append(piece)
+                    self._current_tokens += pn
+                continue
+            if self._current_tokens + n > ck.effective_max_tokens:
+                self._flush()
+                if self._current_tokens + n > ck.effective_max_tokens:
+                    # overlap seeding left no room for this segment — drop
+                    # the overlap rather than exceed the budget
+                    self._current, self._current_tokens = [], 0
+            self._current.append(seg)
+            self._current_tokens += n
+
+    def _flush(self) -> None:
+        """Seal the open tail and seed the next chunk with its overlap."""
+        ck = self._ck
+        if self._current:
+            self._sealed.append(ck._finalize_chunk(
+                self._current, len(self._sealed), self._t0, self._t1))
+            overlap = ck._overlap_segments(self._current)
+            self._current = overlap
+            self._current_tokens = sum(ck._count(s["text"]) for s in overlap)
+
+    def chunks(self) -> list[Chunk]:
+        """Snapshot the pack so far — byte-identical to
+        ``chunk_transcript`` over the same segment stream.
+
+        Sealed chunks are the SAME objects across snapshots (their
+        ``summary``/accounting fields, written by the map stage, survive);
+        the open tail chunk is rebuilt per snapshot since appends extend
+        it.  ``position_percentage`` / ``total_chunks`` / the context
+        header are refreshed against the stream seen so far."""
+        out = list(self._sealed)
+        if self._current:
+            out.append(self._ck._finalize_chunk(
+                self._current, len(self._sealed), self._t0, self._t1))
+        # whole-transcript presentation fields: the span grew with every
+        # append, so sealed chunks' stored positions are stale snapshots
+        span = max((self._t1 or 0.0) - (self._t0 or 0.0), 1e-9)
+        for c in out:
+            c.position_percentage = 100.0 * (c.start_time - (self._t0 or 0.0)) / span
+        self._ck.postprocess_chunks(out)
+        return out
 
 
 if __name__ == "__main__":  # stage demo (pattern: big_chunkeroosky.py:570-606)

@@ -1,0 +1,13 @@
+"""Live sessions: incremental summarization of growing transcripts.
+
+The first streaming workload tier (ROADMAP item 5): a client opens a
+session, appends transcript segments as they arrive (a meeting, a
+stream), and refreshes the summary incrementally — only the dirty tail
+chunks and the dirty reduce root path recompute, everything else answers
+from content-addressed caches journaled through the PR 7 WAL.
+"""
+
+from lmrs_tpu.live.session import (LiveSession, SessionManager,
+                                   rebuild_live_state)
+
+__all__ = ["LiveSession", "SessionManager", "rebuild_live_state"]

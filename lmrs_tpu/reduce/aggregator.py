@@ -19,6 +19,7 @@ defects deliberately fixed (SURVEY.md §2.3):
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import time
 from typing import Any, Sequence
@@ -39,6 +40,30 @@ from lmrs_tpu.prompts import (
 )
 
 logger = logging.getLogger("lmrs.reduce")
+
+
+def content_node_id(display: str, summaries: Sequence[str],
+                    template: str | None,
+                    metadata: dict | None = None) -> str:
+    """Reduce-node identity = positional display name + a hash of the
+    node's ACTUAL prompt inputs (children's text, template, AND metadata
+    — metadata is substituted into the prompt, so two nodes differing
+    only there are different nodes).  The positional part
+    (``L<level>.B<batch>``) is for humans — logs, journal records; the
+    content hash is what node caches may key on: inserting a leaf shifts
+    every later batch's position, and a purely positional id would
+    poison each of their cached entries while a content-derived one
+    keeps every unchanged subtree addressable.  Canonical-JSON payload
+    (the jobs journal's node_key construction) — a delimiter join over
+    raw strings would collide on summaries containing the delimiter."""
+    import json
+
+    digest = hashlib.sha256(json.dumps(
+        [template or "", metadata or {}, list(summaries)],
+        sort_keys=True, separators=(",", ":"), ensure_ascii=False,
+        default=str,
+    ).encode("utf-8", "replace")).hexdigest()[:12]
+    return f"{display}@{digest}"
 
 
 class ResultAggregator:
@@ -90,14 +115,25 @@ class ResultAggregator:
             for c in chunks
         ]
         total_tokens = self._total_tokens(summaries)
-        hierarchical = (
-            self.config.hierarchical and total_tokens > self.config.max_tokens_per_batch
-        )
+        if self.config.stable_tree:
+            # shape is a function of LEAF COUNT alone (append-stability:
+            # token totals grow with every append and would reshape the
+            # tree; the count only ever appends new batches at the edge)
+            hierarchical = (self.config.hierarchical
+                            and len(summaries) > self._stable_arity())
+        else:
+            hierarchical = (self.config.hierarchical
+                            and total_tokens > self.config.max_tokens_per_batch)
         logger.info(
-            "reduce: %d summaries, %d tokens -> %s",
-            len(summaries), total_tokens, "hierarchical" if hierarchical else "single-pass",
+            "reduce: %d summaries, %d tokens -> %s%s",
+            len(summaries), total_tokens,
+            "hierarchical" if hierarchical else "single-pass",
+            " (stable tree)" if self.config.stable_tree else "",
         )
-        if hierarchical:
+        if hierarchical and self.config.stable_tree:
+            summary, levels = self._hierarchical_stable(
+                summaries, prompt_template, metadata, node_cache)
+        elif hierarchical:
             summary, levels = self._hierarchical(summaries, prompt_template,
                                                  metadata, node_cache)
         else:
@@ -173,9 +209,17 @@ class ResultAggregator:
         a resumed run must retry them, not rehydrate the failure)."""
         out: list[str | None] = [None] * len(jobs)
         misses: list[int] = []
+        # content-derived identities (positional display kept as the
+        # prefix), hashed ONCE per job and reused by the record below:
+        # position-keyed identities go stale on any leaf insertion,
+        # content-derived ones keep unchanged sibling subtrees addressable
+        idents: list[str | None] = [None] * len(jobs)
         for i, (node_id, summaries, template, metadata) in enumerate(jobs):
             if node_cache is not None:
-                text = node_cache.lookup(node_id, summaries, template, metadata)
+                idents[i] = content_node_id(node_id, summaries, template,
+                                            metadata)
+                text = node_cache.lookup(idents[i], summaries, template,
+                                         metadata)
                 if text is not None:
                     out[i] = text
                     continue
@@ -194,8 +238,8 @@ class ResultAggregator:
             if reason is None:
                 out[i] = res.text
                 if node_cache is not None:
-                    node_cache.record(node_id, summaries, template, metadata,
-                                      res.text)
+                    node_cache.record(idents[i], summaries, template,
+                                      metadata, res.text)
             else:
                 out[i] = f"[Error aggregating summaries: {reason}]"
                 self._wave_errors += 1
@@ -254,6 +298,63 @@ class ResultAggregator:
             self._trace_level(level, len(batches), t_level)
         if len(current) == 1:
             return current[0], level
+        t_final = time.time()
+        final = self._reduce_once(
+            current, prompt_template or DEFAULT_FINAL_REDUCE_PROMPT, metadata,
+            node_cache, node_id=f"L{level + 1}.final",
+        )
+        self._trace_level(level + 1, 1, t_final)
+        return final, level + 1
+
+    def _stable_arity(self) -> int:
+        return max(2, self.config.max_summaries_per_batch)
+
+    def _hierarchical_stable(
+        self,
+        summaries: list[str],
+        prompt_template: str | None,
+        metadata: dict[str, Any] | None,
+        node_cache: Any | None = None,
+    ) -> tuple[str, int]:
+        """Append-stable batch tree (``ReduceConfig.stable_tree``; the
+        rolling-reduce substrate of lmrs_tpu/live/).
+
+        Differences from ``_hierarchical``, each one an append-stability
+        requirement:
+
+        * **fixed arity** (``max_summaries_per_batch``), leaf-aligned:
+          batch ``i`` of a level always holds children ``[i*a, (i+1)*a)``
+          — appending leaves adds/extends only the LAST batch per level,
+          never re-partitions the ones before it;
+        * **no positional batch metadata**: "batch i/n" / "position
+          lo%-hi%" substitutions bake the leaf count into every prompt, so
+          one append would change every node's text.  Batch nodes carry no
+          metadata; the transcript-global metadata (duration, speakers,
+          num_chunks) goes to the FINAL node only — the root recomputes on
+          every append anyway;
+        * levels derive from the leaf count alone, so a resumed/appended
+          run recomputes exactly the dirty root path and answers every
+          sibling subtree from the node cache.
+        """
+        arity = self._stable_arity()
+        level = 0
+        current = summaries
+        while len(current) > arity and level < self.config.max_levels:
+            level += 1
+            batches = [current[i: i + arity]
+                       for i in range(0, len(current), arity)]
+            logger.info(
+                "reduce level %d (stable): %d summaries in %d batches of <=%d",
+                level, len(current), len(batches), arity,
+            )
+            jobs = [
+                (f"L{level}.B{i}", batch,
+                 prompt_template or DEFAULT_BATCH_REDUCE_PROMPT, None)
+                for i, batch in enumerate(batches)
+            ]
+            t_level = time.time()
+            current = self._reduce_wave(jobs, node_cache)
+            self._trace_level(level, len(batches), t_level)
         t_final = time.time()
         final = self._reduce_once(
             current, prompt_template or DEFAULT_FINAL_REDUCE_PROMPT, metadata,

@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "/v1/jobs): write-ahead journals live here and "
                         "interrupted jobs resume on startup (default: "
                         "LMRS_JOBS_DIR; unset disables — 501)")
+    p.add_argument("--live-dir", default=None,
+                   help="enable the live-session API (POST/GET/DELETE "
+                        "/v1/sessions*): growing transcripts summarized "
+                        "incrementally, journaled here and rehydrated on "
+                        "startup (default: LMRS_LIVE_DIR; unset disables "
+                        "— 501)")
     p.add_argument("--trace", action="store_true",
                    help="enable the in-process lifecycle tracer; GET "
                         "/v1/trace then serves this host's span ring "
@@ -116,8 +122,9 @@ def main(argv: list[str] | None = None) -> int:
             batch_window_s=args.batch_window_ms / 1000.0,
             role=args.role, handoff_ttl_s=engine_cfg.handoff_ttl_s,
             jobs_dir=args.jobs_dir,
-            # the job fingerprint must reflect the SERVED model/config,
-            # not PipelineConfig defaults
+            live_dir=args.live_dir,
+            # the job/session fingerprints must reflect the SERVED
+            # model/config, not PipelineConfig defaults
             pipeline_config=PipelineConfig(engine=engine_cfg),
         )
     except OSError as e:

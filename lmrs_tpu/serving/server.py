@@ -515,7 +515,7 @@ class EngineHTTPServer:
                  model_name: str = "lmrs-tpu", max_tokens_cap: int = 4096,
                  batch_window_s: float = 0.02, role: str = "both",
                  handoff_ttl_s: float = 60.0, jobs_dir: str | None = None,
-                 pipeline_config=None):
+                 live_dir: str | None = None, pipeline_config=None):
         if role not in ("prefill", "decode", "both"):
             raise ValueError(f"unknown serving role {role!r}; "
                              "want prefill|decode|both")
@@ -532,12 +532,15 @@ class EngineHTTPServer:
         # LMRS_JOBS_DIR (JobsConfig); empty disables the API (501 — or
         # forwarding, when the engine is a router with job_request).
         self.jobs = None
-        if jobs_dir is None or pipeline_config is not None:
+        self.live = None
+        if jobs_dir is None or live_dir is None or pipeline_config is not None:
             from lmrs_tpu.config import PipelineConfig
 
             pipeline_config = pipeline_config or PipelineConfig()
             if jobs_dir is None:
                 jobs_dir = pipeline_config.jobs.jobs_dir
+            if live_dir is None:
+                live_dir = pipeline_config.live.sessions_dir
         if jobs_dir:
             from lmrs_tpu.jobs.manager import JobManager
 
@@ -547,6 +550,23 @@ class EngineHTTPServer:
             if recovered:
                 logger.info("job recovery: %d interrupted job(s) re-queued "
                             "from %s", recovered, jobs_dir)
+        # Live sessions (docs/SERVING.md § Live sessions): with a
+        # live_dir, POST/GET/DELETE /v1/sessions* run a journaled
+        # SessionManager whose refresh waves ride the micro-batcher
+        # (pooled with interactive traffic); session journals found in
+        # the directory rehydrate at startup, so a session survives a
+        # server crash/restart.  live_dir=None falls back to
+        # LMRS_LIVE_DIR (LiveConfig); empty disables the API (501 — or
+        # forwarding, when the engine is a router with session_request).
+        if live_dir:
+            from lmrs_tpu.live import SessionManager
+
+            self.live = SessionManager(_BatcherEngine(self.batcher),
+                                       live_dir, config=pipeline_config)
+            rehydrated = self.live.recover()
+            if rehydrated:
+                logger.info("session recovery: %d live session(s) "
+                            "rehydrated from %s", rehydrated, live_dir)
         # Disaggregated serving (docs/SERVING.md): the ROLE is a policy,
         # not a capability — a prefill-role server short-circuits only
         # requests that carry the handoff flag (plain requests still run
@@ -630,6 +650,12 @@ class EngineHTTPServer:
                         or self.path.startswith("/v1/jobs/")):
                     code, payload = outer._job_http("GET", self.path, None)
                     self._send(code, payload)
+                elif (self.path.split("?", 1)[0] == "/v1/sessions"
+                        or self.path.startswith("/v1/sessions/")):
+                    path, _, query = self.path.partition("?")
+                    code, payload = outer._session_http("GET", path, None,
+                                                        query=query)
+                    self._send(code, payload)
                 elif self.path == "/v1/models":
                     self._send(200, {"object": "list", "data": [
                         {"id": outer.model_name, "object": "model",
@@ -661,6 +687,8 @@ class EngineHTTPServer:
                             pass
                     if outer.jobs is not None:
                         payload["jobs"] = outer.jobs.stats()
+                    if outer.live is not None:
+                        payload["live"] = outer.live.stats()
                     self._send(200, payload)
                 else:
                     self._send(404, {"error": {"message": f"no route {self.path}"}})
@@ -891,6 +919,10 @@ class EngineHTTPServer:
                 if self.path.startswith("/v1/jobs/"):
                     code, payload = outer._job_http("DELETE", self.path, None)
                     self._send(code, payload)
+                elif self.path.startswith("/v1/sessions/"):
+                    code, payload = outer._session_http("DELETE", self.path,
+                                                        None)
+                    self._send(code, payload)
                 else:
                     self._send(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -908,6 +940,14 @@ class EngineHTTPServer:
                     return
                 if self.path == "/v1/jobs":
                     code, payload = outer._job_http(
+                        "POST", self.path, body,
+                        trace_id=clean_trace_id(
+                            self.headers.get("X-LMRS-Trace")))
+                    self._send(code, payload)
+                    return
+                if (self.path == "/v1/sessions"
+                        or self.path.startswith("/v1/sessions/")):
+                    code, payload = outer._session_http(
                         "POST", self.path, body,
                         trace_id=clean_trace_id(
                             self.headers.get("X-LMRS-Trace")))
@@ -1228,6 +1268,88 @@ class EngineHTTPServer:
             job = self.jobs.cancel(jid) or job
         return 200, self.jobs.status_doc(job)
 
+    # ---------------------------------------------- live-session plumbing
+
+    def _session_http(self, method: str, path: str, body: dict | None,
+                      trace_id: str | None = None, query: str = ""):
+        """The /v1/sessions surface: returns ``(status, payload)``.
+
+        Local-first like jobs: a configured SessionManager answers here;
+        without one, an engine exposing ``session_request``
+        (RouterEngine) forwards to the backend fleet sticky-by-session-id
+        — a session's journal AND its warm prefix tree live with the
+        backend that runs it.  Neither → 501."""
+        if self.live is None:
+            forward = getattr(self.engine, "session_request", None)
+            if forward is not None:
+                try:
+                    full = path + (f"?{query}" if query else "")
+                    return forward(method, full, body, trace_id=trace_id)
+                except Exception as e:  # noqa: BLE001 - marked, never a crash
+                    logger.exception("session forward failed")
+                    return 502, {"error": {
+                        "message": f"session forward failed: "
+                                   f"{type(e).__name__}: {e}",
+                        "type": "session_error"}}
+            return 501, {"error": {
+                "message": "session API disabled on this host; start "
+                           "lmrs-serve with --live-dir (or LMRS_LIVE_DIR)",
+                "type": "session_error"}}
+        body = body or {}
+        try:
+            if method == "POST" and path.rstrip("/") == "/v1/sessions":
+                session = self.live.create(body.get("params"),
+                                           session_id=body.get("session_id"),
+                                           trace_id=trace_id)
+                return 200, self.live.status_doc(session)
+            if method == "GET" and path.rstrip("/") == "/v1/sessions":
+                return 200, {"object": "list",
+                             "data": [self.live.status_doc(s)
+                                      for s in self.live.sessions()]}
+            rest = path.split("/v1/sessions/", 1)[-1].strip("/")
+            sid, _, sub = rest.partition("/")
+            if not sid:
+                return 404, {"error": {"message": f"no route {path}",
+                                       "type": "session_error"}}
+            if method == "POST" and sub == "segments":
+                return 200, self.live.append(sid, body.get("segments"),
+                                             refresh=body.get("refresh"),
+                                             klass=body.get("class"))
+            if method == "POST" and sub == "refresh":
+                return 200, self.live.refresh(sid, body.get("class"))
+            if method == "GET" and sub == "summary":
+                from urllib.parse import parse_qs
+
+                q = parse_qs(query or "")
+                if q.get("refresh", ["0"])[-1] not in ("0", "false", ""):
+                    self.live.refresh(sid, (q.get("class") or [None])[-1])
+                return 200, self.live.summary_doc(sid)
+            if method == "GET" and not sub:
+                session = self.live.get(sid)
+                if session is None or session.closed:
+                    raise KeyError(sid)
+                return 200, self.live.status_doc(session)
+            if method == "DELETE" and not sub:
+                session = self.live.close(sid)
+                if session is None:
+                    raise KeyError(sid)
+                return 200, {"object": "session", "id": sid,
+                             "status": "closed"}
+            return 404, {"error": {"message": f"no route {method} {path}",
+                                   "type": "session_error"}}
+        except KeyError:
+            return 404, {"error": {"message": f"no session {sid}",
+                                   "type": "session_error"}}
+        except ValueError as e:
+            return 400, {"error": {"message": str(e),
+                                   "type": "session_error"}}
+        except Exception as e:  # noqa: BLE001 - a 5xx body, never a crash
+            logger.exception("session request failed")
+            return 500, {"error": {
+                "message": f"session request failed: "
+                           f"{type(e).__name__}: {e}",
+                "type": "session_error"}}
+
     # ------------------------------------------------ handoff plumbing
 
     def _fetch_handoff(self, desc: dict):
@@ -1396,6 +1518,8 @@ class EngineHTTPServer:
         parts.append(self._handoff_reg.render_prometheus())
         if self.jobs is not None:  # lmrs_jobs_* (docs/OBSERVABILITY.md)
             parts.append(self.jobs.registry.render_prometheus())
+        if self.live is not None:  # lmrs_live_* (docs/OBSERVABILITY.md)
+            parts.append(self.live.registry.render_prometheus())
         return merge_expositions(parts)
 
     def serve_forever(self) -> None:
@@ -1416,6 +1540,10 @@ class EngineHTTPServer:
             # before the batcher: the job worker's in-flight requests must
             # drain (or fast-fail) through a still-open dispatch queue
             self.jobs.shutdown()
+        if self.live is not None:
+            # same ordering: in-flight refresh waves drain or fast-fail
+            # through the open dispatch queue, then journals close
+            self.live.shutdown()
         self.batcher.shutdown()
 
 

@@ -1,0 +1,192 @@
+"""Live-session incremental refresh A/B (ROADMAP item 5 / ISSUE 13).
+
+The product scenario: a ~3-hour meeting transcript already summarized,
+then ~5 minutes of new segments arrive and the summary refreshes.  Two
+arms over the SAME grown transcript, both deviceless (SessionManager
+over MockEngine — the mock's deterministic text + prefix-cache emulation
+give the same accounting surface as the jax scheduler):
+
+* ``full``: re-summarize from scratch — a FRESH session fed the grown
+  transcript in one append (what every refresh would cost without the
+  rolling state);
+* ``incremental``: the live path — the warm session appends the 5
+  minutes and refreshes, recomputing only the dirty tail chunks and the
+  dirty reduce root path.
+
+Reported: refresh-after-append wall clock, map chunks recomputed vs
+reused, reduce nodes recomputed vs reused, and prompt tokens run through
+the engine (the prefill-cost proxy; on a chip this is prefill work, here
+it is the mock's token accounting).  PASS gate (ISSUE 13 acceptance):
+the incremental arm reuses >= 90% of the grown tree's reduce nodes AND
+its refreshed summary is byte-identical to the full arm's — incremental
+must never trade correctness for latency.
+
+CPU-only and fast (~seconds).  Knobs: LMRS_LIVE_AB_HOURS /
+LMRS_LIVE_AB_APPEND_MIN (workload shape), LMRS_LIVE_AB_CHUNK_TOKENS
+(chunk budget — smaller means a deeper tree).
+"""
+
+from __future__ import annotations
+
+import _pathfix  # noqa: F401
+
+import json
+import random
+import tempfile
+import time
+
+from lmrs_tpu.config import (ChunkConfig, EngineConfig, LiveConfig,
+                             PipelineConfig, ReduceConfig)
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.live import SessionManager
+from lmrs_tpu.utils.env import env_float, env_int
+
+HOURS = env_float("LMRS_LIVE_AB_HOURS", 3.0, lo=0.1)
+APPEND_MIN = env_float("LMRS_LIVE_AB_APPEND_MIN", 5.0, lo=0.5)
+CHUNK_TOKENS = env_int("LMRS_LIVE_AB_CHUNK_TOKENS", 240, lo=120)
+
+WORDS = ("the quarterly review covered the inference engine roadmap "
+         "kernel design latency targets hiring plan budget allocation "
+         "serving tier milestones decisions follow ups and the open "
+         "questions everyone agreed to revisit next week").split()
+
+
+def meeting_segments(seconds: float, seed: int = 11,
+                     t0: float = 0.0) -> list[dict]:
+    """Deterministic synthetic meeting audio: ~12s utterances, 2 speakers,
+    ~2 words/second — a 3h meeting lands ~21k words (~28k approx tokens)."""
+    rng = random.Random(f"{seed}:{t0}")
+    segs = []
+    t = t0
+    while t < t0 + seconds:
+        dur = 8.0 + rng.random() * 8.0
+        n_words = int(dur * 2)
+        text = " ".join(rng.choice(WORDS) for _ in range(n_words))
+        segs.append({"start": round(t, 2), "end": round(t + dur, 2),
+                     "text": text.capitalize() + ".",
+                     "speaker": f"SPEAKER_{rng.randrange(2):02d}"})
+        t += dur + 0.5
+    return segs
+
+
+def live_config() -> PipelineConfig:
+    return PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=CHUNK_TOKENS,
+                          overlap_tokens=0, context_tokens=60),
+        engine=EngineConfig(backend="mock", temperature=0.0, max_tokens=64,
+                            retry_delay=0.0),
+        # arity 3 => a 3h transcript at this chunk budget forms a 4-level
+        # tree with ~100+ nodes, so the dirty root path is a small slice
+        reduce=ReduceConfig(max_summaries_per_batch=3),
+        live=LiveConfig(class_default="bulk"))
+
+
+class _CountingEngine:
+    """Transparent wrapper counting the prompt tokens/requests an arm
+    actually runs through the engine — the prefill-cost proxy (on a chip
+    every counted token is prefill work; the radix cache then shaves the
+    shared preambles off it on both arms equally)."""
+
+    def __init__(self, inner: MockEngine):
+        self._inner = inner
+        self.prompt_tokens = 0
+        self.requests = 0
+
+    def generate_batch(self, requests, on_result=None, on_tokens=None):
+        tok = self._inner._tok
+        for r in requests:
+            self.prompt_tokens += tok.count(r.prompt)
+            self.requests += 1
+        kw = {}
+        if on_result is not None:
+            kw["on_result"] = on_result
+        if on_tokens is not None:
+            kw["on_tokens"] = on_tokens
+        return self._inner.generate_batch(requests, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def run() -> dict:
+    base = meeting_segments(HOURS * 3600.0, seed=11, t0=0.0)
+    tail = meeting_segments(APPEND_MIN * 60.0, seed=11,
+                            t0=base[-1]["end"] + 0.5)
+    cfg = live_config()
+
+    # ---- warm the incremental arm on the base transcript
+    with tempfile.TemporaryDirectory() as d:
+        inc_engine = _CountingEngine(MockEngine(seed=0))
+        inc = SessionManager(inc_engine, d, config=cfg)
+        inc.create(session_id="live")
+        inc.append("live", base, refresh=True)
+
+        tokens0, reqs0 = inc_engine.prompt_tokens, inc_engine.requests
+        t0 = time.time()
+        doc = inc.append("live", tail, refresh=True)
+        inc_wall = time.time() - t0
+        r = doc["refresh"]
+        inc_tokens = inc_engine.prompt_tokens - tokens0
+        inc_reqs = inc_engine.requests - reqs0
+
+    # ---- full arm: fresh session over the grown transcript
+    with tempfile.TemporaryDirectory() as d:
+        full_engine = _CountingEngine(MockEngine(seed=0))
+        full = SessionManager(full_engine, d, config=cfg)
+        full.create(session_id="cold")
+        t0 = time.time()
+        cold = full.append("cold", base + tail, refresh=True)["refresh"]
+        full_wall = time.time() - t0
+        full_tokens = full_engine.prompt_tokens
+        full_reqs = full_engine.requests
+
+    nodes_total = r["reduce_nodes_reused"] + r["reduce_nodes_computed"]
+    node_reuse = r["reduce_nodes_reused"] / max(nodes_total, 1)
+    identical = r["summary"] == cold["summary"]
+    return {
+        "workload": {
+            "hours": HOURS, "append_minutes": APPEND_MIN,
+            "segments": len(base) + len(tail),
+            "chunks": r["num_chunks"], "reduce_levels": r["levels"],
+        },
+        "incremental": {
+            "refresh_seconds": round(inc_wall, 3),
+            "dirty_chunks": r["dirty_chunks"],
+            "chunk_summaries_reused": r["chunk_summaries_reused"],
+            "reduce_nodes_computed": r["reduce_nodes_computed"],
+            "reduce_nodes_reused": r["reduce_nodes_reused"],
+            "node_reuse_ratio": round(node_reuse, 3),
+            "requests_run": inc_reqs,
+            "prompt_tokens_run": inc_tokens,
+        },
+        "full": {
+            "refresh_seconds": round(full_wall, 3),
+            "chunks_computed": cold["num_chunks"],
+            "reduce_nodes_computed": cold["reduce_nodes_computed"],
+            "requests_run": full_reqs,
+            "prompt_tokens_run": full_tokens,
+        },
+        "delta": {
+            "speedup": round(full_wall / max(inc_wall, 1e-9), 2),
+            "prompt_tokens_saved": full_tokens - inc_tokens,
+            "tokens_saved_ratio": round(
+                1.0 - inc_tokens / max(full_tokens, 1), 3),
+        },
+        "token_identical": identical,
+    }
+
+
+def main() -> int:
+    out = run()
+    print(json.dumps(out, indent=2))
+    inc = out["incremental"]
+    ok = (out["token_identical"] and inc["node_reuse_ratio"] >= 0.90)
+    print(f"\nincremental: {inc['dirty_chunks']} dirty chunks, node reuse "
+          f"{inc['node_reuse_ratio']:.1%}, {out['delta']['speedup']}x faster "
+          f"than full; token-identical={out['token_identical']} "
+          f"-> {'PASS' if ok else 'FAIL'} (gate: reuse >= 90% + identity)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

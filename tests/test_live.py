@@ -1,0 +1,865 @@
+"""Live sessions (ISSUE 13): incremental chunking parity, the stable
+rolling reduce tree, session lifecycle + journal resume, SIGKILL chaos,
+append/refresh/cancel fuzz, and the /v1/sessions serving surface.
+
+The tier-1 ``live-session`` gate (tier1.yml) runs this whole file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import _live_worker as lw
+from conftest import free_port, make_segments
+from lmrs_tpu.config import (ChunkConfig, EngineConfig, LiveConfig,
+                             PipelineConfig, ReduceConfig)
+from lmrs_tpu.data.chunker import Chunk, TranscriptChunker
+from lmrs_tpu.data.preprocessor import preprocess_transcript
+from lmrs_tpu.engine.executor import MapExecutor
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.jobs import journal as jl
+from lmrs_tpu.live import SessionManager, rebuild_live_state
+from lmrs_tpu.live.session import REC_SEGMENTS, REC_SUMMARY
+from lmrs_tpu.reduce.aggregator import ResultAggregator, content_node_id
+
+
+# --------------------------------------------------------------------------
+# incremental chunker: parity + boundary stability
+# --------------------------------------------------------------------------
+
+
+def _chunker(**kw) -> TranscriptChunker:
+    defaults = dict(max_tokens_per_chunk=120, overlap_tokens=0,
+                    context_tokens=20, tokenizer="approx")
+    defaults.update(kw)
+    return TranscriptChunker(**defaults)
+
+
+@pytest.mark.parametrize("overlap", [0, 40])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_incremental_matches_oneshot_over_every_prefix(seed, overlap):
+    """Property (ISSUE 13 satellite): for random segment streams chopped
+    into random append batches, the incremental snapshot after each
+    append is BYTE-IDENTICAL to a one-shot ``chunk_transcript`` over the
+    same prefix — including overlap seeding and oversized-segment
+    splits."""
+    segs = preprocess_transcript(make_segments(140, seed=seed),
+                                 merge_same_speaker=False)
+    # plant an oversized segment so the sentence-split path is exercised
+    segs[17] = dict(segs[17], text=" ".join(
+        f"Fact {k} about the roadmap milestone." for k in range(120)))
+    inc = _chunker(overlap_tokens=overlap).incremental()
+    rng = random.Random(seed)
+    i = 0
+    while i < len(segs):
+        k = rng.randrange(1, 13)
+        inc.append(segs[i:i + k])
+        i += k
+        snap = [c.to_dict() for c in inc.chunks()]
+        ref = [c.to_dict() for c in
+               _chunker(overlap_tokens=overlap).chunk_transcript(segs[:i])]
+        assert json.dumps(snap, sort_keys=True) == \
+            json.dumps(ref, sort_keys=True)
+
+
+def test_incremental_sealed_boundaries_never_move():
+    """Previously sealed ``(index, start, end)`` identities and chunk
+    text are frozen across appends; only the open tail extends."""
+    segs = preprocess_transcript(make_segments(120, seed=4),
+                                 merge_same_speaker=False)
+    inc = _chunker().incremental()
+    seen: dict[int, tuple] = {}
+    tail_history: list[tuple] = []
+    for i in range(0, len(segs), 7):
+        inc.append(segs[i:i + 7])
+        snap = inc.chunks()
+        for c in snap[:inc.sealed_count]:
+            ident = (c.start_time, c.end_time, c.text)
+            if c.chunk_index in seen:
+                assert seen[c.chunk_index] == ident, \
+                    f"sealed chunk {c.chunk_index} moved"
+            seen[c.chunk_index] = ident
+        if snap:
+            tail = snap[-1]
+            tail_history.append((tail.chunk_index, tail.start_time,
+                                 tail.end_time))
+    # the tail only ever extends: same index keeps its start, end grows
+    for (i1, s1, e1), (i2, s2, e2) in zip(tail_history, tail_history[1:]):
+        assert i2 >= i1
+        if i2 == i1:
+            assert s2 == s1 and e2 >= e1
+
+
+def test_incremental_empty_and_accessors():
+    inc = _chunker().incremental()
+    assert inc.chunks() == [] and inc.chunk_count == 0
+    inc.append([])
+    assert inc.chunks() == []
+    segs = preprocess_transcript(make_segments(10, seed=0),
+                                 merge_same_speaker=False)
+    inc.append(segs)
+    assert inc.chunk_count == len(inc.chunks()) > 0
+    assert inc.n_segments == len(segs)
+
+
+# --------------------------------------------------------------------------
+# stable reduce tree + content-derived node identity
+# --------------------------------------------------------------------------
+
+
+class DictCache:
+    """Minimal node cache recording what the aggregator asked of it."""
+
+    def __init__(self):
+        self.store: dict[str, str] = {}
+        self.computed: list[str] = []
+        self.hits: list[str] = []
+
+    def lookup(self, node_id, summaries, template, metadata):
+        text = self.store.get(jl.node_key(summaries, template, metadata))
+        if text is not None:
+            self.hits.append(node_id)
+        return text
+
+    def record(self, node_id, summaries, template, metadata, text):
+        self.store[jl.node_key(summaries, template, metadata)] = text
+        self.computed.append(node_id)
+
+
+def _leaf_chunks(n: int) -> list[Chunk]:
+    return [Chunk(chunk_index=i, start_time=i * 60.0,
+                  end_time=(i + 1) * 60.0, speakers=["S"],
+                  summary=f"Summary {i}: findings about item {i}.")
+            for i in range(n)]
+
+
+def _stable_agg(cache_cfg=None):
+    cfg = cache_cfg or ReduceConfig(stable_tree=True,
+                                    max_summaries_per_batch=3,
+                                    max_tokens_per_batch=50,
+                                    reserve_tokens=0)
+    return ResultAggregator(
+        MapExecutor(MockEngine(), EngineConfig(temperature=0.0)), cfg)
+
+
+def test_stable_tree_append_invalidates_only_root_path():
+    """ISSUE 13 satellite regression: with content-derived node identity
+    and the stable tree, appending a leaf recomputes ONLY the batch it
+    lands in plus the root path — every sibling subtree answers from the
+    cache, and the result equals a cold run of the grown input."""
+    agg = _stable_agg()
+    cache = DictCache()
+    agg.aggregate(_leaf_chunks(12), node_cache=cache)
+    first_round = set(cache.computed)
+    assert len(first_round) == 7  # L1 x4, L2 x2, final
+    cache.computed, cache.hits = [], []
+
+    grown = agg.aggregate(_leaf_chunks(13), node_cache=cache)
+    # dirty: the new leaf's L1 batch, the L2 batch above it, the root
+    assert len(cache.computed) == 3, cache.computed
+    assert [n.split("@")[0] for n in cache.computed] == \
+        ["L1.B4", "L2.B1", "L3.final"]
+    # sibling subtrees reused — the poisoned-positional-key failure mode
+    assert {n.split("@")[0] for n in cache.hits} == \
+        {"L1.B0", "L1.B1", "L1.B2", "L1.B3", "L2.B0"}
+    cold = _stable_agg().aggregate(_leaf_chunks(13))
+    assert grown["final_summary"] == cold["final_summary"]
+
+
+def test_node_identity_is_content_derived():
+    a = content_node_id("L1.B0", ["x", "y"], "T")
+    assert a.startswith("L1.B0@")
+    assert a == content_node_id("L1.B0", ["x", "y"], "T")
+    assert a != content_node_id("L1.B0", ["x", "z"], "T")
+    # metadata is substituted into the prompt, so it is content too
+    assert a != content_node_id("L1.B0", ["x", "y"], "T", {"batch": "1/2"})
+    assert a.split("@")[1] == \
+        content_node_id("L9.B9", ["x", "y"], "T").split("@")[1]
+
+
+def test_stable_tree_single_pass_below_arity():
+    agg = _stable_agg()
+    out = agg.aggregate(_leaf_chunks(3))
+    assert out["hierarchical"] is False and out["levels"] == 1
+
+
+# --------------------------------------------------------------------------
+# session manager: incremental == cold, resume, classes, lifecycle
+# --------------------------------------------------------------------------
+
+
+def _live_cfg(**live_kw) -> PipelineConfig:
+    live = dict(class_default="bulk")
+    live.update(live_kw)
+    return PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=150, overlap_tokens=0,
+                          context_tokens=30, tokenizer="approx"),
+        engine=EngineConfig(backend="mock", temperature=0.0, seed=0,
+                            max_tokens=48, retry_delay=0.0),
+        reduce=ReduceConfig(max_summaries_per_batch=3),
+        live=LiveConfig(**live))
+
+
+def test_session_incremental_refresh_equals_cold(tmp_path):
+    """The acceptance identity: N appends + refreshes produce the same
+    greedy summary as a cold session fed the grown transcript at once,
+    while recomputing only the dirty tail chunks and root path."""
+    segs = make_segments(120, seed=3)
+    m1 = SessionManager(MockEngine(seed=0), tmp_path / "a",
+                        config=_live_cfg())
+    m1.create(session_id="inc")
+    last = None
+    for i in range(0, 120, 30):
+        last = m1.append("inc", segs[i:i + 30], refresh=True)["refresh"]
+    assert last["dirty_chunks"] < last["num_chunks"]
+    assert last["reduce_nodes_reused"] > 0
+    m2 = SessionManager(MockEngine(seed=0), tmp_path / "b",
+                        config=_live_cfg())
+    m2.create(session_id="cold")
+    cold = m2.append("cold", segs, refresh=True)["refresh"]
+    assert last["summary"] == cold["summary"]
+    # dirty fraction: the 30-segment append touched the tail, not the body
+    assert last["dirty_chunks"] <= cold["num_chunks"] // 2
+
+
+def test_session_restart_resumes_without_recompute(tmp_path):
+    """SIGKILL-shaped restart (graceful variant): a new manager over the
+    same live dir rehydrates segments, summaries, nodes, and the current
+    summary — and the next refresh recomputes NOTHING when nothing
+    changed."""
+    segs = make_segments(90, seed=7)
+    d = tmp_path / "live"
+    m1 = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    m1.create(session_id="s")
+    ref = m1.append("s", segs, refresh=True)["refresh"]
+    m1.shutdown()
+
+    m2 = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    assert m2.recover() == 1
+    doc = m2.summary_doc("s")
+    assert doc["summary"] == ref["summary"]
+    assert doc["staleness"]["stale"] is False
+    r = m2.refresh("s")
+    assert r["dirty_chunks"] == 0
+    assert r["reduce_nodes_computed"] == 0
+    assert r["summary"] == ref["summary"]
+    # append after resume: clean subtrees stay cached
+    r2 = m2.append("s", make_segments(20, seed=8), refresh=True)["refresh"]
+    assert r2["reduce_nodes_reused"] > 0
+    assert r2["dirty_chunks"] < r2["num_chunks"]
+
+
+def test_session_journal_replay_idempotent(tmp_path):
+    segs = make_segments(40, seed=9)
+    m = SessionManager(MockEngine(seed=0), tmp_path, config=_live_cfg())
+    m.create(session_id="s")
+    m.append("s", segs[:20], refresh=True)
+    m.append("s", segs[20:], refresh=True)
+    session = m.get("s")
+    records, meta = jl.replay(session.wal_path)
+    assert not meta["torn"] and not meta["corrupt"]
+    s1 = jl.canonical_json(
+        {k: v for k, v in rebuild_live_state(records).items()})
+    s2 = jl.canonical_json(
+        {k: v for k, v in rebuild_live_state(records + records).items()})
+    assert s1 == s2
+    kinds = {r.get("type") for r in records}
+    assert {REC_SEGMENTS, REC_SUMMARY, jl.REC_CHUNK, jl.REC_NODE} <= kinds
+
+
+def test_session_fingerprint_gate_keeps_transcript(tmp_path):
+    """A restart under a different prompt/chunking surface must NOT
+    rehydrate stale summaries — but the transcript itself (the part only
+    the WAL holds) always survives."""
+    segs = make_segments(60, seed=5)
+    d = tmp_path / "live"
+    m1 = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    m1.create(session_id="s")
+    m1.append("s", segs, refresh=True)
+    m1.shutdown()
+
+    changed = _live_cfg()
+    changed = PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=100, overlap_tokens=0,
+                          context_tokens=30, tokenizer="approx"),
+        engine=changed.engine, reduce=changed.reduce, live=changed.live)
+    m2 = SessionManager(MockEngine(seed=0), d, config=changed)
+    assert m2.recover() == 1
+    s = m2.get("s")
+    assert s.n_raw_segments == len(segs)          # transcript survived
+    assert s.summary is None                       # stale summary dropped
+    assert (d / "s.wal.stale").exists()
+    r = m2.refresh("s")
+    assert r["dirty_chunks"] == r["num_chunks"]    # full recompute
+    # and the recompute matches a cold run under the NEW surface
+    m3 = SessionManager(MockEngine(seed=0), tmp_path / "c", config=changed)
+    m3.create(session_id="cold")
+    assert r["summary"] == \
+        m3.append("cold", segs, refresh=True)["refresh"]["summary"]
+
+
+def test_session_auto_refresh_threshold(tmp_path):
+    """LMRS_LIVE_REFRESH_TOKENS semantics: appends auto-trigger a refresh
+    once the appended-but-unsummarized token estimate crosses the
+    threshold; below it they only mark the summary stale."""
+    segs = make_segments(60, seed=6)
+    m = SessionManager(MockEngine(seed=0), tmp_path,
+                       config=_live_cfg(refresh_tokens=400))
+    m.create(session_id="s")
+    doc = m.append("s", segs[:2])   # tiny: under the threshold
+    assert "refresh" not in doc
+    assert doc["staleness"]["pending_tokens"] > 0
+    doc = m.append("s", segs[2:40])  # crosses it
+    assert doc["refresh"]["auto"] is True
+    assert doc["staleness"]["pending_tokens"] == 0
+    # explicit refresh=False suppresses the auto trigger
+    doc = m.append("s", segs[40:], refresh=False)
+    assert "refresh" not in doc
+
+
+def test_session_deadline_classes(tmp_path):
+    """``interactive`` refreshes carry a real deadline budget end to end
+    (map + reduce requests shed/expire under PR 5's lifecycle);
+    ``bulk`` runs unbounded.  Failed chunks are NOT cached — the next
+    bulk refresh retries them and converges on the clean summary."""
+    segs = make_segments(60, seed=2)
+    m = SessionManager(MockEngine(seed=0, latency_s=0.03), tmp_path,
+                       config=_live_cfg(interactive_deadline_s=0.02))
+    m.create(session_id="s")
+    m.append("s", segs)
+    r_int = m.refresh("s", klass="interactive")
+    assert r_int["class"] == "interactive"
+    assert r_int["map_failed"] > 0 or r_int["reduce_errors"] > 0
+    r_bulk = m.refresh("s", klass="bulk")
+    assert r_bulk["map_failed"] == 0 and r_bulk["reduce_errors"] == 0
+    cold = SessionManager(MockEngine(seed=0), tmp_path / "c",
+                          config=_live_cfg())
+    cold.create(session_id="c")
+    assert r_bulk["summary"] == \
+        cold.append("c", segs, refresh=True)["refresh"]["summary"]
+    # a fully degraded refresh (final reduce = error marker) must never
+    # overwrite the good summary or clear the staleness that keeps the
+    # auto-refresh threshold armed
+    good = m.summary_doc("s")["summary"]
+    m.append("s", make_segments(10, seed=9))
+    r_deg = m.refresh("s", klass="interactive")
+    assert r_deg["final_error"] is True
+    doc = m.summary_doc("s")
+    assert doc["summary"] == good
+    assert doc["staleness"]["stale"] is True
+    with pytest.raises(ValueError):
+        m.refresh("s", klass="warp")
+
+
+def test_tail_chunk_grown_without_end_moving_recomputes(tmp_path):
+    """Identity edge: a zero-duration append grows the open tail chunk's
+    TEXT without moving its (index, start, end) key — the text-hash
+    component of the cache check must mark it dirty, or the stale
+    summary would rehydrate over the grown content."""
+    m = SessionManager(MockEngine(seed=0), tmp_path / "a",
+                       config=_live_cfg())
+    m.create(session_id="s")
+    base = [{"start": 0.0, "end": 10.0, "speaker": "A",
+             "text": "The roadmap review covered kernels."}]
+    grow = [{"start": 10.0, "end": 10.0, "speaker": "A",
+             "text": "Budget moved to serving."}]
+    m.append("s", base, refresh=True)
+    r = m.append("s", grow, refresh=True)["refresh"]
+    assert r["dirty_chunks"] >= 1  # the tail recomputed despite same key
+    cold = SessionManager(MockEngine(seed=0), tmp_path / "b",
+                          config=_live_cfg())
+    cold.create(session_id="c")
+    assert r["summary"] == \
+        cold.append("c", base + grow, refresh=True)["refresh"]["summary"]
+
+
+def test_append_validation_never_journals(tmp_path):
+    """A malformed batch 400s BEFORE anything reaches the WAL: replay
+    must never meet a record only a pre-validation build could write."""
+    d = tmp_path / "live"
+    m = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    m.create(session_id="s")
+    ref = m.append("s", make_segments(20, seed=0),
+                   refresh=True)["refresh"]
+    for bad in ([{"start": "abc", "end": 5.0, "text": "hi"}],
+                [{"start": 0.0, "end": float("nan"), "text": "hi"}],
+                [{"start": 9.0, "end": 1.0, "text": "hi"}],
+                [{"start": 0.0, "end": 1.0, "text": None}]):
+        with pytest.raises(ValueError):
+            m.append("s", bad)
+    assert m.get("s").append_seq == 1  # nothing journaled, seq unmoved
+    m.shutdown()
+    m2 = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    assert m2.recover() == 1
+    assert m2.summary_doc("s")["summary"] == ref["summary"]
+
+
+def test_recovered_staleness_counts_uncovered_batches_only(tmp_path):
+    """A restart between an append and its refresh must report the
+    staleness of THAT batch, not of the whole transcript (a whole-
+    transcript count would spuriously fire the auto-refresh threshold)."""
+    d = tmp_path / "live"
+    m1 = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    m1.create(session_id="s")
+    m1.append("s", make_segments(60, seed=1), refresh=True)
+    m1.append("s", make_segments(5, seed=2))  # appended, NOT summarized
+    pending_before = m1.get("s").stale_tokens
+    assert pending_before > 0
+    m1.shutdown()
+    m2 = SessionManager(MockEngine(seed=0), d, config=_live_cfg())
+    assert m2.recover() == 1
+    doc = m2.summary_doc("s")
+    assert doc["staleness"]["stale"] is True
+    assert doc["staleness"]["pending_tokens"] == pending_before
+
+
+def test_session_close_deletes(tmp_path):
+    m = SessionManager(MockEngine(seed=0), tmp_path, config=_live_cfg())
+    m.create(session_id="s")
+    m.append("s", make_segments(10, seed=0), refresh=True)
+    wal = m.get("s").wal_path
+    assert wal.exists()
+    assert m.close("s") is not None
+    assert not wal.exists()
+    assert m.get("s") is None
+    with pytest.raises(KeyError):
+        m.refresh("s")
+    assert m.close("nope") is None
+    # a fresh manager over the dir finds nothing to recover
+    m2 = SessionManager(MockEngine(seed=0), tmp_path, config=_live_cfg())
+    assert m2.recover() == 0
+
+
+def test_session_param_validation(tmp_path):
+    m = SessionManager(MockEngine(seed=0), tmp_path, config=_live_cfg())
+    with pytest.raises(ValueError):
+        m.create({"bogus_knob": 1})
+    with pytest.raises(ValueError):
+        m.create({"class": "warp"})
+    with pytest.raises(ValueError):
+        m.create(session_id="bad/../id")
+    m.create(session_id="ok")
+    with pytest.raises(ValueError):
+        m.append("ok", [{"start": 0}])  # malformed segment
+    with pytest.raises(KeyError):
+        m.append("missing", make_segments(2, seed=0))
+
+
+# --------------------------------------------------------------------------
+# SIGKILL chaos: resume with the rolling tree intact
+# --------------------------------------------------------------------------
+
+
+def _wait_for_wal(wal: Path, rec_type: str, n: int,
+                  deadline_s: float = 120.0) -> int:
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        if wal.exists():
+            recs, _ = jl.replay(wal)
+            have = sum(1 for r in recs if r.get("type") == rec_type)
+            if have >= n:
+                return have
+        time.sleep(0.02)
+    raise TimeoutError(f"never saw {n} {rec_type} records in {wal}")
+
+
+def test_sigkill_mid_refresh_resumes_token_identical(tmp_path):
+    """The ISSUE 13 chaos contract: SIGKILL a live-session process
+    mid-refresh (journal paced by an append-stall plan), resume the
+    journal in a new manager, and the next refresh is token-identical to
+    an uninterrupted run — with the clean subtrees answered from the
+    journal, not recomputed."""
+    segs = lw.live_segments(60)
+    batches = [segs[:40], segs[40:]]
+
+    # uninterrupted reference in its own dir
+    ref_mgr = lw.build_manager(str(tmp_path / "ref"))
+    ref_mgr.create(session_id="live")
+    ref = None
+    for b in batches:
+        ref = ref_mgr.append("live", b, refresh=True)["refresh"]
+
+    live_dir = tmp_path / "live"
+    live_dir.mkdir()
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"live_dir": str(live_dir),
+                                "session_id": "live",
+                                "batches": batches}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LMRS_FAULT_PLAN=json.dumps({"faults": [
+                   {"site": "journal.append", "every": 1,
+                    "action": "stall", "stall_s": 0.1}]}))
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "_live_worker.py"),
+         str(spec)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    wal = live_dir / "live.wal"
+    try:
+        # phase 1 summary lands, then kill inside phase 2's map stream:
+        # after the first summary_done, wait for fresh chunk records
+        _wait_for_wal(wal, REC_SUMMARY, 1)
+        recs, _ = jl.replay(wal)
+        chunks_at_p1 = sum(1 for r in recs if r.get("type") == jl.REC_CHUNK)
+        _wait_for_wal(wal, jl.REC_CHUNK, chunks_at_p1 + 1)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    state = rebuild_live_state(jl.replay(wal)[0])
+    assert (state["summary"] or {}).get("seq") == 1, \
+        "kill landed after the second refresh completed"
+
+    mgr = lw.build_manager(str(live_dir))
+    assert mgr.recover() == 1
+    s = mgr.get("live")
+    assert s.recovered and s.n_raw_segments == len(segs)
+    r = mgr.refresh("live")
+    assert r["summary"] == ref["summary"], "resume diverged from control"
+    # the rolling tree survived: phase-1 subtrees answered from the
+    # journal (strictly fewer recomputes than a cold run of everything)
+    assert r["chunk_summaries_reused"] > 0
+    assert r["reduce_nodes_reused"] > 0
+    assert r["dirty_chunks"] < r["num_chunks"]
+
+
+# --------------------------------------------------------------------------
+# fuzz: interleaved append/refresh/close, auditor clean
+# --------------------------------------------------------------------------
+
+
+def test_fuzz_append_refresh_close_mock(tmp_path):
+    """Seeded interleave over two sessions on one manager: appends of
+    random size, refreshes under random classes, closes/recreates — the
+    journal must replay idempotently after every wave and the surviving
+    session's final summary must equal a cold rebuild."""
+    rng = random.Random(0xC0FFEE)
+    cfg = _live_cfg()
+    m = SessionManager(MockEngine(seed=0), tmp_path / "live", config=cfg)
+    stream: dict[str, list] = {"a": [], "b": []}
+    m.create(session_id="a")
+    m.create(session_id="b")
+    pool = make_segments(400, seed=12)
+    cursor = 0
+    for _ in range(40):
+        sid = rng.choice(("a", "b"))
+        op = rng.random()
+        if op < 0.55 and cursor < len(pool):
+            k = rng.randrange(1, 9)
+            batch = pool[cursor:cursor + k]
+            cursor += k
+            m.append(sid, batch)
+            stream[sid].extend(batch)
+        elif op < 0.85:
+            if stream[sid]:
+                m.refresh(sid, klass=rng.choice(("interactive", "bulk")))
+        else:
+            m.close(sid)
+            stream[sid] = []
+            m.create(session_id=sid)
+        session = m.get(sid)
+        records, meta = jl.replay(session.wal_path)
+        assert not meta["corrupt"]
+        s1 = jl.canonical_json(rebuild_live_state(records))
+        s2 = jl.canonical_json(rebuild_live_state(records + records))
+        assert s1 == s2
+    for sid in ("a", "b"):
+        if not stream[sid]:
+            continue
+        final = m.refresh(sid, klass="bulk")
+        cold = SessionManager(MockEngine(seed=0), tmp_path / f"cold-{sid}",
+                              config=cfg)
+        cold.create(session_id="c")
+        expect = cold.append("c", stream[sid], refresh=True)["refresh"]
+        assert final["summary"] == expect["summary"]
+
+
+def test_close_during_refresh_cancels_cleanly(tmp_path):
+    """A DELETE racing a slow refresh: the refresh aborts through the
+    executor cancel hooks, close() wins, and the manager stays usable."""
+    m = SessionManager(MockEngine(seed=0, latency_s=0.05), tmp_path,
+                       config=_live_cfg())
+    m.create(session_id="s")
+    m.append("s", make_segments(40, seed=1))
+    out: dict = {}
+
+    def do_refresh():
+        try:
+            out["r"] = m.refresh("s", klass="bulk")
+        except KeyError:
+            out["r"] = {"cancelled": True}
+
+    t = threading.Thread(target=do_refresh)
+    t.start()
+    time.sleep(0.08)  # inside the map stream
+    m.close("s")
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert m.get("s") is None
+    # cancelled refreshes report so (or completed just before the close)
+    assert "r" in out
+    m.create(session_id="s")  # id reusable after close
+    assert m.get("s") is not None
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_live_waves_scheduler_audit_clean(tmp_path, seed):
+    """The jax arm (ISSUE 13 satellite): interleaved append/refresh
+    waves through a REAL continuous scheduler — after every refresh the
+    scheduler's invariant auditor (page conservation, refcount balance,
+    radix structure) must be clean.  Token identity is asserted on the
+    mock arm only: a content-free random-init argmax is knife-edge under
+    partial recompute on a differently-warmed engine (the PR 7 chaos
+    rationale)."""
+    from lmrs_tpu.config import ModelConfig
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    model = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                        dtype="float32")
+    eng = JaxEngine(
+        EngineConfig(backend="jax", scheduler="continuous", max_tokens=48,
+                     temperature=0.0, max_batch_slots=2, seed=0,
+                     decode_block=4, page_size=16, num_pages=48),
+        model)
+    cfg = PipelineConfig(
+        chunk=ChunkConfig(max_tokens_per_chunk=120, overlap_tokens=0,
+                          context_tokens=30, tokenizer="approx"),
+        engine=EngineConfig(backend="jax", temperature=0.0, seed=0,
+                            max_tokens=16, retry_delay=0.0),
+        reduce=ReduceConfig(max_summaries_per_batch=3,
+                            max_tokens_per_batch=12, reserve_tokens=0),
+        live=LiveConfig(class_default="bulk"))
+    try:
+        m = SessionManager(eng, tmp_path, config=cfg)
+        m.create(session_id="s")
+        rng = random.Random(seed)
+        pool = lw.live_segments(36, seed=20 + seed)
+        cursor = 0
+        refreshes = 0
+        while cursor < len(pool):
+            k = rng.randrange(4, 12)
+            m.append("s", pool[cursor:cursor + k])
+            cursor += k
+            r = m.refresh("s", klass=rng.choice(("interactive", "bulk")))
+            refreshes += 1
+            assert eng._scheduler.audit() == [], "auditor dirty after wave"
+            assert r["num_chunks"] > 0
+        assert refreshes >= 3
+        # resume path against the same engine: audit stays clean
+        m2 = SessionManager(eng, tmp_path, config=cfg)
+        assert m2.recover() == 1
+        r = m2.refresh("s", klass="bulk")
+        assert eng._scheduler.audit() == []
+        assert r["dirty_chunks"] == 0 or r["summary"]
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------------------------
+# serving surface: /v1/sessions*, restart, router stickiness
+# --------------------------------------------------------------------------
+
+
+def _call(port, method, path, body=None, host="127.0.0.1"):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request(method, path,
+                 body=None if body is None else json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    data = json.loads(r.read())
+    conn.close()
+    return r.status, data
+
+
+def test_http_session_lifecycle(tmp_path):
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(MockEngine(seed=0), port=0,
+                           batch_window_s=0.01,
+                           live_dir=str(tmp_path / "live"),
+                           pipeline_config=_live_cfg())
+    srv.start_background()
+    segs = make_segments(60, seed=5)
+    try:
+        p = srv.port
+        st, doc = _call(p, "POST", "/v1/sessions",
+                        {"session_id": "abc", "params": {"class": "bulk"}})
+        assert st == 200 and doc["id"] == "abc"
+        # idempotent re-create
+        st, doc = _call(p, "POST", "/v1/sessions", {"session_id": "abc"})
+        assert st == 200 and doc["id"] == "abc"
+        st, doc = _call(p, "POST", "/v1/sessions/abc/segments",
+                        {"segments": segs[:30], "refresh": True})
+        assert st == 200 and doc["refresh"]["summary"]
+        st, doc = _call(p, "GET", "/v1/sessions/abc/summary")
+        assert st == 200 and doc["summary"]
+        assert doc["staleness"]["stale"] is False
+        st, doc = _call(p, "POST", "/v1/sessions/abc/segments",
+                        {"segments": segs[30:]})
+        assert st == 200 and "refresh" not in doc
+        st, doc = _call(p, "GET", "/v1/sessions/abc/summary")
+        assert doc["staleness"]["stale"] is True
+        st, doc = _call(p, "GET", "/v1/sessions/abc/summary?refresh=1")
+        assert doc["staleness"]["stale"] is False
+        st, doc = _call(p, "POST", "/v1/sessions/abc/refresh",
+                        {"class": "bulk"})
+        assert st == 200 and doc["dirty_chunks"] == 0
+        st, doc = _call(p, "GET", "/v1/sessions")
+        assert st == 200 and len(doc["data"]) == 1
+        st, doc = _call(p, "GET", "/v1/sessions/abc")
+        assert st == 200 and doc["num_chunks"] > 0
+        # error surfaces
+        st, doc = _call(p, "GET", "/v1/sessions/nope")
+        assert st == 404
+        st, doc = _call(p, "POST", "/v1/sessions",
+                        {"params": {"bogus": 1}})
+        assert st == 400
+        st, doc = _call(p, "POST", "/v1/sessions/abc/segments",
+                        {"segments": "no"})
+        assert st == 400
+        # metrics exposure
+        st, doc = _call(p, "GET", "/metrics")
+        assert doc["live"]["sessions"] == 1
+        import urllib.request
+
+        req = urllib.request.Request(f"http://127.0.0.1:{p}/metrics",
+                                     headers={"Accept": "text/plain"})
+        text = urllib.request.urlopen(req, timeout=10).read().decode()
+        assert "lmrs_live_sessions_active 1" in text
+        assert "lmrs_live_refreshes_total" in text
+        st, doc = _call(p, "DELETE", "/v1/sessions/abc")
+        assert st == 200 and doc["status"] == "closed"
+        st, doc = _call(p, "GET", "/v1/sessions/abc")
+        assert st == 404
+    finally:
+        srv.shutdown()
+
+
+def test_http_session_api_disabled_501():
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(MockEngine(seed=0), port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        st, doc = _call(srv.port, "POST", "/v1/sessions", {})
+        assert st == 501 and "live-dir" in doc["error"]["message"]
+    finally:
+        srv.shutdown()
+
+
+def test_http_session_survives_server_restart(tmp_path):
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    d = str(tmp_path / "live")
+    segs = make_segments(50, seed=8)
+    srv = EngineHTTPServer(MockEngine(seed=0), port=0, batch_window_s=0.01,
+                           live_dir=d, pipeline_config=_live_cfg())
+    srv.start_background()
+    try:
+        st, _ = _call(srv.port, "POST", "/v1/sessions",
+                      {"session_id": "s"})
+        st, doc = _call(srv.port, "POST", "/v1/sessions/s/segments",
+                        {"segments": segs, "refresh": True})
+        summary = doc["refresh"]["summary"]
+    finally:
+        srv.shutdown()
+    srv2 = EngineHTTPServer(MockEngine(seed=0), port=0, batch_window_s=0.01,
+                            live_dir=d, pipeline_config=_live_cfg())
+    srv2.start_background()
+    try:
+        st, doc = _call(srv2.port, "GET", "/v1/sessions/s/summary")
+        assert st == 200 and doc["summary"] == summary
+        assert doc["staleness"]["stale"] is False
+        st, doc = _call(srv2.port, "GET", "/v1/sessions/s")
+        assert doc["recovered"] is True
+    finally:
+        srv2.shutdown()
+
+
+def test_router_sessions_sticky_and_rescan(tmp_path):
+    """Fleet deployments: the front router-backed server has no local
+    SessionManager — /v1/sessions* forwards sticky by session id (the
+    journal AND the warm prefix tree live on one backend), and a fresh
+    router re-locates sessions by fleet scan."""
+    from lmrs_tpu.serving.router import RouterEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    segs = make_segments(60, seed=5)
+    backends = [
+        EngineHTTPServer(MockEngine(seed=0), port=0, batch_window_s=0.01,
+                         live_dir=str(tmp_path / f"b{i}"),
+                         pipeline_config=_live_cfg())
+        for i in range(2)]
+    for b in backends:
+        b.start_background()
+    hosts = [f"127.0.0.1:{b.port}" for b in backends]
+    router = RouterEngine(hosts)
+    front = EngineHTTPServer(router, port=0, batch_window_s=0.01)
+    front.start_background()
+    try:
+        p = front.port
+        st, doc = _call(p, "POST", "/v1/sessions", {"session_id": "r1"})
+        assert st == 200 and doc["id"] == "r1"
+        st, doc = _call(p, "POST", "/v1/sessions/r1/segments",
+                        {"segments": segs[:30], "refresh": True})
+        assert st == 200 and doc["refresh"]["summary"]
+        st, doc = _call(p, "POST", "/v1/sessions/r1/segments",
+                        {"segments": segs[30:], "refresh": True})
+        summary = doc["refresh"]["summary"]
+        # exactly one backend owns it (journal + warm tree colocated)
+        statuses = sorted(_call(b.port, "GET", "/v1/sessions/r1")[0]
+                          for b in backends)
+        assert statuses == [200, 404]
+        # a second session with another id may land anywhere, but stays
+        # pinned wherever it landed
+        st, doc = _call(p, "POST", "/v1/sessions", {"session_id": "r2"})
+        st, doc = _call(p, "POST", "/v1/sessions/r2/segments",
+                        {"segments": segs[:10], "refresh": True})
+        assert st == 200
+        # fresh router (restart): unknown id re-locates by fleet scan
+        router2 = RouterEngine(hosts)
+        st, doc = router2.session_request(
+            "GET", "/v1/sessions/r1/summary", None)
+        assert st == 200 and doc["summary"] == summary
+        st, doc = router2.session_request("GET", "/v1/sessions", None)
+        assert {d["id"] for d in doc["data"]} == {"r1", "r2"}
+        st, doc = router2.session_request(
+            "GET", "/v1/sessions/missing/summary", None)
+        assert st == 404
+        # create-retry convergence: a router with a DIFFERENT fleet view
+        # re-creating an existing id must land on the backend that holds
+        # it (the existing journal wins), never fork a second journal
+        router3 = RouterEngine(list(reversed(hosts)))
+        st, doc = router3.session_request(
+            "POST", "/v1/sessions", {"session_id": "r1"})
+        assert st == 200 and doc["num_segments"] > 0  # the EXISTING one
+        statuses = sorted(_call(b.port, "GET", "/v1/sessions/r1")[0]
+                          for b in backends)
+        assert statuses == [200, 404], "create retry forked the session"
+        router3.shutdown()
+        router2.shutdown()
+        st, doc = _call(p, "DELETE", "/v1/sessions/r1")
+        assert st == 200
+        st, doc = _call(p, "GET", "/v1/sessions/r1")
+        assert st == 404
+    finally:
+        front.shutdown()
+        router.shutdown()
+        for b in backends:
+            b.shutdown()
