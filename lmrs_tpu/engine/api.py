@@ -129,15 +129,18 @@ class GenerationResult:
     text: str = ""
     prompt_tokens: int = 0
     completion_tokens: int = 0
-    # stop | length | error | cancelled | deadline | shed | handoff —
-    # deadline/shed are deadline-lifecycle terminals
+    # stop | length | error | cancelled | deadline | shed | wedged |
+    # handoff — deadline/shed are deadline-lifecycle terminals
     # (api.GenerationRequest.deadline_s): "deadline" expired in flight
     # (partial text kept), "shed" rejected at admission before any engine
     # work.  "handoff" is NOT client-terminal: the request stopped after
     # its first token with KV pages pinned for export (handoff_export);
     # only the serving layer ever sees it — it turns the result into a
     # handoff ticket, and the decode pod's continuation is the real
-    # completion.  Engine-side neither sets
+    # completion.  "wedged" (docs/ROBUSTNESS.md § Hang survival) is the
+    # watchdog's terminal for a request abandoned inside a wedged
+    # dispatch: it always carries ``error`` so the executor's retry
+    # machinery re-dispatches it.  Engine-side neither sets
     # ``error`` (they are outcomes the caller asked for, not faults to
     # retry); the one exception is the executor's retry clip, which marks
     # a request that FAILED and then ran out of budget to retry with both

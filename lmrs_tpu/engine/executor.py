@@ -513,6 +513,23 @@ class MapExecutor:
                 on_final(GenerationResult(request_id=rid, finish_reason="error",
                                           error=msg),
                          lambda new_reqs: None)
+        else:
+            # a WEDGED engine (watchdog, docs/ROBUSTNESS.md § Hang
+            # survival) returns synthesized terminals whose retry clones
+            # the dead run can no longer accept — wrapper's submit
+            # dropped them, leaving their rids without a final.  The
+            # stream must never end with a silent hole: deliver the
+            # exhaustion now (degrade-and-continue, same contract as the
+            # except branch).
+            for rid in [r for r in by_id if r not in finals]:
+                self.total_requests += 1
+                self.failed_requests += 1
+                finals.add(rid)
+                on_final(GenerationResult(
+                    request_id=rid, finish_reason="error",
+                    error="engine stream ended before a retry could run "
+                          "(wedged/degraded engine)"),
+                    lambda new_reqs: None)
         finally:
             with self._cancel_lock:
                 self._run_live = False

@@ -170,6 +170,7 @@ class JaxEngine:
         self._key = jax.random.PRNGKey(engine_cfg.seed + 1)
         self._gen_fns: dict[tuple, object] = {}  # (B, S_bucket, max_new) -> jitted
         self._scheduler = None
+        self._runner = None
         self.schedules_internally = False
         if engine_cfg.scheduler == "continuous":
             from lmrs_tpu.engine.scheduler import ContinuousScheduler
@@ -180,6 +181,18 @@ class JaxEngine:
             )
             # slot + page admission control replaces the executor's wave cap
             self.schedules_internally = True
+            # Hang survival (engine/watchdog.py): with the watchdog armed
+            # (LMRS_WATCHDOG, default on) dispatch moves onto a daemon
+            # runner thread and the caller thread watches the scheduler's
+            # heartbeat — a wedged chip becomes bounded wedged/deadline
+            # results + a degraded fail-fast engine instead of a silent
+            # freeze.  LMRS_WATCHDOG=0 leaves _runner None: run() executes
+            # inline on the caller thread, byte-for-byte the pre-watchdog
+            # dispatch path.
+            if self._scheduler.watchdog is not None:
+                from lmrs_tpu.engine.watchdog import WatchdogRunner
+
+                self._runner = WatchdogRunner(self._scheduler)
 
     # -------------------------------------------------------------- plumbing
 
@@ -211,7 +224,17 @@ class JaxEngine:
         return jax.device_put(params)
 
     def shutdown(self) -> None:
+        if self._runner is not None:
+            self._runner.shutdown()
         self._gen_fns.clear()
+
+    def wedged(self) -> bool:
+        """Optional Engine hook (getattr convention): True while a wedged
+        dispatch still holds the runner thread — the engine is degraded
+        fail-fast.  The serving layer surfaces it through /healthz (503)
+        so the supervisor (serving/supervisor.py) can bounce the
+        process."""
+        return self._runner is not None and self._runner.wedged
 
     def cancel(self, request_id: int) -> None:
         """Abort a request in the current generate_batch call (Engine
@@ -289,6 +312,9 @@ class JaxEngine:
 
         faults.fire("engine.batch")
         if self._scheduler is not None:
+            if self._runner is not None:
+                return self._runner.run(requests, on_result=on_result,
+                                        on_tokens=on_tokens)
             return self._scheduler.run(requests, on_result=on_result,
                                        on_tokens=on_tokens)
         if on_tokens is not None:

@@ -22,7 +22,7 @@ and overlaps; the GIL only serializes Python-side batch assembly).
 from __future__ import annotations
 
 import logging
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import replace
 
 import jax
@@ -30,6 +30,9 @@ import jax
 from lmrs_tpu.config import EngineConfig, MeshConfig, ModelConfig
 from lmrs_tpu.engine.api import GenerationRequest, GenerationResult
 from lmrs_tpu.engine.jax_engine import needs_host_quant_init
+from lmrs_tpu.engine.watchdog import DaemonExecutor
+from lmrs_tpu.testing import faults
+from lmrs_tpu.utils.env import env_bool, env_float
 
 logger = logging.getLogger("lmrs.replicated")
 
@@ -99,8 +102,10 @@ class ReplicatedEngine:
         # not thread-safe, so everything aimed at it — construction, user
         # shards, health probes — funnels through its own queue and can
         # never run concurrently, while distinct replicas run in parallel.
-        self._pools = [ThreadPoolExecutor(max_workers=1,
-                                          thread_name_prefix=f"lmrs-dp{i}")
+        # DAEMON workers (engine/watchdog.py): a wedged shard or probe
+        # future must never pin interpreter exit, and a quarantined
+        # replica's stuck pool can simply be abandoned and replaced.
+        self._pools = [DaemonExecutor(thread_name=f"lmrs-dp{i}")
                        for i in range(dp)]
 
         def build(i: int) -> JaxEngine:
@@ -138,7 +143,20 @@ class ReplicatedEngine:
             if not fut.done():
                 continue
             del self._probes[ri]
-            if fut.exception() is None:
+            # cancelled() FIRST: a probe queued behind a quarantined
+            # shard is cancelled by the pool teardown, and exception()
+            # on a cancelled future RAISES CancelledError (a
+            # BaseException no degrade path catches) instead of
+            # returning it
+            if fut.cancelled() or fut.exception() is not None:
+                results = None
+            else:
+                results = fut.result()
+            # a degraded (wedged) engine fail-fasts its probe as a RESULT
+            # carrying an error, not an exception — both mean "still down"
+            ok = results is not None and all(r.error is None
+                                             for r in results)
+            if ok:
                 self._healthy[ri] = True
                 logger.info("replica %d probe succeeded: re-admitted", ri)
             else:
@@ -167,6 +185,56 @@ class ReplicatedEngine:
                 requests, on_result)
         return self._generate_wave(requests, on_tokens=on_tokens)
 
+    def _shard_timeout_s(self) -> float | None:
+        """Per-shard bound on the wave wait (straggler containment).
+        None (untimed — the pre-watchdog behavior) when the hang-survival
+        tier is killed via ``LMRS_WATCHDOG=0``; the timeout may only be
+        armed WITH the member engines' watchdogs, whose fail-fast runner
+        is what makes submitting to a quarantined replica's fresh pool
+        safe (the abandoned worker can still be inside generate_batch —
+        the runner refuses to touch the wedged scheduler concurrently)."""
+        if not env_bool("LMRS_WATCHDOG", True):
+            return None
+        return env_float("LMRS_SHARD_TIMEOUT_S", 600.0, lo=1.0)
+
+    def _shard_wait_s(self, ri: int, timeout: float | None) -> float | None:
+        """Effective wait bound for one replica's shard: a member engine
+        that has never completed a warm step (no step-time EMA yet) is
+        still COLD-compiling, and a first-dispatch XLA compile can
+        legitimately outlast LMRS_SHARD_TIMEOUT_S — extend to the
+        watchdog's compile grace instead of quarantining healthy
+        hardware mid-compile (the member watchdog itself graces compiles
+        the same way)."""
+        if timeout is None:
+            return None
+        wd = getattr(getattr(self.replicas[ri], "_scheduler", None),
+                     "watchdog", None)
+        if wd is not None and wd.ema_step_s is None:
+            from lmrs_tpu.engine.watchdog import COLD_COMPILE_GRACE_S
+
+            return max(timeout, COLD_COMPILE_GRACE_S)
+        return timeout
+
+    def _quarantine(self, ri: int, why: str) -> None:
+        """A shard wedged: mark the replica unhealthy and ABANDON its
+        worker pool (daemon thread — it can never pin interpreter exit).
+        The fresh pool keeps probes and later waves from queueing behind
+        the stuck call; re-admission goes through the existing probe
+        loop once the replica answers again."""
+        logger.error("replica %d quarantined: %s", ri, why)
+        self._healthy[ri] = False
+        self._pools[ri].shutdown(wait=False, cancel_futures=True)
+        self._pools[ri] = DaemonExecutor(thread_name=f"lmrs-dp{ri}r")
+
+    def _run_shard(self, replica, shard, on_tokens):
+        # injection site (hang survival): a "stall" plan here wedges this
+        # shard's worker thread the way a hung replica chip would —
+        # exercising the bounded wait + quarantine + re-dispatch path;
+        # "raise" takes the existing replica-fault path
+        faults.fire("replicated.shard")
+        return replica.generate_batch([req for _, req in shard],
+                                      on_tokens=on_tokens)
+
     def _generate_wave(self, requests: list[GenerationRequest],
                        on_tokens=None) -> list[GenerationResult]:
         # route over healthy replicas only; if every replica is marked dead,
@@ -183,28 +251,107 @@ class ReplicatedEngine:
         for i, req in enumerate(requests):
             shards[i % len(targets)].append((i, req))
 
-        def run(replica, shard):
-            return replica.generate_batch([req for _, req in shard],
-                                          on_tokens=on_tokens)
-
         futures = [
-            (ri, shard, self._pools[ri].submit(run, self.replicas[ri], shard))
+            (ri, shard, self._pools[ri].submit(self._run_shard,
+                                               self.replicas[ri], shard,
+                                               on_tokens))
             for ri, shard in zip(targets, shards) if shard
         ]
         self._launch_probes()  # concurrent with the wave, on unhealthy replicas
         out: list[GenerationResult | None] = [None] * len(requests)
+        timeout = self._shard_timeout_s()
+        # straggler containment: shard entries whose replica wedged (stuck
+        # future OR watchdog-wedged results), re-dispatched below onto the
+        # replicas that survived this wave — greedy outputs are
+        # replica-invariant (identical weights), so the re-dispatch is
+        # token-identical to a healthy first placement
+        redispatch: list[tuple[int, GenerationRequest]] = []
+        survivors: list[int] = []
         for ri, shard, fut in futures:
+            wait_s = self._shard_wait_s(ri, timeout)
             try:
-                # blocking wait, no timeout: a shard that WEDGES inside a
-                # device call can't be abandoned anyway (the worker thread
-                # would stay stuck and hang interpreter exit) — a hung chip
-                # is a process-level fault handled by slice restart, while
-                # this health layer handles the faults JAX surfaces as
-                # exceptions, which it raises promptly
-                results = fut.result()
-                self._healthy[ri] = True
+                # bounded wait (timeout=None restores the untimed
+                # pre-watchdog wait; cold-compiling members get the
+                # compile grace): a shard that WEDGES inside a device
+                # call is abandoned with its daemon worker — quarantined,
+                # its requests re-dispatched — instead of stalling the
+                # whole wave forever
+                results = fut.result(timeout=wait_s)
+            except FutureTimeout:
+                self._quarantine(
+                    ri, f"shard produced no result within {wait_s:.1f}s "
+                        f"({len(shard)} request(s) re-dispatched)")
+                redispatch.extend(shard)
+                continue
             except Exception as e:  # degrade-and-continue per replica
                 logger.exception("replica %d batch failure: marked unhealthy", ri)
+                self._healthy[ri] = False
+                for (pos, _), res in zip(shard, [
+                    GenerationResult(request_id=req.request_id,
+                                     finish_reason="error",
+                                     error=str(e) or type(e).__name__)
+                        for _, req in shard]):
+                    out[pos] = res
+                continue
+            # the member engine's own watchdog may have declared the wedge
+            # first (fail-fast wedged results instead of a stuck future):
+            # same containment — route the wedged requests elsewhere
+            wedged = [ent for ent, res in zip(shard, results)
+                      if res.finish_reason == "wedged"]
+            if wedged:
+                self._healthy[ri] = False
+                logger.warning("replica %d returned %d wedged result(s): "
+                               "re-dispatching to healthy replicas",
+                               ri, len(wedged))
+                redispatch.extend(wedged)
+                for ent, res in zip(shard, results):
+                    if res.finish_reason != "wedged":
+                        out[ent[0]] = res
+                continue
+            self._healthy[ri] = True
+            survivors.append(ri)
+            for (pos, _), res in zip(shard, results):
+                out[pos] = res
+        if redispatch:
+            self._redispatch(redispatch, survivors, out, on_tokens, timeout)
+        return [r for r in out if r is not None]
+
+    def _redispatch(self, entries, survivors, out, on_tokens,
+                    timeout) -> None:
+        """One containment retry wave: the wedged shards' requests run on
+        the replicas that answered this wave (all currently-healthy ones
+        when none did).  A request that wedges or fails AGAIN terminates
+        wedged/error — the executor's retry budget owns anything
+        further."""
+        targets = survivors or [i for i, ok in enumerate(self._healthy)
+                                if ok] or list(range(len(self.replicas)))
+        shards: list[list[tuple[int, GenerationRequest]]] = [
+            [] for _ in targets]
+        for k, ent in enumerate(entries):
+            shards[k % len(targets)].append(ent)
+        futures = [
+            (ri, shard, self._pools[ri].submit(self._run_shard,
+                                               self.replicas[ri], shard,
+                                               on_tokens))
+            for ri, shard in zip(targets, shards) if shard
+        ]
+        for ri, shard, fut in futures:
+            wait_s = self._shard_wait_s(ri, timeout)
+            try:
+                results = fut.result(timeout=wait_s)
+            except FutureTimeout:
+                self._quarantine(
+                    ri, f"re-dispatched shard wedged again within "
+                        f"{wait_s:.1f}s")
+                results = [
+                    GenerationResult(request_id=req.request_id,
+                                     finish_reason="wedged",
+                                     error="re-dispatched shard wedged "
+                                           "again")
+                    for _, req in shard
+                ]
+            except Exception as e:  # noqa: BLE001 - degrade per replica
+                logger.exception("replica %d re-dispatch failure", ri)
                 self._healthy[ri] = False
                 results = [
                     GenerationResult(request_id=req.request_id,
@@ -214,7 +361,6 @@ class ReplicatedEngine:
                 ]
             for (pos, _), res in zip(shard, results):
                 out[pos] = res
-        return [r for r in out if r is not None]
 
     def cancel(self, request_id: int) -> None:
         """Engine optional abort hook: forward to every replica — request
@@ -228,8 +374,9 @@ class ReplicatedEngine:
         for replica in self.replicas:
             replica.shutdown()
         for pool in self._pools:
-            # cancel_futures: a wedged probe future would otherwise pin a
-            # non-daemon worker thread at interpreter exit
+            # daemon workers (DaemonExecutor): even a wedged shard or
+            # probe future can never pin interpreter exit; cancel_futures
+            # just drops anything still queued
             pool.shutdown(wait=False, cancel_futures=True)
 
     def engine_metrics(self) -> dict:

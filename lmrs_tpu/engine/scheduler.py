@@ -532,6 +532,24 @@ class ContinuousScheduler:
         # per process into LMRS_PROFILE_DIR — the "why was that step
         # slow" hook that needs no redeploy
         self._slow_step_fired = False
+        # Hang survival (engine/watchdog.py): the dispatch loop stamps a
+        # monotonic heartbeat each iteration; JaxEngine's WatchdogRunner
+        # watches it and declares a wedge when no progress lands within
+        # the threshold.  LMRS_WATCHDOG=0 removes the watchdog entirely —
+        # run() then executes inline on the caller thread, byte-for-byte
+        # today's dispatch path (the acceptance A/B).
+        self.watchdog = None
+        if env_bool("LMRS_WATCHDOG", True):
+            from lmrs_tpu.engine.watchdog import DispatchWatchdog
+
+            self.watchdog = DispatchWatchdog()
+        self._c_watchdog_fires = c("lmrs_watchdog_fires_total",
+                                   "dispatch wedges declared by the "
+                                   "watchdog (run abandoned, engine "
+                                   "degraded fail-fast)")
+        self._c_wedged = c("lmrs_wedged_requests_total",
+                           "requests terminated finish_reason=\"wedged\" "
+                           "by the watchdog sweep")
 
     @property
     def metrics(self) -> dict:
@@ -569,6 +587,8 @@ class ContinuousScheduler:
             "mixed_dispatches": int(self._h_mixed_fill.count),
             "mixed_fill_sum": self._h_mixed_fill.sum,
             "prefill_tokens_piggybacked": int(self._c_piggybacked.value),
+            "watchdog_fires": int(self._c_watchdog_fires.value),
+            "wedged_requests": int(self._c_wedged.value),
         }
 
     def metrics_registry(self) -> MetricsRegistry:
@@ -621,6 +641,23 @@ class ContinuousScheduler:
         logger.warning("slow decode block (%.3fs > %.3fs threshold): "
                        "profiler capture %s (%s)", wall_s, thresh,
                        "started" if ok else "NOT started", msg)
+
+    def _wd_grace_cold(self) -> None:
+        """The next dispatch compiles a new shape: open the watchdog's
+        one-shot compile grace window so a legitimate multi-second (or
+        multi-minute) XLA compile can never read as a wedge.  Call sites
+        are exactly the existing cold-shape checks (``_ran_ok``)."""
+        if self.watchdog is not None:
+            self.watchdog.grace_cold()
+
+    def _note_ran_ok(self, key) -> None:
+        """Mark a dispatch shape proven AND close the cold-compile grace
+        window it opened: the compile is done, so the wedge detector
+        re-arms immediately — a stall in the same iteration (or the next
+        loop-top heartbeat) must still be caught."""
+        self._ran_ok.add(key)
+        if self.watchdog is not None:
+            self.watchdog.grace_end()
 
     def _timed_get(self, x):
         """``jax.device_get`` with the blocking wait charged to the
@@ -1072,12 +1109,23 @@ class ContinuousScheduler:
                 self._g_peak_slots.track_max(
                     sum(s is not None for s in slots))
 
+        wd = self.watchdog
+        if wd is not None:
+            wd.run_started()
         try:
             while True:
                 # injection site: a fired plan fails this scheduler
                 # iteration the way a bad dispatch would — exercising the
                 # pool-recovery path in the except below
                 faults.fire("scheduler.step")
+                # injection site + heartbeat (hang survival, engine/
+                # watchdog.py): a "stall" plan here wedges the loop the
+                # way a hung chip would — no beat lands, the watchdog
+                # declares the wedge.  With LMRS_WATCHDOG=0 the same
+                # stall simply hangs the run (today's behavior).
+                faults.fire("scheduler.heartbeat")
+                if wd is not None:
+                    wd.beat()
                 # sweep cancellations first (block boundary): their results are
                 # then delivered with this iteration's fresh batch
                 if self._cancelled:
@@ -1347,6 +1395,8 @@ class ContinuousScheduler:
             # clamped (same reason as _timed_get) — doubly important here:
             # this runs in a finally, where a raise would mask the real error
             self._c_run_seconds.inc(max(0.0, time.time() - t_run))
+            if wd is not None:
+                wd.run_ended()
             self._on_tokens = None
             self._streamed = {}
             self._cancelled.clear()
@@ -2615,6 +2665,8 @@ class ContinuousScheduler:
                 jnp.asarray(top_k), jnp.asarray(top_p))
         key_ = ("mixed", T, w)
         warm = key_ in self._ran_ok
+        if not warm:
+            self._wd_grace_cold()
         t_disp = time.time()
         try:
             nxt, self.cache.k, self.cache.v = \
@@ -2634,7 +2686,7 @@ class ContinuousScheduler:
             self._mixed_fns.clear()
             nxt, self.cache.k, self.cache.v = \
                 self._get_mixed_fn(T, w)(*args)
-        self._ran_ok.add(key_)
+        self._note_ran_ok(key_)
         nxt = np.asarray(self._timed_get(nxt))
         t_done = time.time()
 
@@ -2875,6 +2927,7 @@ class ContinuousScheduler:
             key_ = ("prefill", fresh, s_bucket, w, ring)
             if key_ not in self._ran_ok:
                 self._attr_prefill_cold = True  # compiling: no MFU sample
+                self._wd_grace_cold()
             try:
                 fn = (self._get_prefill_fn(s_bucket, use_ring=ring) if fresh
                       else self._get_prefill_window_fn(s_bucket, w))
@@ -2897,7 +2950,7 @@ class ContinuousScheduler:
                       else self._get_prefill_window_fn(s_bucket, w))
                 tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
                     fn(*args)
-            self._ran_ok.add(key_)
+            self._note_ran_ok(key_)
             rows = [(b, row) for row, (b, _, _, _, is_final) in enumerate(items)
                     if is_final]
             if rows:
@@ -2985,6 +3038,7 @@ class ContinuousScheduler:
         key_ = ("packed", s_bucket)
         if key_ not in self._ran_ok:
             self._attr_prefill_cold = True  # compiling: no MFU sample
+            self._wd_grace_cold()
         try:
             tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
                 self._get_packed_prefill_fn(s_bucket)(*args)
@@ -3003,7 +3057,7 @@ class ContinuousScheduler:
             self._packed_prefill_fns.clear()
             tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
                 self._get_packed_prefill_fn(s_bucket)(*args)
-        self._ran_ok.add(key_)
+        self._note_ran_ok(key_)
         return tok0, [(b, si) for si, (b, _, _) in enumerate(items)]
 
     def _get_packed_prefill_fn(self, s_bucket: int):
@@ -3240,6 +3294,8 @@ class ContinuousScheduler:
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
         decode_warm = ("decode", bc, w) in self._ran_ok
+        if not decode_warm:
+            self._wd_grace_cold()
         t_disp = time.time()
         try:
             out = self._get_decode_fn(w)(*args)
@@ -3257,7 +3313,7 @@ class ContinuousScheduler:
             self._decode_fns.clear()
             self._mixed_fns.clear()  # mixed fns captured use_ragged too
             out = self._get_decode_fn(w)(*args)
-        self._ran_ok.add(("decode", bc, w))
+        self._note_ran_ok(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
         toks, n_valid, *tok0s = self._timed_get(  # one transfer
             (toks, n_valid, *[t for t, _ in pending]))
@@ -3390,6 +3446,8 @@ class ContinuousScheduler:
             jnp.asarray(table[:, :w]), jnp.asarray(active), sub,
             jnp.asarray(temps), jnp.asarray(top_k), jnp.asarray(top_p),
         )
+        if ("specfn", w) not in self._ran_ok:
+            self._wd_grace_cold()
         t_disp = time.time()
         try:
             out = self._get_spec_decode_fn(w)(*args)
@@ -3405,7 +3463,7 @@ class ContinuousScheduler:
             self._decode_fns.clear()  # spec fns cache here too
             self._mixed_fns.clear()  # mixed fns captured use_ragged too
             out = self._get_spec_decode_fn(w)(*args)
-        self._ran_ok.add(("specfn", w))
+        self._note_ran_ok(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out
         toks, counts = self._timed_get((toks, counts))  # one transfer
         # spec blocks contribute step gaps but no byte/FLOP samples (the

@@ -26,6 +26,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="lmrs-serve",
         description="OpenAI/Anthropic-wire-compatible HTTP server over the "
                     "in-tree TPU engine",
+        # no prefix abbreviation: --supervise re-execs this CLI with the
+        # flag stripped by EXACT match — an abbreviated "--supervis"
+        # would survive the strip and fork supervisors recursively
+        allow_abbrev=False,
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -68,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "incrementally, journaled here and rehydrated on "
                         "startup (default: LMRS_LIVE_DIR; unset disables "
                         "— 501)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run the server in a supervised CHILD process: "
+                        "the parent polls /healthz and SIGKILL-respawns "
+                        "the child on a watchdog-declared wedge, a hang, "
+                        "or a crash; jobs/sessions resume from their "
+                        "journals across the bounce (docs/ROBUSTNESS.md "
+                        "§ Supervised restart)")
     p.add_argument("--trace", action="store_true",
                    help="enable the in-process lifecycle tracer; GET "
                         "/v1/trace then serves this host's span ring "
@@ -80,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(quiet=args.quiet)
+    if args.supervise:
+        # parent mode: never builds an engine — it spawns this same CLI
+        # (minus --supervise) as a child and owns only its lifecycle
+        import sys as _sys
+
+        from lmrs_tpu.serving.supervisor import Supervisor
+
+        raw = list(argv) if argv is not None else _sys.argv[1:]
+        child_argv = [a for a in raw if a != "--supervise"]
+        return Supervisor(child_argv, host=args.host, port=args.port).run()
     from lmrs_tpu.utils.platform import honor_platform_env
 
     honor_platform_env()

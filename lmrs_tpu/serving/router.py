@@ -51,7 +51,7 @@ from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  remaining_budget)
 from lmrs_tpu.obs import new_trace_id, stitch_traces
 from lmrs_tpu.testing import faults
-from lmrs_tpu.utils.env import env_bool, env_float
+from lmrs_tpu.utils.env import env_bool, env_float, env_int
 
 logger = logging.getLogger("lmrs.router")
 
@@ -98,34 +98,132 @@ class _Host:
     ("prefill" | "decode" | "both") — pool membership is a routing policy;
     every host can serve a full request (the colocated-fallback
     invariant), prefill-role hosts just additionally mint handoff tickets
-    and decode-role hosts import them."""
+    and decode-role hosts import them.
 
-    def __init__(self, url: str, role: str = "both"):
+    Health is a CIRCUIT BREAKER, not a binary bit (docs/ROBUSTNESS.md §
+    Router circuit breaker): ``LMRS_BREAKER_FAILURES`` consecutive
+    request-path failures of ANY kind (connect faults, timeouts, wedged
+    backends) OPEN the breaker — the host leaves the dispatch order even
+    though its TCP port may still accept connections (the wedged-backend
+    signature a connect-phase check can never see).  After
+    ``LMRS_BREAKER_COOLDOWN_S`` the paced recovery path moves it to
+    HALF-OPEN and sends one tiny golden canary request; success closes
+    the breaker, failure re-opens it for another cooldown.  The legacy
+    connect-phase belief (``_down``) still short-circuits on host-down
+    class failures exactly as before; ``healthy`` is now the derived
+    view both signals feed, and its setter keeps the existing
+    router/test surface (``h.healthy = True`` force-closes everything).
+    ``LMRS_BREAKER_FAILURES=0`` disables the breaker — the pre-breaker
+    binary bit, byte-for-byte."""
+
+    def __init__(self, url: str, role: str = "both",
+                 clock=time.monotonic):
         u = urlsplit(url if "//" in url else f"http://{url}")
         self.netloc = u.netloc or u.path  # tolerate bare host:port
         self.url = f"http://{self.netloc}"
         self.role = role
-        # ``healthy`` is a bare bool STORE (atomic under the GIL, last
-        # writer wins — an acceptable belief flag); the request counters
-        # are read-modify-writes and increment under the per-host lock:
-        # _one() runs per request on the dispatch pool, and bare ``+=``
-        # from concurrent legs was losing updates (the same class as the
-        # PR 6 handoff-counter fix, now machine-checked via guarded-by).
-        self.healthy = True
+        self.clock = clock
+        # ``_down``/breaker fields are bare STORES (atomic under the GIL,
+        # last writer wins — acceptable belief flags); the request
+        # counters are read-modify-writes and increment under the
+        # per-host lock: _one() runs per request on the dispatch pool,
+        # and bare ``+=`` from concurrent legs was losing updates (the
+        # same class as the PR 6 handoff-counter fix, now machine-checked
+        # via guarded-by).
+        self._down = False
+        self.breaker_state = "closed"  # closed | open | half_open
+        self.breaker_opened_t = 0.0    # clock() when last opened
         self._count_lock = threading.Lock()
         self.served = 0  # guarded-by: _count_lock
         self.failed = 0  # guarded-by: _count_lock
+        self.consec_failures = 0  # guarded-by: _count_lock
+        self.breaker_opens = 0    # guarded-by: _count_lock
         # earliest clock time the next recovery probe may launch (probe
         # pacing lives in RouterEngine._launch_probes; 0 = probe freely)
         self.next_probe_t = 0.0
 
+    @property
+    def healthy(self) -> bool:
+        """Request-path availability: connect-phase belief AND breaker.
+        A half-open host stays OUT of the dispatch order — only its
+        canary may touch it until the breaker closes."""
+        return not self._down and self.breaker_state == "closed"
+
+    @healthy.setter
+    def healthy(self, value: bool) -> None:
+        # True = the force-close every success path (and tests) use;
+        # False = the legacy connect-phase condemnation
+        if value:
+            self._down = False
+            self.breaker_state = "closed"
+            with self._count_lock:
+                self.consec_failures = 0
+        else:
+            self._down = True
+
     def note_served(self) -> None:
         with self._count_lock:
             self.served += 1
+            self.consec_failures = 0
 
     def note_failed(self) -> None:
+        threshold = env_int("LMRS_BREAKER_FAILURES", 3, lo=0)
+        opened = False
         with self._count_lock:
             self.failed += 1
+            self.consec_failures += 1
+            if (threshold and self.consec_failures >= threshold
+                    and self.breaker_state == "closed"):
+                opened = True
+                self.breaker_opens += 1
+        if opened:
+            self.breaker_state = "open"
+            self.breaker_opened_t = self.clock()
+            logger.warning("host %s: breaker OPEN after %d consecutive "
+                           "failures", self.netloc, threshold)
+
+    def reopen_breaker(self) -> None:
+        """A half-open canary failed: back to open, cooldown restarts."""
+        if self.breaker_state != "closed":
+            self.breaker_state = "open"
+            self.breaker_opened_t = self.clock()
+
+    def breaker_due(self) -> bool:
+        """True when an open breaker's cooldown has elapsed (eligible
+        for the half-open canary)."""
+        if self.breaker_state != "open":
+            return False
+        cooldown = env_float("LMRS_BREAKER_COOLDOWN_S", 5.0, lo=0.1)
+        return self.clock() - self.breaker_opened_t >= cooldown
+
+    def canary(self, timeout: float = 10.0) -> bool:
+        """Half-open probe: ONE tiny golden generation (1 greedy token)
+        through the real request path — a wedged backend accepts TCP but
+        cannot answer this, which is exactly what /healthz alone misses.
+        Success closes the breaker; failure re-opens it."""
+        self.breaker_state = "half_open"
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(self.netloc, timeout=timeout)
+            conn.request("POST", "/v1/chat/completions",
+                         body=json.dumps({
+                             "messages": [{"role": "user",
+                                           "content": "breaker canary"}],
+                             "max_tokens": 1, "temperature": 0.0}),
+                         headers={"Content-Type": "application/json"})
+            ok = conn.getresponse().status == 200
+        except Exception:  # noqa: BLE001 - still down
+            ok = False
+        finally:
+            if conn is not None:
+                conn.close()
+        if ok:
+            logger.info("host %s: canary succeeded, breaker CLOSED",
+                        self.netloc)
+            self.healthy = True
+        else:
+            self.reopen_breaker()
+        return ok
 
     def connect(self, timeout: float) -> http.client.HTTPConnection:
         # injection site: a connection-phase fault, raised AS the
@@ -135,7 +233,11 @@ class _Host:
         return http.client.HTTPConnection(self.netloc, timeout=timeout)
 
     def probe(self) -> bool:
-        """GET /healthz; re-admits an unhealthy host when it answers."""
+        """GET /healthz; clears the connect-phase condemnation when the
+        host answers.  Deliberately NOT a breaker close: a wedged backend
+        still answers /healthz — an OPEN breaker only closes through the
+        half-open canary (real request path).  With the breaker disabled
+        this is exactly the old re-admission."""
         conn = None
         try:
             # own injection site, own connection: probes run on pool
@@ -152,7 +254,7 @@ class _Host:
             if conn is not None:
                 conn.close()
         if ok:
-            self.healthy = True
+            self._down = False
         return ok
 
 
@@ -174,9 +276,11 @@ class RouterEngine:
         # the tier back to colocated operation over every full-capable
         # host.  Plain deployments pass ``hosts`` only: one "both" pool,
         # identical behavior to before.
-        self.hosts = ([_Host(h) for h in hosts]
-                      + [_Host(h, "prefill") for h in prefill_hosts]
-                      + [_Host(h, "decode") for h in decode_hosts])
+        self.hosts = ([_Host(h, clock=clock) for h in hosts]
+                      + [_Host(h, "prefill", clock=clock)
+                         for h in prefill_hosts]
+                      + [_Host(h, "decode", clock=clock)
+                         for h in decode_hosts])
         if not self.hosts:
             raise ValueError("RouterEngine needs at least one backend host")
         self.pools: dict[str, list[_Host]] = {
@@ -278,6 +382,18 @@ class RouterEngine:
         self._prefix_routed = 0     # guarded-by: _stats_lock
         self._prefix_predicted = 0  # guarded-by: _stats_lock
         self._prefix_fallback = 0   # guarded-by: _stats_lock
+        # Tail hedging (LMRS_HEDGE_MS, default 0 = off): a straggling
+        # NON-STREAMED request duplicates to a sibling host after a
+        # p99-derived delay; first non-error result wins, the loser is
+        # hung up through the existing cancel plumbing (the backend's
+        # disconnect detection frees its slot).  Fan-out-safe: results
+        # are keyed by request id, and greedy outputs are host-invariant,
+        # so whichever leg wins the text is identical.
+        self._hedges = 0       # guarded-by: _stats_lock
+        self._hedge_wins = 0   # guarded-by: _stats_lock
+        from collections import deque
+
+        self._lat_s = deque(maxlen=512)  # guarded-by: _stats_lock
 
     def _count(self, attr: str) -> None:
         """Increment a handoff counter atomically (dispatch-pool threads)."""
@@ -304,16 +420,27 @@ class RouterEngine:
             for wave in self._wave_cancel_sets:
                 wave.add(request_id)
         with self._inflight_lock:
-            target = self._inflight.get(request_id)
+            # hedge/failover legs register under ("hedge", rid): a cancel
+            # landing after the primary leg finished must still reach the
+            # duplicate's socket, or the abandoned leg would run its full
+            # generation and come back as a "success"
+            targets = [self._inflight.get(request_id),
+                       self._inflight.get(("hedge", request_id))]
+        for target in targets:
+            self._hangup(target)
+
+    @staticmethod
+    def _hangup(target) -> None:
+        """Force-close one in-flight leg's connection/socket (cancel()
+        and the hedge loser path share this).  shutdown(), not close():
+        while the dispatch thread is blocked reading the response,
+        socket.makefile's _io_refs defer a close() — no FIN would ever
+        reach the server and the "hangup" would silently no-op.
+        shutdown() sends the FIN immediately and unblocks the local
+        read.  Pre-connect the target is the HTTPConnection (no socket
+        yet; _post's post-request re-check covers that window)."""
         if target is None:
             return
-        # shutdown(), not close(): while the dispatch thread is blocked
-        # reading the response, socket.makefile's _io_refs defer a close()
-        # — no FIN would ever reach the server and the "hangup" would
-        # silently no-op.  shutdown() sends the FIN immediately and
-        # unblocks the local read.  Pre-connect the target is the
-        # HTTPConnection (no socket yet; _post's post-request re-check
-        # covers that window).
         import socket as _socket
 
         try:
@@ -331,6 +458,7 @@ class RouterEngine:
         per = []
         for h in self.hosts:
             row = {"host": h.netloc, "role": h.role, "healthy": h.healthy,
+                   "breaker": h.breaker_state,
                    "served": h.served, "failed": h.failed}
             conn = None
             try:
@@ -360,6 +488,8 @@ class RouterEngine:
                 "handoff": {"handoffs": self._handoffs,
                             "retries": self._handoff_retries,
                             "fallbacks": self._handoff_fallbacks},
+                "hedge": {"hedges": self._hedges,
+                          "wins": self._hedge_wins},
                 "prefix_route": {"enabled": self.prefix_route,
                                  "routed": self._prefix_routed,
                                  "predicted": self._prefix_predicted,
@@ -436,6 +566,15 @@ class RouterEngine:
                         "requests completed on this host").inc(h.served)
             reg.counter("lmrs_router_host_failed_total",
                         "requests failed on this host").inc(h.failed)
+            reg.gauge("lmrs_router_breaker_state",
+                      "circuit-breaker state for this host "
+                      "(0=closed, 1=open, 2=half_open)").set(
+                {"closed": 0.0, "open": 1.0,
+                 "half_open": 2.0}.get(h.breaker_state, 0.0))
+            reg.counter("lmrs_router_breaker_opens_total",
+                        "times this host's breaker opened "
+                        "(consecutive-failure threshold crossed)"
+                        ).inc(h.breaker_opens)
             pages.append(add_label_to_exposition(
                 reg.render_prometheus(), "host", h.netloc))
         # Per-role pool gauges (disaggregated serving).  Only pools with
@@ -477,6 +616,12 @@ class RouterEngine:
         hreg.counter("lmrs_router_prefix_fallback_total",
                      "prefix-eligible requests that degraded to plain "
                      "load/health ordering").inc(self._prefix_fallback)
+        hreg.counter("lmrs_router_hedges_total",
+                     "straggling requests duplicated to a sibling host "
+                     "(LMRS_HEDGE_MS tail hedging)").inc(self._hedges)
+        hreg.counter("lmrs_router_hedge_wins_total",
+                     "hedged requests whose DUPLICATE leg answered first "
+                     "(the loser was hung up)").inc(self._hedge_wins)
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
 
@@ -875,24 +1020,43 @@ class RouterEngine:
                 self._wave_cancel_sets.remove(cancelled)
 
     def _launch_probes(self) -> list[_Host]:
-        """Submit a /healthz probe for each unhealthy host whose pacing
-        window has elapsed; returns the hosts probed (test hook).  The
-        next-probe stamp is claimed under a lock BEFORE submission, so
-        concurrent waves racing this method cannot double-probe a host —
-        the loser of the race just skips, covered by the winner's probe."""
+        """Submit a recovery attempt for each unavailable host whose
+        pacing window has elapsed; returns the hosts probed (test hook).
+        Recovery is two-stage (_recover_host): the /healthz probe clears
+        a connect-phase condemnation; an OPEN breaker past its cooldown
+        additionally runs the half-open golden canary, the only thing
+        that may close it.  An open breaker still inside its cooldown is
+        not touched at all.  The next-probe stamp is claimed under a lock
+        BEFORE submission, so concurrent waves racing this method cannot
+        double-probe a host — the loser of the race just skips, covered
+        by the winner's probe."""
         now = self._clock()
         probed: list[_Host] = []
         with self._probe_lock:
             for host in self.hosts:
                 if host.healthy or now < host.next_probe_t:
                     continue
+                if (not host._down and host.breaker_state == "open"
+                        and not host.breaker_due()):
+                    continue  # cooldown running: no canary yet
+                if host.breaker_state == "half_open":
+                    continue  # a canary is already in flight
                 host.next_probe_t = (now + self.probe_floor_s
                                      + self._probe_rng.random()
                                      * self.probe_jitter_s)
                 probed.append(host)
         for host in probed:
-            self._pool.submit(host.probe)
+            self._pool.submit(self._recover_host, host)
         return probed
+
+    def _recover_host(self, host: _Host) -> None:
+        """One paced recovery attempt (pool thread): healthz first when
+        the host is connect-condemned, then the breaker canary when its
+        cooldown has elapsed."""
+        if host._down and not host.probe():
+            return
+        if host.breaker_state == "open" and host.breaker_due():
+            host.canary()
 
     def _role_pool(self, role: str) -> list[_Host]:
         if role == "full":
@@ -1107,6 +1271,12 @@ class RouterEngine:
             self._count("_handoff_fallbacks")
             logger.warning("request %d: handoff degraded; re-prefilling "
                            "colocated", req.request_id)
+        # tail hedging (read per request so A/B harnesses can flip the
+        # knob on a live router): non-streamed only — duplicating an SSE
+        # stream would double every delta the client already holds
+        hedge_ms = env_float("LMRS_HEDGE_MS", 0.0, lo=0.0)
+        if hedge_ms > 0 and on_tokens is None and len(self.hosts) > 1:
+            return self._one_hedged(i, req, cancelled, prefer, hedge_ms)
         return self._one_colocated(i, req, on_tokens, cancelled, prefer)
 
     def _one_colocated(self, i: int, req: GenerationRequest, on_tokens,
@@ -1128,7 +1298,13 @@ class RouterEngine:
                                         finish_reason="deadline")
             streamed = [0]  # deltas already forwarded on THIS request
             try:
+                t_leg = time.time()
                 res = self._post(host, req, on_tokens, streamed, cancelled)
+                if on_tokens is None:
+                    # the hedge-delay p99 pool holds NON-streamed
+                    # completion walls only: SSE walls are client-paced
+                    # and would inflate the p99 until hedging never fires
+                    self._note_latency(time.time() - t_leg)
                 host.note_served()
                 host.healthy = True
                 return res
@@ -1152,6 +1328,185 @@ class RouterEngine:
                     # delta concatenation equals the final text — surface
                     # the mid-stream failure instead
                     break
+        return GenerationResult(request_id=rid, finish_reason="error",
+                                error=last_err)
+
+    # ------------------------------------------------------- tail hedging
+
+    def _note_latency(self, dt: float) -> None:
+        """One successful non-streamed completion wall (the hedge delay's
+        p99 sample pool)."""
+        with self._stats_lock:
+            self._lat_s.append(dt)
+
+    def _hedge_delay_s(self, hedge_ms: float) -> float:
+        """How long the primary leg may run before the hedge launches:
+        the observed p99 completion wall once enough samples exist (a
+        hedge should only chase genuine TAIL stragglers), floored at the
+        operator's LMRS_HEDGE_MS."""
+        base = hedge_ms / 1000.0
+        with self._stats_lock:
+            lat = sorted(self._lat_s)
+        if len(lat) >= 20:
+            return max(base, lat[int(0.99 * (len(lat) - 1))])
+        return base
+
+    def _one_hedged(self, i: int, req: GenerationRequest,
+                    cancelled: set[int], prefer: _Host | None,
+                    hedge_ms: float) -> GenerationResult:
+        """Colocated dispatch with tail hedging: the primary leg runs on
+        the normal first-choice host; if it has not completed within the
+        hedge delay, a DUPLICATE leg launches on the next host in the
+        failover order.  First non-error result wins (results are keyed
+        by request id, so fan-out callers cannot mix legs up); every
+        other leg is hung up — the backend's disconnect detection cancels
+        the duplicate server-side and frees its slot/pages (the existing
+        cancel plumbing).  Greedy token-identity is preserved: both legs
+        run the same request on identical weights.
+
+        Failover is NOT traded away: a primary that fails FAST (before
+        the hedge delay) still gets the sibling attempt — as a plain
+        failover leg, not a hedge (no hedge counters, no duplicate) —
+        so arming LMRS_HEDGE_MS can never degrade availability below
+        the _one_colocated targets[:2] contract."""
+        from concurrent.futures import FIRST_COMPLETED
+        from concurrent.futures import wait as _fwait
+
+        rid = req.request_id
+        rem = remaining_budget(req)
+        if rem is not None and rem <= 0:
+            return GenerationResult(request_id=rid,
+                                    finish_reason="deadline")
+        targets = self._targets(i, "full", prefer=prefer)
+        primary, sibling = targets[0], (targets[1] if len(targets) > 1
+                                        else None)
+        # loser-abort marker: added to before the hangup so a loser still
+        # PRE-connect (its _inflight target is a socketless
+        # HTTPConnection whose close() no-ops) aborts itself at _post's
+        # post-request re-check instead of running a full duplicate
+        # generation nobody consumes.  Union-viewed with the wave's
+        # cancel set — _post/_read_sse only do membership tests.
+        aborted: set[int] = set()
+
+        class _Either:
+            __slots__ = ()
+
+            def __contains__(_self, x) -> bool:
+                return x in cancelled or x in aborted
+
+        leg_cancel = _Either()
+
+        def leg(host: _Host, key) -> GenerationResult:
+            t0 = time.time()
+            res = self._post(host, req, None, [0], leg_cancel,
+                             inflight_key=key)
+            self._note_latency(time.time() - t0)
+            return res
+
+        def spawn(host: _Host, key) -> "Future":
+            # a fresh daemon thread per leg, NOT the dispatch pool: _one
+            # already runs on a pool thread, and legs queued behind a
+            # saturated wave's _one tasks would deadlock the pool
+            # (every runner waiting on a leg that can never start)
+            from concurrent.futures import Future
+
+            fut: Future = Future()
+
+            def run_leg():
+                if not fut.set_running_or_notify_cancel():
+                    return
+                try:
+                    fut.set_result(leg(host, key))
+                except BaseException as e:  # noqa: BLE001 - future carries
+                    fut.set_exception(e)
+
+            threading.Thread(target=run_leg, daemon=True,
+                             name=f"lmrs-hedge-{rid}").start()
+            return fut
+
+        # future -> (host, inflight key, is_hedge)
+        legs: dict = {}
+        fut_p = spawn(primary, rid)
+        legs[fut_p] = (primary, rid, False)
+        delay_s = self._hedge_delay_s(hedge_ms)  # computed ONCE: the
+        # wait and the log must agree (and the reservoir sort is paid once)
+        _done, still_running = _fwait({fut_p}, timeout=delay_s)
+        if still_running and sibling is not None and rid not in cancelled:
+            try:
+                # injection site: "raise" abandons THIS hedge (the
+                # primary leg continues alone — hedging is an
+                # optimization); "stall" delays its launch
+                faults.fire("router.hedge")
+                fut_h = spawn(sibling, ("hedge", rid))
+                legs[fut_h] = (sibling, ("hedge", rid), True)
+                self._count("_hedges")
+                logger.info("request %d: hedged to %s after %.0f ms "
+                            "straggle", rid, sibling.netloc,
+                            delay_s * 1e3)
+            except Exception:  # noqa: BLE001 - degrade to primary-only
+                logger.warning("hedge launch for %d abandoned", rid,
+                               exc_info=True)
+        winner: GenerationResult | None = None
+        error_res: GenerationResult | None = None
+        last_err = "no healthy backend"
+        pending = set(legs)
+        while pending and winner is None:
+            done, pending = _fwait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                host, _key, is_hedge = legs[f]
+                try:
+                    res = f.result()
+                except Exception as e:  # noqa: BLE001 - per-leg degrade
+                    if rid in cancelled:
+                        winner = GenerationResult(request_id=rid,
+                                                  finish_reason="cancelled")
+                        break
+                    host.note_failed()
+                    if isinstance(e, _HostConnectError):
+                        host.healthy = False
+                    last_err = f"{host.netloc}: {type(e).__name__}: {e}"
+                    logger.warning("hedge leg for %d failed on %s: %s",
+                                   rid, host.netloc, last_err)
+                    # this leg DIED: if no other leg is running and the
+                    # sibling was never tried, launch it as a plain
+                    # FAILOVER attempt — the targets[:2] availability
+                    # contract must survive arming the hedge knob
+                    if (not pending and sibling is not None
+                            and not any(h is sibling
+                                        for h, _k, _h2 in legs.values())
+                            and rid not in cancelled):
+                        fut_f = spawn(sibling, ("hedge", rid))
+                        legs[fut_f] = (sibling, ("hedge", rid), False)
+                        pending = {fut_f}
+                    continue
+                if res.finish_reason != "error":
+                    host.note_served()
+                    host.healthy = True
+                    if is_hedge:
+                        self._count("_hedge_wins")
+                    winner = res
+                    break
+                # a backend-ANSWERED error result: the host served it
+                # (_one_colocated parity — request-level engine errors
+                # must not feed the breaker or trigger failover); keep it
+                # as the outcome unless a concurrent leg wins outright
+                host.note_served()
+                error_res = res
+                last_err = res.error or "backend error"
+        # hang up the loser leg(s): abort-mark first (pre-connect losers
+        # self-abort at the post-request check), then FIN the socket —
+        # the backend's disconnect detection cancels server-side
+        if winner is not None and any(not f.done() for f in legs):
+            aborted.add(rid)
+        for f, (_host, key, _h) in legs.items():
+            if not f.done():
+                with self._inflight_lock:
+                    target = self._inflight.get(key)
+                self._hangup(target)
+        if winner is not None:
+            return winner
+        if error_res is not None:
+            return error_res
         return GenerationResult(request_id=rid, finish_reason="error",
                                 error=last_err)
 
@@ -1335,7 +1690,8 @@ class RouterEngine:
 
     def _post(self, host: _Host, req: GenerationRequest, on_tokens,
               streamed: list[int], cancelled: set[int],
-              body_extra: dict | None = None) -> GenerationResult:
+              body_extra: dict | None = None,
+              inflight_key=None) -> GenerationResult:
         body = _request_body(req)
         if body_extra:
             body.update(body_extra)
@@ -1352,8 +1708,12 @@ class RouterEngine:
             timeout = max(1.0, min(timeout, rem + 5.0))
         conn = host.connect(timeout)
         rid = req.request_id
+        # hedged legs register under their own key so two concurrent legs
+        # of ONE rid never clobber each other's hangup target; the plain
+        # path keys by rid (what cancel() looks up)
+        key = rid if inflight_key is None else inflight_key
         with self._inflight_lock:
-            self._inflight[rid] = conn
+            self._inflight[key] = conn
         try:
             try:
                 conn.connect()  # explicit: connect failures mean HOST DOWN
@@ -1363,7 +1723,7 @@ class RouterEngine:
                 # re-pin to the RAW socket: getresponse() will detach it
                 # from the conn for Connection:close responses (SSE), and
                 # cancel() must still be able to hang up
-                self._inflight[rid] = conn.sock
+                self._inflight[key] = conn.sock
             payload = json.dumps(body)
             headers = {"Content-Type": "application/json"}
             if req.trace_id:
@@ -1400,7 +1760,7 @@ class RouterEngine:
             )
         finally:
             with self._inflight_lock:
-                self._inflight.pop(rid, None)
+                self._inflight.pop(key, None)
             try:
                 conn.close()
             except Exception:  # noqa: BLE001

@@ -626,9 +626,27 @@ class EngineHTTPServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    payload = {"status": "ok", "role": outer.role,
+                    # watchdog-degraded engines answer 503 + wedged:true
+                    # (optional Engine hook, getattr convention): the
+                    # supervisor SIGKILL-respawns on this signature and
+                    # the router's probe refuses to re-admit the host —
+                    # a wedged backend must not read as healthy just
+                    # because its HTTP stack still answers
+                    wedged = False
+                    wedged_hook = getattr(outer.engine, "wedged", None)
+                    if wedged_hook is not None:
+                        try:
+                            wedged = bool(wedged_hook())
+                        except Exception:  # noqa: BLE001 - stay healthy
+                            logger.debug("wedged hook failed",
+                                         exc_info=True)
+                    payload = {"status": "wedged" if wedged else "ok",
+                               "wedged": wedged, "role": outer.role,
                                "uptime_s": round(
                                    time.time() - outer.started, 1)}
+                    if wedged:
+                        self._send(503, payload)
+                        return
                     # compact radix summary (prefix-aware fleet routing,
                     # docs/SERVING.md): rides the probe path so the
                     # router's placement refresh costs one existing
