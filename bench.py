@@ -302,6 +302,7 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     # percentiles; counter metrics are windowed via the snapshot below.
     sched.reset_latency_stats()
     metrics_before = dict(sched.metrics)
+    cost_before = sched._cost.report()
     reps = env_int("LMRS_BENCH_REPS", 3, lo=1)
     rep_rows = _partial_reps  # shared with the watchdog (see start_watchdog)
     for _ in range(reps):
@@ -327,6 +328,12 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
         "backend": "jax",
         **roofline,
         **_scheduler_window(sched, metrics_before),
+        # request-cost ledger over the timed window (obs/ledger.py):
+        # windowed per-tenant device-seconds + goodput, and the host's
+        # burn-rate SLO state at capture — attribution rides every BENCH
+        # artifact next to the latency it explains
+        "cost": sched._cost.report(cost_before),
+        "slo": _slo_summary(sched.slo_report()),
     })
     # live-vs-offline agreement (ISSUE 8 acceptance): the live attribution
     # gauges gathered DURING the timed reps against the RTT-amortized
@@ -344,6 +351,20 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     if cmp_block:
         detail["live_vs_roofline"] = cmp_block
     return float(value), detail
+
+
+def _slo_summary(doc: dict) -> dict:
+    """Compact SLO block for bench detail: state + per-spec burn rates
+    (the full windows live on /healthz; the artifact needs the verdict
+    and the why, not the raw series)."""
+    return {
+        "enabled": doc.get("enabled", False),
+        "state": doc.get("state", "ok"),
+        "specs": {name: {"state": s.get("state"),
+                         "burn_fast": s.get("burn_fast"),
+                         "burn_slow": s.get("burn_slow")}
+                  for name, s in (doc.get("specs") or {}).items()},
+    }
 
 
 def _scheduler_window(sched, before: dict) -> dict:

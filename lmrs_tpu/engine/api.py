@@ -76,6 +76,16 @@ class GenerationRequest:
     # causal chain fleet-wide; None (engine-direct callers, the CLI
     # pipeline) falls back to the per-run request-id track.
     trace_id: str | None = None
+    # Cost-attribution label (docs/OBSERVABILITY.md § Request-cost
+    # ledger).  Minted at INGRESS from the ``X-LMRS-Tenant`` header (or
+    # the ``tenant`` body field) and propagated exactly like the trace
+    # id: router forwards resend the header, both disaggregation legs
+    # and the handoff payload carry it, and the job/session journal
+    # headers persist it (jobs/sessions default it to their own id when
+    # the submit carried none, so ``GET /v1/usage`` rolls up per
+    # job/session for free).  None = the engine bills the request to the
+    # "default" tenant.
+    tenant: str | None = None
 
 
 def preamble_text(system_prompt: str | None, prompt: str,
@@ -152,6 +162,13 @@ class GenerationResult:
     stop_sequence: str | None = None
     device_seconds: float = 0.0
     error: str | None = None
+    # Per-request cost-ledger bill (obs/ledger.py): phase-split
+    # device-seconds, token attribution, tokens saved, page/byte-seconds
+    # — attached by engines whose ledger is armed, surfaced as the wire
+    # ``usage.cost`` block and rolled up by jobs/sessions/tenant.  None
+    # with ``LMRS_COST_LEDGER=0`` (outputs then byte-identical to the
+    # pre-ledger wire format).
+    usage: dict | None = None
 
     @property
     def total_tokens(self) -> int:
@@ -248,6 +265,75 @@ class Engine(Protocol):
     # runs — this is how the HTTP server propagates a client disconnect
     # (the reference's asyncio gave cancellation for free,
     # llm_executor.py:290-296; a batch engine must expose it).
+
+
+class TenantStampEngine:
+    """Engine facade that (a) stamps a tenant label onto every request
+    that carries none — how jobs and live sessions bill their chunk and
+    reduce traffic to their own identity (or the submit's
+    ``X-LMRS-Tenant``) without threading a label through the pipeline —
+    and (b) accumulates every result's ledger ``usage`` block into one
+    rollup dict (``obs.merge_usage`` semantics), the ``usage`` block of
+    the job/session status doc.  Pure pass-through otherwise: optional
+    engine attributes (``schedules_internally``, ``cancel``, ...) resolve
+    through ``__getattr__``, so the facade composes with every engine
+    the managers already accept."""
+
+    def __init__(self, engine: "Engine", tenant: str | None,
+                 publish=None, seed: dict | None = None):
+        self._engine = engine
+        self.tenant = tenant
+        # ``publish`` receives an atomic SNAPSHOT dict after every merge:
+        # readers (job/session status docs on HTTP handler threads) hold
+        # a reference that is replaced, never mutated — json.dumps can
+        # never race a mid-merge resize.  ``seed`` carries a prior
+        # rollup forward (accumulation across refreshes/resumes).
+        self.usage_rollup: dict = dict(seed or {})  # guarded-by: _rollup_lock
+        self._publish = publish
+        import threading
+
+        self._rollup_lock = threading.Lock()
+
+    def generate_batch(self, requests: list["GenerationRequest"],
+                       on_result=None, on_tokens=None):
+        if self.tenant:
+            for req in requests:
+                if req.tenant is None:
+                    req.tenant = self.tenant
+
+        def absorb(res: "GenerationResult") -> None:
+            if res.usage:
+                from lmrs_tpu.obs.ledger import merge_usage
+
+                with self._rollup_lock:
+                    merge_usage(self.usage_rollup, res.usage)
+                    snap = dict(self.usage_rollup)
+                if self._publish is not None:
+                    self._publish(snap)
+
+        if on_result is None:
+            out = self._engine.generate_batch(requests, on_tokens=on_tokens)
+            for res in out:
+                absorb(res)
+            return out
+
+        def wrapped(res, submit):
+            absorb(res)
+
+            def stamped_submit(more: list["GenerationRequest"]) -> None:
+                if self.tenant:
+                    for req in more:
+                        if req.tenant is None:
+                            req.tenant = self.tenant
+                submit(more)
+
+            on_result(res, stamped_submit)
+
+        return self._engine.generate_batch(requests, on_result=wrapped,
+                                           on_tokens=on_tokens)
+
+    def __getattr__(self, name: str):
+        return getattr(self._engine, name)
 
 
 def drain_with_callback(run_batch, requests: list["GenerationRequest"],

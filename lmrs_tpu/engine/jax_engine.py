@@ -256,6 +256,22 @@ class JaxEngine:
             return []
         return self._scheduler.prefix_summary(top_k)
 
+    def usage_report(self) -> dict:
+        """Optional Engine hook: per-tenant cost-ledger rollups (the
+        ``GET /v1/usage`` document, docs/OBSERVABILITY.md § Request-cost
+        ledger).  Empty-disabled shape for the static scheduler."""
+        if self._scheduler is None:
+            return {"object": "usage", "enabled": False, "tenants": {},
+                    "totals": {}}
+        return self._scheduler.usage_report()
+
+    def slo_report(self) -> dict:
+        """Optional Engine hook: the burn-rate SLO evaluation exported
+        through ``/healthz`` (the router's placement-penalty feed)."""
+        if self._scheduler is None:
+            return {"enabled": False, "state": "ok", "specs": {}}
+        return self._scheduler.slo_report()
+
     # ---------------------------------------- disaggregated handoff hooks
     # (optional Engine surface, same getattr convention as ``cancel``):
     # the continuous scheduler implements the real page pin/export/import

@@ -56,6 +56,11 @@ class MockEngine:
     EMU_BYTES_PER_TOKEN = 1024
     EMU_PAGE_TOKENS = 128
 
+    # deterministic emulated device-time: the mock "spends" this many
+    # seconds per token, so usage bills are byte-reproducible across
+    # arms and hosts (the A/B harnesses compare exact rollup sums)
+    EMU_SECONDS_PER_TOKEN = 1e-6
+
     def __init__(self, seed: int = 0, latency_s: float = 0.0,
                  fail_pattern: str | None = None,
                  handoff_ttl_s: float = 60.0,
@@ -63,7 +68,9 @@ class MockEngine:
                  mixed_token_budget: int = 256,
                  prefix_cache: bool = True,
                  host_kv: bool | None = None,
-                 host_kv_gb: float = 1.0):
+                 host_kv_gb: float = 1.0,
+                 cost_ledger: bool | None = None,
+                 slo: bool | None = None):
         from lmrs_tpu.utils.env import env_bool
 
         self.seed = seed
@@ -115,6 +122,31 @@ class MockEngine:
         self._mixed_piggybacked = 0  # guarded-by: _mixed_lock
         self._mixed_fill_sum = 0.0  # guarded-by: _mixed_lock
         self._tok = ApproxTokenizer()
+        # Cost ledger + SLO parity (obs/ledger.py, obs/slo.py): the SAME
+        # accounting/knob surface as the jax scheduler, deterministically
+        # emulated — per-request device-seconds derive from token counts
+        # (EMU_SECONDS_PER_TOKEN), never wall clocks, so the whole
+        # usage -> /v1/usage -> router-aggregation -> SLO-routing flow
+        # runs deviceless in CI with exact, reproducible sums.  The env
+        # kill switches compose exactly as in the scheduler: LMRS_
+        # COST_LEDGER=0 / LMRS_SLO=0 always disarm, constructor False
+        # always disarms.
+        from lmrs_tpu.obs.ledger import CostLedger
+        from lmrs_tpu.obs.slo import SLOEngine
+
+        cl_on = (env_bool("LMRS_COST_LEDGER", True)
+                 and (cost_ledger is None or bool(cost_ledger)))
+        slo_on = (env_bool("LMRS_SLO", True)
+                  and (slo is None or bool(slo)))
+        # frozen ledger clock: residency-derived meters (host-pool
+        # byte-seconds) read 0 so usage sums stay byte-reproducible —
+        # the mock bills work, never wall time
+        self.ledger = CostLedger(enabled=cl_on, clock=lambda: 0.0)
+        self.slo = SLOEngine(enabled=slo_on)
+        # rid -> prompt tokens the prefix cache / prefetch served, so
+        # _bill skips them like the real scheduler (saved tokens never
+        # enter a prefill dispatch — they must not bill device time)
+        self._billing_saved: dict[int, int] = {}  # guarded-by: _prefix_lock
         # ids cancel() was called for — generation is instantaneous here, so
         # the hook only records (tests assert the server propagated a
         # disconnect) and flags ids not yet generated in this batch
@@ -141,6 +173,10 @@ class MockEngine:
             tr = get_tracer()
             t0 = time.time()
             res = self._one(req)
+            self._bill(req, res)
+            self.slo.observe_ttft(time.time() - t0)
+            self.slo.note_result(res.finish_reason, res.completion_tokens,
+                                 res.error)
             if tr:  # minimal lifecycle: the mock has no queue or slots
                 # the tid is resolved AFTER _one so a handoff import's
                 # adopted trace takes effect: CI's no-device disagg
@@ -214,11 +250,23 @@ class MockEngine:
             if ent is not None:
                 self._prefix_hits += 1
                 self._prefix_tokens_reused += ent["tokens"]
-                if ent["tier"] == "spilled":
+                spilled = ent["tier"] == "spilled"
+                if spilled:
                     self._spilled_hits += 1
                     self._tokens_prefetched += ent["tokens"]
                     self._prefetch_pages += pages
                     ent["tier"] = "resident"
+                self.ledger.note_saved(
+                    req,
+                    prefix_tokens=0 if spilled else ent["tokens"],
+                    prefetched_tokens=ent["tokens"] if spilled else 0,
+                    prefetched_bytes=(ent["tokens"]
+                                      * self.EMU_BYTES_PER_TOKEN
+                                      if spilled else 0.0))
+                if self.ledger.enabled:  # popped by _bill; no entry may
+                    self._billing_saved[req.request_id] = (  # outlive it
+                        self._billing_saved.get(req.request_id, 0)
+                        + ent["tokens"])
             else:
                 ent = {"tokens": tokens, "tier": "resident", "tick": 0}
                 self._prefix[key] = ent
@@ -285,6 +333,45 @@ class MockEngine:
                 })
         return out
 
+    def _bill(self, req: GenerationRequest,
+              res: GenerationResult) -> None:
+        """Deterministic ledger entry for one finished mock request:
+        prompt tokens bill as prefill, completion tokens as decode, at
+        EMU_SECONDS_PER_TOKEN each (emulated pages at EMU_PAGE_TOKENS
+        granularity).  Token-count-derived, so two arms running the same
+        traffic produce byte-identical usage sums."""
+        if not self.ledger.enabled:
+            return
+        spt = self.EMU_SECONDS_PER_TOKEN
+        with self._prefix_lock:
+            saved = self._billing_saved.pop(res.request_id, 0)
+        # saved tokens never entered a prefill dispatch on the real
+        # scheduler, so the mock must not bill them either — with the
+        # cache serving the whole prompt there is NO prefill step
+        billed = max(0, res.prompt_tokens - saved)
+        if billed:
+            self.ledger.note_step(
+                billed * spt,
+                prefill_rows=[(req, billed, float(billed))],
+                prefill_cost_s=1.0)
+        if res.completion_tokens:
+            pages = -(-(res.prompt_tokens + res.completion_tokens)
+                      // self.EMU_PAGE_TOKENS)
+            self.ledger.note_step(
+                res.completion_tokens * spt,
+                decode_rows=[(req, res.completion_tokens, pages)],
+                decode_cost_s=1.0)
+        res.usage = self.ledger.finish(req, res)
+
+    def usage_report(self) -> dict:
+        """Optional Engine hook: the ``GET /v1/usage`` document (same
+        shape as the scheduler's)."""
+        return self.ledger.usage_report()
+
+    def slo_report(self) -> dict:
+        """Optional Engine hook: the ``/healthz`` ``slo`` block."""
+        return self.slo.report()
+
     def shutdown(self) -> None:
         pass
 
@@ -329,6 +416,13 @@ class MockEngine:
                     "prefetch_pages": self._prefetch_pages,
                     "dropped_pages_total": self._host_dropped_pages,
                 }
+        # the cost block appears once work flowed (the same
+        # report-nothing-when-idle contract as the mixed/prefix blocks).
+        # Deliberately NO slo block here: engine_metrics is contractually
+        # deterministic for identical traffic (test_mixed asserts it) and
+        # SLO burns are wall-clock-fed — consumers read slo_report()
+        if self.ledger.enabled and self.ledger.finished_count:
+            out["cost"] = self.ledger.report()
         # no work recorded at all: the mock reports no engine metrics,
         # as it always has
         return out
@@ -403,6 +497,8 @@ class MockEngine:
             # same adoption rule as the scheduler's _admit_import)
             if not req.trace_id and isinstance(state.get("trace_id"), str):
                 req.trace_id = state["trace_id"]
+            if not req.tenant and isinstance(state.get("tenant"), str):
+                req.tenant = state["tenant"]
             tr = get_tracer()
             if tr:
                 tr.instant(
@@ -445,6 +541,8 @@ class MockEngine:
                            "finish_reason": "stop"}
                 if req.trace_id:
                     payload["trace_id"] = req.trace_id
+                if req.tenant:
+                    payload["tenant"] = req.tenant
                 with self._pinned_lock:
                     self._pinned[req.request_id] = {
                         "payload": payload,

@@ -379,6 +379,41 @@ class ReplicatedEngine:
             # just drops anything still queued
             pool.shutdown(wait=False, cancel_futures=True)
 
+    def usage_report(self) -> dict:
+        """Optional Engine hook: per-tenant ledger rollups merged across
+        replicas (obs.merge_usage — the one merge rule, so fleet totals
+        equal the sum of replica totals exactly)."""
+        from lmrs_tpu.obs.ledger import merge_usage, totals_from_tenants
+
+        tenants: dict[str, dict] = {}
+        enabled = False
+        for r in self.replicas:
+            hook = getattr(r, "usage_report", None)
+            doc = hook() if hook is not None else {}
+            enabled = enabled or bool(doc.get("enabled"))
+            for t, roll in (doc.get("tenants") or {}).items():
+                merge_usage(tenants.setdefault(t, {}), roll)
+        return {"object": "usage", "enabled": enabled, "tenants": tenants,
+                "totals": totals_from_tenants(tenants)}
+
+    def slo_report(self) -> dict:
+        """Optional Engine hook: the replicated engine's health is the
+        WORST replica's SLO state (one degraded shard degrades the
+        host's placement score — the router cannot address replicas
+        individually)."""
+        from lmrs_tpu.obs.slo import state_rank
+
+        docs = []
+        for r in self.replicas:
+            hook = getattr(r, "slo_report", None)
+            if hook is not None:
+                docs.append(hook())
+        live = [d for d in docs if d.get("enabled")]
+        if not live:
+            return {"enabled": False, "state": "ok", "specs": {}}
+        worst = max(live, key=lambda d: state_rank(d.get("state")))
+        return {**worst, "replicas": len(live)}
+
     def engine_metrics(self) -> dict:
         """Fleet metrics in the same shape as one scheduler's report
         (engine/scheduler.py:metrics_report) so downstream consumers — the

@@ -43,8 +43,8 @@ from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
                                       audit_allocator)
 from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.models.transformer import forward_paged
-from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS,
-                          DispatchAttribution, MetricsRegistry,
+from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, CostLedger,
+                          DispatchAttribution, MetricsRegistry, SLOEngine,
                           dump_postmortem, get_tracer, req_tid)
 from lmrs_tpu.ops.sampling import sample_logits
 from lmrs_tpu.testing import faults
@@ -527,6 +527,25 @@ class ContinuousScheduler:
         self._attr_pending_flops = 0.0
         self._attr_prefill_cold = False  # a compiling shape in the wave
         self._attr_last_gb = 0.0  # last block's model bytes (span arg)
+        # Request-cost ledger (obs/ledger.py): every dispatch wall —
+        # already phase-split by the attribution above — apportions one
+        # level further down, to the live rows, accumulating an honest
+        # per-request device-time bill with a conservation invariant in
+        # audit().  LMRS_COST_LEDGER=0 turns every note into a no-op
+        # (pure host bookkeeping; outputs byte-identical either way).
+        self._cost = CostLedger(self.registry)
+        # per-row prefill work issued since the last consumption —
+        # (req, tokens, flops) mirrors of _attr_pending_flops, consumed
+        # by whichever dispatch fetch charges the wave's wall
+        self._cost_pending_prefill: list[tuple] = []
+        # (wall_s, decode_cost_s, prefill_cost_s, prefill_rows) of the
+        # last decode/spec dispatch, consumed by run()'s emitted loop
+        # where the per-row token counts become known
+        self._cost_step: tuple | None = None
+        # SLO engine (obs/slo.py): burn-rate health states over the
+        # stream's own TTFT / block-gap / outcome samples; /healthz and
+        # the router's placement penalty read slo_report().
+        self._slo = SLOEngine(self.registry, metrics_cb=lambda: self.metrics)
         # LMRS_PROFILE_ON_SLOW_STEP: a decode block slower than the
         # threshold (warm shapes only) triggers ONE jax.profiler capture
         # per process into LMRS_PROFILE_DIR — the "why was that step
@@ -620,6 +639,42 @@ class ContinuousScheduler:
         self._attr_pending_flops = 0.0
         self._attr_prefill_cold = False
         return flops, cold
+
+    def _consume_prefill_cost(self) -> list[tuple]:
+        """Take (and reset) the per-row prefill cost rows mirroring
+        _consume_prefill_attr — the ledger's row-level view of the same
+        pending work."""
+        rows, self._cost_pending_prefill = self._cost_pending_prefill, []
+        return rows
+
+    def _roofline_phase_costs(self, nbytes: float,
+                              flops: float) -> tuple[float, float]:
+        """(decode_cost_s, prefill_cost_s): each phase's own roofline
+        time — the exact-split denominators the ledger apportions dispatch
+        walls by (obs/perf.note_mixed_step's rule, one level down)."""
+        spec = self._perf._spec()
+        return (max(nbytes, 0.0) / spec.peak_hbm_bw,
+                max(flops, 0.0) / spec.peak_flops)
+
+    # ------------------------------------------------ cost / SLO surfaces
+
+    def usage_report(self) -> dict:
+        """Per-tenant cost rollups (the ``GET /v1/usage`` document)."""
+        return self._cost.usage_report()
+
+    def slo_report(self) -> dict:
+        """Burn-rate SLO evaluation (the ``/healthz`` ``slo`` block)."""
+        return self._slo.report()
+
+    def cost_finish(self, req: GenerationRequest, res: GenerationResult
+                    ) -> None:
+        """Finalize a request's ledger entry for a result synthesized
+        OUTSIDE the scheduler loop (the watchdog's wedge sweep): attaches
+        the usage bill and feeds the SLO outcome stream, same as
+        _record_result does for loop-delivered results."""
+        res.usage = self._cost.finish(req, res)
+        self._slo.note_result(res.finish_reason, res.completion_tokens,
+                              res.error)
 
     def _maybe_profile_slow_step(self, wall_s: float, warm: bool) -> None:
         """LMRS_PROFILE_ON_SLOW_STEP trigger: the first WARM decode block
@@ -716,6 +771,8 @@ class ContinuousScheduler:
             "mixed_batch": self._mixed_report(),
             "host_kv": self._host_kv_report(),
             "perf_attribution": self._perf.report(),
+            "cost": self._cost.report(),
+            "slo": self._slo.report(),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
             **({"prefix_cache": self._prefix_cache_report()}
@@ -1053,6 +1110,14 @@ class ContinuousScheduler:
                     if cached_tokens:
                         self._c_prefix_hits.inc()
                         self._c_prefix_tokens.inc(cached_tokens)
+                        self._cost.note_saved(
+                            req,
+                            prefix_tokens=cached_tokens - prefetched_tokens,
+                            prefetched_tokens=prefetched_tokens,
+                            prefetched_bytes=(
+                                self.cache.pages_needed(prefetched_tokens)
+                                * self.cache.page_payload_bytes()
+                                if prefetched_tokens else 0.0))
                 # a continuation keeps its ORIGINAL t_start: device_seconds
                 # then spans the whole request, and the slot stays "old" for
                 # youngest-victim selection (a refreshed t_start would make
@@ -1074,6 +1139,7 @@ class ContinuousScheduler:
                 t_q = t_enq.get(rid)
                 if t_q is not None and t0 is None:
                     self._h_queue_wait.observe(now - t_q)
+                    self._cost.note_queue_wait(req, now - t_q)
                     if tr:
                         tr.complete("queue_wait", t_q, now,
                                     tid=self._tid(req))
@@ -1212,9 +1278,14 @@ class ContinuousScheduler:
                     # clean prefill MFU sample: the wall from dispatch
                     # issue to this fetch covers exactly the prefill
                     # compute (+1 RTT) — the prefill pod's whole life
+                    t_fetch = time.time()
                     flops, cold = self._consume_prefill_attr()
-                    self._perf.note_prefill_sync(flops, t_pf, time.time(),
+                    self._perf.note_prefill_sync(flops, t_pf, t_fetch,
                                                  warm=not cold)
+                    self._cost.note_step(
+                        max(0.0, t_fetch - t_pf),
+                        prefill_rows=self._consume_prefill_cost(),
+                        prefill_cost_s=1.0)
                     for (b, p, row) in deferred:
                         st = slots[b]
                         tok0 = int(fetched[p][row])
@@ -1240,10 +1311,14 @@ class ContinuousScheduler:
                         # now — a stalled slot's tok0 is real output and must
                         # not be dropped (preempted slots resample theirs)
                         fetched = self._timed_get([t for t, _ in pending])
+                        t_fetch = time.time()
                         flops, cold = self._consume_prefill_attr()
-                        self._perf.note_prefill_sync(flops, t_pf,
-                                                     time.time(),
+                        self._perf.note_prefill_sync(flops, t_pf, t_fetch,
                                                      warm=not cold)
+                        self._cost.note_step(
+                            max(0.0, t_fetch - t_pf),
+                            prefill_rows=self._consume_prefill_cost(),
+                            prefill_cost_s=1.0)
                         for (b, p, row) in deferred:
                             if slots[b] is None:
                                 continue
@@ -1263,6 +1338,7 @@ class ContinuousScheduler:
                 now = time.time()
                 if last_block_t is not None:
                     self._h_block_gap.observe(now - last_block_t)
+                    self._slo.observe_gap(now - last_block_t)
                 last_block_t = now
                 if self.spec_k:
                     emitted = self._spec_decode_block(
@@ -1271,6 +1347,28 @@ class ContinuousScheduler:
                     toks, n_valid, tok0s = self._decode_block(
                         slots, last_tok, kv_lens, active, temps, top_k, top_p,
                         pending)
+                    emitted = [toks[b, : int(n_valid[b])].tolist()
+                               for b in range(self.B)]
+                if self._cost.enabled and self._cost_step is not None:
+                    # the dispatch wall stashed by _decode_block /
+                    # _spec_decode_block meets its per-row token counts
+                    # here — one ledger note per dispatch, issued BEFORE
+                    # any of this iteration's finishes (the mixed path's
+                    # ordering): a row finishing on this very block must
+                    # have its final share billed while its entry is
+                    # still open, not re-created as an orphan after
+                    # finish() already rolled it up
+                    wall, dcost, pcost, prows = self._cost_step
+                    self._cost_step = None
+                    self._cost.note_step(
+                        wall,
+                        decode_rows=[(slots[b].req, len(emitted[b]),
+                                      len(slots[b].seq.pages))
+                                     for b in range(self.B)
+                                     if slots[b] is not None and active[b]],
+                        prefill_rows=prows,
+                        decode_cost_s=dcost, prefill_cost_s=pcost)
+                if not self.spec_k:
                     for (b, p, row) in deferred:
                         if slots[b] is None:
                             continue  # preempted: tok0 is resampled on re-prefill
@@ -1285,8 +1383,6 @@ class ContinuousScheduler:
                             # emitted loop below skips inactive rows
                             self._maybe_finish(b, slots, results, active, fresh,
                                                kv_lens, last_tok)
-                    emitted = [toks[b, : int(n_valid[b])].tolist()
-                               for b in range(self.B)]
                 block_tokens = 0
                 for b in range(self.B):
                     st = slots[b]
@@ -1400,6 +1496,11 @@ class ContinuousScheduler:
             self._on_tokens = None
             self._streamed = {}
             self._cancelled.clear()
+            # un-consumed ledger rows must not leak across runs (a run
+            # abandoned mid-wave would bill its rows to the next run's
+            # first dispatch)
+            self._cost_pending_prefill = []
+            self._cost_step = None
             with self._pinned_lock:
                 self._run_live = False
             # releases parked during the run free here, on the scheduler
@@ -1434,7 +1535,7 @@ class ContinuousScheduler:
                     completion_tokens=len(gen),
                     finish_reason="cancelled",
                     stop_sequence=stop_hit,
-                ))
+                ), req=req)
                 fresh.append(req.request_id)
                 hit.add(req.request_id)
                 self._c_cancelled.inc()
@@ -1455,15 +1556,22 @@ class ContinuousScheduler:
                          st.req.request_id, b)
         self._cancelled -= hit
 
-    def _record_result(self, results: dict, res: GenerationResult) -> None:
+    def _record_result(self, results: dict, res: GenerationResult,
+                       req: GenerationRequest | None = None) -> None:
         """The ONE write path into a run's result dict: every submitted id
         must terminate exactly once, so an overwrite is recorded for the
-        auditor instead of silently replacing the first outcome."""
+        auditor instead of silently replacing the first outcome.  Also
+        the one place every terminal outcome meets the cost ledger (the
+        usage bill attaches here) and the SLO outcome stream."""
         if res.request_id in results:
             self._audit_double_finish += 1
             logger.error("request %d terminated more than once "
                          "(%s over %s)", res.request_id, res.finish_reason,
                          results[res.request_id].finish_reason)
+        if req is not None:
+            res.usage = self._cost.finish(req, res)
+        self._slo.note_result(res.finish_reason, res.completion_tokens,
+                              res.error)
         results[res.request_id] = res
 
     # ------------------------------------------------------------ deadlines
@@ -1502,7 +1610,7 @@ class ContinuousScheduler:
             completion_tokens=len(gen) if continuation else 0,
             finish_reason=reason,
             stop_sequence=stop_hit if continuation else None,
-        ))
+        ), req=req)
         fresh.append(req.request_id)
         (self._c_deadline if continuation else self._c_shed).inc()
         if self._tr:
@@ -1588,6 +1696,7 @@ class ContinuousScheduler:
             violations.append(f"{self._audit_double_finish} result "
                               "record(s) overwrote an existing result "
                               "(termination-exactly-once broken)")
+        violations += self._cost.audit()
         if violations:
             # an invariant break is exactly the moment the last-N spans
             # and counters matter; no-op unless the recorder is armed
@@ -1618,6 +1727,7 @@ class ContinuousScheduler:
             now = time.time()
             self._ttft_min = min(self._ttft_min, now - t0)
             self._h_ttft.observe(now - t0)
+            self._slo.observe_ttft(now - t0)
             if self._tr:
                 self._tr.instant("first_token", ts=now,
                                  tid=self._tid(st.req))
@@ -1647,7 +1757,7 @@ class ContinuousScheduler:
             finish_reason=finish_reason,
             stop_sequence=stop_hit,
             device_seconds=now - st.t_start,
-        ))
+        ), req=st.req)
         if self._tr:
             tid = self._tid(st.req)
             if st.t_decode_start:  # close the decode span of this slot life
@@ -1713,7 +1823,8 @@ class ContinuousScheduler:
             self._record_result(results, GenerationResult(
                 request_id=rid, prompt_tokens=st.n_prompt,
                 finish_reason="error",
-                error=f"handoff export failed: {type(e).__name__}: {e}"))
+                error=f"handoff export failed: {type(e).__name__}: {e}"),
+                req=st.req)
             if fresh is not None:
                 fresh.append(rid)
             self.cache.close_sequence(st.seq)
@@ -1733,6 +1844,10 @@ class ContinuousScheduler:
         # trace id even when the ticket is followed without the router
         if st.req.trace_id:
             payload["trace_id"] = st.req.trace_id
+        # the tenant label crosses the pod boundary the same way: the
+        # decode pod bills its share of the request to the same tenant
+        if st.req.tenant:
+            payload["tenant"] = st.req.tenant
         # budget-overshoot pages (decode-capacity growth past the prompt)
         # are NOT part of the handoff — release them before pinning
         if len(st.seq.pages) > keep:
@@ -1753,7 +1868,7 @@ class ContinuousScheduler:
         self._record_result(results, GenerationResult(
             request_id=rid, text=text, prompt_tokens=st.n_prompt,
             completion_tokens=len(gen), finish_reason="handoff",
-            device_seconds=now - st.t_start))
+            device_seconds=now - st.t_start), req=st.req)
         if self._tr:
             tid = self._tid(st.req)
             if st.t_decode_start:
@@ -1869,6 +1984,8 @@ class ContinuousScheduler:
         # the router re-sent the header — keeps it; they are equal anyway)
         if not req.trace_id and isinstance(state.get("trace_id"), str):
             req.trace_id = state["trace_id"]
+        if not req.tenant and isinstance(state.get("tenant"), str):
+            req.tenant = state["tenant"]
         try:
             need = int(state.get("n_pages", 0) or 0)
         except (TypeError, ValueError):
@@ -1884,7 +2001,7 @@ class ContinuousScheduler:
                 finish_reason="error",
                 error=f"handoff import failed: page claim {need} exceeds "
                       "this pool's capacity (geometry drift or corrupt "
-                      "ticket)"))
+                      "ticket)"), req=req)
             fresh.append(req.request_id)
             return True
         if need > self.cache.allocator.free_count:
@@ -1948,7 +2065,8 @@ class ContinuousScheduler:
             self._record_result(results, GenerationResult(
                 request_id=req.request_id, prompt_tokens=n_prompt,
                 finish_reason="error",
-                error=f"handoff import failed: {type(e).__name__}: {e}"))
+                error=f"handoff import failed: {type(e).__name__}: {e}"),
+                req=req)
             fresh.append(req.request_id)
             return True
         now = time.time()
@@ -2648,6 +2766,7 @@ class ContinuousScheduler:
         now = time.time()
         if last_block_t is not None:
             self._h_block_gap.observe(now - last_block_t)
+            self._slo.observe_gap(now - last_block_t)
         last_block_t = now
         flops = self._perf.prefill_flops(c, kv_start=pos)
         if self._tr:
@@ -2694,9 +2813,23 @@ class ContinuousScheduler:
         # are known, so no decode-share estimate is involved (note_block's
         # EMA decomposition stays for the sequenced-prefill block path)
         extra_flops, cold_pf = self._consume_prefill_attr()
-        self._attr_last_gb = round(self._perf.note_mixed_step(
+        nb = self._perf.note_mixed_step(
             t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
-            warm=warm and not cold_pf) / 1e9, 3)
+            warm=warm and not cold_pf)
+        self._attr_last_gb = round(nb / 1e9, 3)
+        if self._cost.enabled:
+            # fused-step ledger note: every decode row advanced exactly
+            # one token; the piggybacked slice joins the pending prefill
+            # rows (the ISSUE's exact per-row split, no estimates)
+            dcost, pcost = self._roofline_phase_costs(
+                nb, flops + extra_flops)
+            self._cost.note_step(
+                max(0.0, t_done - t_disp),
+                decode_rows=[(slots[b].req, 1, len(slots[b].seq.pages))
+                             for b in rows],
+                prefill_rows=(self._consume_prefill_cost()
+                              + [(st_pf.req, c, flops)]),
+                decode_cost_s=dcost, prefill_cost_s=pcost)
 
         for b in rows:
             st = slots[b]
@@ -2905,9 +3038,15 @@ class ContinuousScheduler:
             self._h_prefill_batch.observe(batch_tokens)
             # roofline attribution: real-token FLOPs of this dispatch
             # (window chunks additionally attend their cached prefix),
-            # consumed by whichever block fetches the wave's results
-            flops = sum(self._perf.prefill_flops(len(c), kv_start=p)
-                        for _, _, c, p, _ in items)
+            # consumed by whichever block fetches the wave's results —
+            # the ledger keeps the same work per ROW for its split
+            flops = 0.0
+            for _, st_i, c_i, p_i, _ in items:
+                f_i = self._perf.prefill_flops(len(c_i), kv_start=p_i)
+                flops += f_i
+                if self._cost.enabled:
+                    self._cost_pending_prefill.append(
+                        (st_i.req, len(c_i), f_i))
             self._attr_pending_flops += flops
             if self._tr:
                 self._tr.instant("prefill_dispatch",
@@ -3019,7 +3158,12 @@ class ContinuousScheduler:
             self._c_prefill_tokens.inc(n)
             off += n
         self._h_prefill_batch.observe(s_real)
-        flops = sum(self._perf.prefill_flops(len(c)) for _, _, c in items)
+        flops = 0.0
+        for _, st_i, c_i in items:
+            f_i = self._perf.prefill_flops(len(c_i))
+            flops += f_i
+            if self._cost.enabled:
+                self._cost_pending_prefill.append((st_i.req, len(c_i), f_i))
         self._attr_pending_flops += flops
         if self._tr:
             self._tr.instant("prefill_dispatch",
@@ -3323,10 +3467,15 @@ class ContinuousScheduler:
         # block's device work (plus any same-iteration prefill sequenced
         # before it — its FLOPs are pending and charged here)
         flops, cold_pf = self._consume_prefill_attr()
-        self._attr_last_gb = round(self._perf.note_block(
+        nb = self._perf.note_block(
             t_disp, t_done, self.decode_block, attr_live_rows,
             attr_live_tokens, flops,
-            warm=decode_warm and not cold_pf) / 1e9, 3)
+            warm=decode_warm and not cold_pf)
+        self._attr_last_gb = round(nb / 1e9, 3)
+        if self._cost.enabled:
+            dcost, pcost = self._roofline_phase_costs(nb, flops)
+            self._cost_step = (max(0.0, t_done - t_disp), dcost, pcost,
+                               self._consume_prefill_cost())
         self._maybe_profile_slow_step(t_done - t_disp,
                                       decode_warm and not cold_pf)
         if bc < B or perm is not None:
@@ -3466,22 +3615,32 @@ class ContinuousScheduler:
         self._note_ran_ok(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out
         toks, counts = self._timed_get((toks, counts))  # one transfer
+        t_done = time.time()
         # spec blocks contribute step gaps but no byte/FLOP samples (the
         # verify-step byte model differs); pending prefill FLOPs are
         # consumed — still counted, never sampled — so they cannot
         # mis-attribute to a later plain block
-        self._perf.note_gap(t_disp, time.time())
+        self._perf.note_gap(t_disp, t_done)
         flops, _ = self._consume_prefill_attr()
         if flops > 0:
             self._perf.c_flops.inc(flops)
         self._attr_last_gb = 0.0
+        if self._cost.enabled:
+            # no byte model for the verify step: phase costs 0 degrade
+            # the ledger split to per-row token counts (documented)
+            self._cost_step = (max(0.0, t_done - t_disp), 0.0, 0.0,
+                               self._consume_prefill_cost())
         emitted: list[list[int]] = []
         for b in range(self.B):
             row: list[int] = []
+            accepted = 0
             for s in range(counts.shape[1]):
                 c = int(counts[b, s])
                 row.extend(int(t) for t in toks[b, s, :c])
                 self._c_spec_accepted.inc(max(0, c - 1))
+                accepted += max(0, c - 1)
+            if accepted and slots[b] is not None:
+                self._cost.note_saved(slots[b].req, spec_tokens=accepted)
             emitted.append(row)
         return emitted
 

@@ -444,6 +444,10 @@ class WatchdogRunner:
                         request_id=rid, text=text,
                         finish_reason="wedged", error=err)
                     self.sched._c_wedged.inc()
+                # the wedge bill still lands in the cost ledger (and the
+                # SLO outcome stream): a wedged request is exactly the
+                # kind of waste per-tenant accounting must show
+                self.sched.cost_finish(req, res)
                 if on_result is not None:
                     on_result(res, self._dead_submit)
             out.append(res)

@@ -94,6 +94,16 @@ class Job:
     # persisted in the journal header, restored by recover() — a resumed
     # job CONTINUES its trace instead of starting an anonymous one
     trace_id: str | None = None
+    # cost-attribution tenant (docs/OBSERVABILITY.md § Request-cost
+    # ledger): the submit's X-LMRS-Tenant, defaulting to the job's own
+    # id — persisted in the journal header like the trace id, stamped on
+    # every chunk/reduce request the job runs, so GET /v1/usage rolls up
+    # per job with no extra machinery
+    tenant: str | None = None
+    # ledger usage rolled up from this process-life's results
+    # (obs.merge_usage shape; resumed work re-billed on recompute only —
+    # journal-answered chunks cost nothing, which is the point)
+    usage: dict = field(default_factory=dict)
     # progress (GET /v1/jobs/<id> partial-progress contract)
     n_chunks: int = 0
     chunks_done: int = 0
@@ -217,7 +227,8 @@ class JobManager:
     # ------------------------------------------------------------- public
 
     def submit(self, transcript_data: dict, params: dict | None = None,
-               trace_id: str | None = None) -> Job:
+               trace_id: str | None = None,
+               tenant: str | None = None) -> Job:
         """Persist + queue a job; returns immediately (POST /v1/jobs).
         Content-addressed: an identical (transcript, params) submit
         returns the existing job — live jobs dedupe, terminal
@@ -267,6 +278,10 @@ class JobManager:
                 from lmrs_tpu.obs import new_trace_id
 
                 job.trace_id = trace_id or new_trace_id()
+            if job.tenant is None:
+                # the submit's tenant wins; anonymous submits bill to the
+                # job's own identity (per-job usage rollups for free)
+                job.tenant = tenant or f"job:{jid[:24]}"
         # Disk I/O OUTSIDE the lock: the fsync'd header append must not
         # serialize every get()/jobs()/stats() reader behind the disk.  A
         # concurrent duplicate submit finds the registered job and returns
@@ -288,6 +303,7 @@ class JobManager:
                     "type": jl.REC_HEADER, "job_id": jid, "fingerprint": fp,
                     "transcript_sha": jl.job_id_for(transcript_data, ""),
                     "trace_id": job.trace_id,
+                    "tenant": job.tenant,
                     "created_t": job.created_t})
         except Exception as e:
             # the registered-but-unqueued job must not linger "queued"
@@ -376,6 +392,11 @@ class JobManager:
                 header_trace = (state["header"] or {}).get("trace_id")
                 if isinstance(header_trace, str) and header_trace:
                     job.trace_id = header_trace
+                # a resumed job keeps billing to its original tenant
+                header_tenant = (state["header"] or {}).get("tenant")
+                job.tenant = (header_tenant
+                              if isinstance(header_tenant, str)
+                              and header_tenant else f"job:{jid[:24]}")
                 if state["done"] is not None:
                     self._finish_from_record(job, state["done"])
                     continue
@@ -409,6 +430,7 @@ class JobManager:
             "created_t": job.created_t,
             "recovered": job.recovered,
             "trace_id": job.trace_id,
+            "tenant": job.tenant,
             "progress": {
                 "num_chunks": job.n_chunks,
                 "chunks_done": job.chunks_done,
@@ -420,6 +442,10 @@ class JobManager:
         }
         if job.result is not None:
             doc["result"] = job.result
+        if job.usage:
+            # ledger rollup over THIS process life's engine work (journal-
+            # answered chunks cost nothing — the savings ARE the feature)
+            doc["usage"] = job.usage
         if job.degraded_reasons:
             doc["degraded_reasons"] = job.degraded_reasons
         if job.error is not None:
@@ -572,7 +598,7 @@ class JobManager:
             self._append(job, {
                 "type": jl.REC_HEADER, "job_id": job.job_id,
                 "fingerprint": job.fingerprint, "created_t": job.created_t,
-                "trace_id": job.trace_id})
+                "trace_id": job.trace_id, "tenant": job.tenant})
 
         transcript = json.loads(job.req_path.read_text("utf-8"))["transcript"]
         params = job.params
@@ -602,6 +628,7 @@ class JobManager:
                 **{k: v for k, v in hdr0.items() if k != "type"},
                 "type": jl.REC_HEADER, "job_id": job.job_id,
                 "fingerprint": job.fingerprint, "created_t": job.created_t,
+                "tenant": job.tenant,
                 "num_chunks": len(chunks)})
 
         # ---- resume: rehydrate journaled chunk summaries (errored
@@ -630,7 +657,16 @@ class JobManager:
                         "reduce node(s) from the journal", job.job_id,
                         resumed, len(chunks), len(state["nodes"]))
 
-        executor = MapExecutor(self.engine, self.config.engine)
+        from lmrs_tpu.engine.api import TenantStampEngine
+
+        def _publish_usage(snap: dict) -> None:
+            # atomic reference swap: status_doc serializes whatever
+            # snapshot it holds — never a dict a merge is resizing
+            job.usage = snap
+
+        stamp = TenantStampEngine(self.engine, job.tenant,
+                                  publish=_publish_usage, seed=job.usage)
+        executor = MapExecutor(stamp, self.config.engine)
         job._executor = executor
         self._run_map(job, executor, chunks, map_prompt, summary_type,
                       sys_prompt)

@@ -171,6 +171,14 @@ class LiveSession:
     created_t: float = field(default_factory=time.time)
     recovered: bool = False
     trace_id: str | None = None
+    # cost-attribution tenant (docs/OBSERVABILITY.md § Request-cost
+    # ledger): the create's X-LMRS-Tenant, defaulting to the session's
+    # own id — persisted in the session header like the trace id and
+    # stamped on every refresh request, so GET /v1/usage rolls up per
+    # session for free
+    tenant: str | None = None
+    # ledger usage rolled up from this process-life's refresh waves
+    usage: dict = field(default_factory=dict)
     journal: jl.Journal | None = None
     closed: bool = False
     # transcript + chunking state (all appended-so-far; serialized by the
@@ -293,7 +301,8 @@ class SessionManager:
 
     def create(self, params: dict | None = None,
                session_id: str | None = None,
-               trace_id: str | None = None) -> LiveSession:
+               trace_id: str | None = None,
+               tenant: str | None = None) -> LiveSession:
         """Open a session (POST /v1/sessions).  ``session_id`` may be
         client-supplied (stable id across client retries; validated);
         otherwise one is minted.  Re-creating an existing live session
@@ -312,12 +321,13 @@ class SessionManager:
                 from lmrs_tpu.obs import new_trace_id
 
                 session.trace_id = new_trace_id()
+            session.tenant = tenant or f"session:{sid[:24]}"
             self._c_opened.inc()
             self._g_active.set(self._active_count())
         self._append(session, {
             "type": REC_SESSION, "session_id": sid, "fingerprint": fp,
             "params": params, "created_t": session.created_t,
-            "trace_id": session.trace_id})
+            "trace_id": session.trace_id, "tenant": session.tenant})
         tr = get_tracer()
         if tr:
             tr.instant("session_open", pid=PID_PIPELINE,
@@ -478,6 +488,11 @@ class SessionManager:
                     header_trace = state["header"].get("trace_id")
                     if isinstance(header_trace, str) and header_trace:
                         session.trace_id = header_trace
+                    header_tenant = state["header"].get("tenant")
+                    session.tenant = (header_tenant
+                                      if isinstance(header_tenant, str)
+                                      and header_tenant
+                                      else f"session:{sid[:24]}")
                     self._g_active.set(self._active_count())
                 self._rehydrate(session, state, wal, stale=stale)
             except Exception as e:  # noqa: BLE001 - degrade per session
@@ -513,6 +528,7 @@ class SessionManager:
             "created_t": session.created_t,
             "recovered": session.recovered,
             "trace_id": session.trace_id,
+            "tenant": session.tenant,
             "params": session.params,
             "append_seq": session.append_seq,
             "num_segments": session.n_raw_segments,
@@ -524,6 +540,10 @@ class SessionManager:
                 "pending_tokens": session.stale_tokens,
             },
         }
+        if session.usage:
+            # ledger rollup over THIS process life's refresh engine work
+            # (journal/cache-answered nodes cost nothing — the point)
+            doc["usage"] = session.usage
         if session.journal is not None:
             doc["journal"] = session.journal.stats()
         return doc
@@ -693,7 +713,8 @@ class SessionManager:
                     "fingerprint": session.fingerprint,
                     "params": session.params,
                     "created_t": session.created_t,
-                    "trace_id": session.trace_id})
+                    "trace_id": session.trace_id,
+                    "tenant": session.tenant})
             tokens_by_seq: dict[int, int] = {}
             for seq in sorted(state["segments"]):
                 raw = state["segments"][seq]
@@ -793,7 +814,17 @@ class SessionManager:
             engine_cfg = dataclasses.replace(
                 engine_cfg,
                 request_deadline_s=self.live_cfg.interactive_deadline_s)
-        executor = MapExecutor(self.engine, engine_cfg)
+        from lmrs_tpu.engine.api import TenantStampEngine
+
+        def _publish_usage(snap: dict) -> None:
+            # atomic reference swap (see jobs/manager.py): status docs
+            # serialize a snapshot, never the dict a merge is resizing
+            session.usage = snap
+
+        stamp = TenantStampEngine(self.engine, session.tenant,
+                                  publish=_publish_usage,
+                                  seed=session.usage)
+        executor = MapExecutor(stamp, engine_cfg)
         with session.ctl:
             session._executor = executor
 

@@ -12,6 +12,7 @@ from lmrs_tpu.obs.flight import (
     postmortem_dir,
     validate_postmortem_file,
 )
+from lmrs_tpu.obs.ledger import DEFAULT_TENANT, CostLedger, merge_usage
 from lmrs_tpu.obs.metrics import (
     DEFAULT_LATENCY_BUCKETS_S,
     MS_LATENCY_BUCKETS,
@@ -29,6 +30,14 @@ from lmrs_tpu.obs.perf import (
     DispatchAttribution,
     profile_capture_active,
     start_profile_capture,
+)
+from lmrs_tpu.obs.slo import (
+    DEFAULT_SPECS,
+    SLOEngine,
+    SLOSpec,
+    specs_from_env,
+    state_rank,
+    worst_state,
 )
 from lmrs_tpu.obs.trace import (
     PID_ENGINE,
@@ -57,6 +66,9 @@ __all__ = [
     "DispatchAttribution", "profile_capture_active", "start_profile_capture",
     "POSTMORTEM_SCHEMA", "dump_postmortem", "postmortem_dir",
     "validate_postmortem_file",
+    "DEFAULT_TENANT", "CostLedger", "merge_usage",
+    "DEFAULT_SPECS", "SLOEngine", "SLOSpec", "specs_from_env",
+    "state_rank", "worst_state",
     "PID_ENGINE", "PID_PIPELINE", "PID_STITCH", "TID_SCHED",
     "TRACE_TRACK_PREFIX", "Tracer",
     "disable_tracing", "enable_tracing", "export_current", "get_tracer",

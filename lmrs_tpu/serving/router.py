@@ -268,7 +268,8 @@ class RouterEngine:
                  clock=time.monotonic, prefill_hosts: list[str] = (),
                  decode_hosts: list[str] = (),
                  prefix_route: bool | None = None,
-                 summary_ttl_s: float | None = None):
+                 summary_ttl_s: float | None = None,
+                 slo_route: bool | None = None):
         # Per-role pools (disaggregated serving, docs/SERVING.md): when
         # BOTH the prefill and decode pools have members, requests run the
         # two-tier handoff — admission to the prefill pool, KV-page ticket
@@ -382,6 +383,17 @@ class RouterEngine:
         self._prefix_routed = 0     # guarded-by: _stats_lock
         self._prefix_predicted = 0  # guarded-by: _stats_lock
         self._prefix_fallback = 0   # guarded-by: _stats_lock
+        # SLO-aware placement (docs/SERVING.md § routing policy): each
+        # host's /healthz now carries its burn-rate SLO state (obs/slo.py)
+        # and the dispatch order demotes degraded hosts as a GRADED
+        # penalty (ok < warn < critical) BEFORE the breaker would have to
+        # open — a host converting overload into deadline misses sheds
+        # traffic while it still answers probes.  LMRS_SLO_ROUTE=0
+        # restores pure load/health ordering byte-for-byte (the A/B
+        # arm); states ride the same summary cache as prefix routing.
+        self.slo_route = (env_bool("LMRS_SLO_ROUTE", True)
+                          if slo_route is None else bool(slo_route))
+        self._slo_penalized = 0     # guarded-by: _stats_lock
         # Tail hedging (LMRS_HEDGE_MS, default 0 = off): a straggling
         # NON-STREAMED request duplicates to a sibling host after a
         # p99-derived delay; first non-error result wins, the loser is
@@ -495,6 +507,10 @@ class RouterEngine:
                                  "predicted": self._prefix_predicted,
                                  "fallback": self._prefix_fallback,
                                  "summary_age_s": ages},
+                "slo_route": {"enabled": self.slo_route,
+                              "penalized": self._slo_penalized,
+                              "states": {h.netloc: self._slo_penalty(h)
+                                         for h in self.hosts}},
                 "per_host": per}
 
     def prometheus_metrics(self) -> str:
@@ -575,6 +591,10 @@ class RouterEngine:
                         "times this host's breaker opened "
                         "(consecutive-failure threshold crossed)"
                         ).inc(h.breaker_opens)
+            reg.gauge("lmrs_router_host_slo_state",
+                      "the host's last published SLO burn-rate state "
+                      "(0=ok/unknown, 1=warn, 2=critical)").set(
+                float(self._slo_penalty(h)))
             pages.append(add_label_to_exposition(
                 reg.render_prometheus(), "host", h.netloc))
         # Per-role pool gauges (disaggregated serving).  Only pools with
@@ -622,8 +642,69 @@ class RouterEngine:
         hreg.counter("lmrs_router_hedge_wins_total",
                      "hedged requests whose DUPLICATE leg answered first "
                      "(the loser was hung up)").inc(self._hedge_wins)
+        hreg.counter("lmrs_router_slo_penalized_total",
+                     "dispatch orders whose first choice was demoted by a "
+                     "published SLO state (LMRS_SLO_ROUTE)"
+                     ).inc(self._slo_penalized)
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
+
+    # ------------------------------------------------------ fleet usage
+
+    def usage_report(self) -> dict:
+        """Fleet-wide ``GET /v1/usage``: every backend's per-tenant
+        rollups pulled concurrently (control-plane: bare connections,
+        short timeout, dispatch pool) and merged through the ONE merge
+        rule (obs.merge_usage) — per-tenant fleet rollups sum to the
+        fleet totals by construction.  Hosts that are down or ledger-less
+        stay visible in ``unreachable``."""
+        from lmrs_tpu.obs.ledger import merge_usage, totals_from_tenants
+
+        def fetch(h: _Host):
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(h.netloc, timeout=5.0)
+                conn.request("GET", "/v1/usage")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read())
+            except Exception as e:  # noqa: BLE001 - best-effort per host
+                logger.debug("usage fetch failed for %s: %s: %s",
+                             h.netloc, type(e).__name__, e)
+                return None
+            finally:
+                if conn is not None:
+                    conn.close()
+
+        futures = [(h, self._pool.submit(fetch, h)) for h in self.hosts]
+        tenants: dict[str, dict] = {}
+        per_host: list[dict] = []
+        unreachable: list[str] = []
+        enabled = False
+        for h, fut in futures:
+            try:
+                doc = fut.result(timeout=10.0)
+            except Exception:  # noqa: BLE001 - pool saturation/timeout
+                doc = None
+            if not isinstance(doc, dict):
+                unreachable.append(h.netloc)
+                continue
+            enabled = enabled or bool(doc.get("enabled"))
+            per_host.append({"host": h.netloc,
+                             "totals": doc.get("totals") or {}})
+            for t, roll in (doc.get("tenants") or {}).items():
+                merge_usage(tenants.setdefault(t, {}), roll)
+        totals = totals_from_tenants(tenants)
+        with self._stats_lock:
+            router = {"hedges": self._hedges,
+                      "hedge_wins": self._hedge_wins,
+                      "handoff_retries": self._handoff_retries,
+                      "slo_penalized": self._slo_penalized}
+        return {"object": "usage", "enabled": enabled, "fleet": True,
+                "tenants": tenants, "totals": totals,
+                "per_host": per_host, "unreachable": unreachable,
+                "router": router}
 
     # ------------------------------------------------------ trace stitching
 
@@ -677,7 +758,8 @@ class RouterEngine:
     # ------------------------------------------------------- job forwarding
 
     def job_request(self, method: str, path: str, body: dict | None,
-                    trace_id: str | None = None) -> tuple[int, dict]:
+                    trace_id: str | None = None,
+                    tenant: str | None = None) -> tuple[int, dict]:
         """Forward one /v1/jobs call to the backend fleet (the front
         server's ``_job_http`` delegates here when it has no local
         JobManager).  Placement is STICKY: a submit hashes its transcript
@@ -704,7 +786,8 @@ class RouterEngine:
                     continue  # same optimism as _targets: try someone
                 try:
                     status, payload = self._job_call(host, method, path,
-                                                     body, trace_id)
+                                                     body, trace_id,
+                                                     tenant=tenant)
                 except Exception as e:  # noqa: BLE001 - next host
                     host.note_failed()
                     last = (502, {"error": {
@@ -774,7 +857,8 @@ class RouterEngine:
     # -------------------------------------------- live-session forwarding
 
     def session_request(self, method: str, path: str, body: dict | None,
-                        trace_id: str | None = None) -> tuple[int, dict]:
+                        trace_id: str | None = None,
+                        tenant: str | None = None) -> tuple[int, dict]:
         """Forward one /v1/sessions call (the front server's
         ``_session_http`` delegates here when it has no local
         SessionManager).  Placement is STICKY BY SESSION ID — stronger
@@ -829,7 +913,8 @@ class RouterEngine:
                     continue
                 try:
                     status, payload = self._job_call(host, method, path,
-                                                     body, trace_id)
+                                                     body, trace_id,
+                                                     tenant=tenant)
                 except Exception as e:  # noqa: BLE001 - next host
                     host.note_failed()
                     last = (502, {"error": {
@@ -958,7 +1043,8 @@ class RouterEngine:
     def _job_call(self, host: _Host, method: str, path: str,
                   body: dict | None,
                   trace_id: str | None = None,
-                  timeout: float = 10.0) -> tuple[int, dict]:
+                  timeout: float = 10.0,
+                  tenant: str | None = None) -> tuple[int, dict]:
         """One forwarded job/session call.  A bare connection on purpose
         (like probes): the control plane must not consume the request
         path's ``router.connect`` fault occurrences — chaos plans stay
@@ -972,6 +1058,8 @@ class RouterEngine:
         headers = {"Content-Type": "application/json"}
         if trace_id:
             headers["X-LMRS-Trace"] = trace_id
+        if tenant:
+            headers["X-LMRS-Tenant"] = tenant
         try:
             conn.request(method, path,
                          body=None if body is None else json.dumps(body),
@@ -1083,9 +1171,40 @@ class RouterEngine:
         order = [pool[(start + k) % n] for k in range(n)]
         healthy = [h for h in order if h.healthy]
         out = healthy or order
+        if self.slo_route:
+            # graded SLO demotion (docs/SERVING.md § routing policy):
+            # stable sort by published burn-rate state, so an ok fleet
+            # keeps today's rotation byte-for-byte and a degraded host
+            # sinks in the failover order instead of vanishing — it still
+            # serves when everyone is degraded (the _targets optimism)
+            penalties = {h.netloc: self._slo_penalty(h) for h in out}
+            if any(penalties.values()):
+                first = out[0]
+                out = sorted(out, key=lambda h: penalties[h.netloc])
+                if out and out[0] is not first:
+                    with self._stats_lock:
+                        self._slo_penalized += 1
+            # a critical sticky preference is NOT fronted: prefix warmth
+            # never outranks a host that is actively burning its SLOs
+            if prefer is not None and penalties.get(prefer.netloc, 0) >= 2:
+                prefer = None
         if prefer is not None and prefer in out:
             out = [prefer] + [h for h in out if h is not prefer]
         return out
+
+    def _slo_penalty(self, host: _Host) -> int:
+        """Graded placement penalty from the host's last published SLO
+        state (0 ok/unknown, 1 warn, 2 critical).  Stale summaries decay
+        to 0 — a host that stopped publishing must not stay penalized
+        forever on old news."""
+        from lmrs_tpu.obs.slo import state_rank
+
+        with self._summary_lock:
+            s = self._summaries.get(host.netloc)
+            if s is None or (self._clock() - s.get("slo_at", s["at"])
+                             > self.summary_ttl_s):
+                return 0
+            return state_rank(s.get("slo"))
 
     # ------------------------------------------------- prefix-aware routing
 
@@ -1095,7 +1214,7 @@ class RouterEngine:
         older than half the TTL.  Stale summaries only degrade placement
         quality; they never block a wave — fetches ride the dispatch
         pool, results land under the summary lock."""
-        if not self.prefix_route:
+        if not (self.prefix_route or self.slo_route):
             return
         now = self._clock()
         due: list[_Host] = []
@@ -1129,22 +1248,36 @@ class RouterEngine:
             if conn is not None:
                 conn.close()
         smap: dict[str, dict] | None = None
+        slo_state: str | None = None
         if isinstance(doc, dict):
             smap = {}
             for ent in doc.get("prefix_summary") or ():
                 if isinstance(ent, dict) and ent.get("hash"):
                     smap[str(ent["hash"])] = ent
+            slo = doc.get("slo")
+            if isinstance(slo, dict) and slo.get("enabled"):
+                slo_state = str(slo.get("state") or "ok")
         with self._summary_lock:
+            now = self._clock()
+            slo_at = now
             if smap is None:
                 # transient fetch failure: keep the last-known-good map
                 # (stale-but-recent beats empty — an empty overwrite
                 # would bounce same-preamble traffic off the warm host
                 # for a whole TTL) and stamp the time only, so the host
-                # is re-probed at the normal cadence, not hammered
+                # is re-probed at the normal cadence, not hammered.
+                # The SLO state keeps its LAST-SUCCESS stamp instead:
+                # the penalty must decay on a host that stopped
+                # publishing (re-stamping would penalize it forever on
+                # old news — the opposite of the prefix-map tradeoff,
+                # where stale warmth is still the best placement guess)
                 prev = self._summaries.get(host.netloc)
                 smap = prev["map"] if prev else {}
-            self._summaries[host.netloc] = {"at": self._clock(),
-                                            "map": smap}
+                slo_state = (prev or {}).get("slo")
+                slo_at = (prev or {}).get("slo_at", 0.0)
+            self._summaries[host.netloc] = {"at": now, "map": smap,
+                                            "slo": slo_state,
+                                            "slo_at": slo_at}
             self._summary_inflight.discard(host.netloc)
 
     def _prefix_target(self, req: GenerationRequest, role: str = "full"
@@ -1657,6 +1790,8 @@ class RouterEngine:
             headers = {"Content-Type": "application/json"}
             if req.trace_id:
                 headers["X-LMRS-Trace"] = req.trace_id
+            if req.tenant:
+                headers["X-LMRS-Tenant"] = req.tenant
             conn.request("POST", "/v1/chat/completions",
                          body=json.dumps(body), headers=headers)
             if rid in cancelled:
@@ -1679,6 +1814,7 @@ class RouterEngine:
                 prompt_tokens=int(usage.get("prompt_tokens", 0)),
                 completion_tokens=int(usage.get("completion_tokens", 0)),
                 finish_reason=choice.get("finish_reason") or "stop",
+                usage=usage.get("cost") or None,
             )
         finally:
             with self._inflight_lock:
@@ -1728,6 +1864,8 @@ class RouterEngine:
             headers = {"Content-Type": "application/json"}
             if req.trace_id:
                 headers["X-LMRS-Trace"] = req.trace_id
+            if req.tenant:
+                headers["X-LMRS-Tenant"] = req.tenant
             conn.request("POST", "/v1/chat/completions", body=payload,
                          headers=headers)
             # close the cancel() race on an unconnected conn: cancel adds
@@ -1757,6 +1895,9 @@ class RouterEngine:
                 prompt_tokens=int(usage.get("prompt_tokens", 0)),
                 completion_tokens=int(usage.get("completion_tokens", 0)),
                 finish_reason=choice.get("finish_reason") or "stop",
+                # the backend ledger's bill rides back through the router
+                # (fronting servers re-surface it; jobs roll it up)
+                usage=usage.get("cost") or None,
             )
         finally:
             with self._inflight_lock:
@@ -1840,4 +1981,5 @@ class RouterEngine:
             prompt_tokens=int(usage.get("prompt_tokens", 0)),
             completion_tokens=int(usage.get("completion_tokens",
                                             len(text_parts))),
-            finish_reason=finish)
+            finish_reason=finish,
+            usage=usage.get("cost") or None)

@@ -60,6 +60,14 @@ def clean_trace_id(raw) -> str | None:
     return raw if _TRACE_ID_RE.match(raw) else None
 
 
+def clean_tenant(raw) -> str | None:
+    """A wire-supplied ``X-LMRS-Tenant`` label, validated against the
+    same safe alphabet as trace ids (it rides journals, usage rollup
+    keys, and Prometheus-adjacent docs); None when absent/garbage — the
+    ledger then bills the "default" tenant."""
+    return clean_trace_id(raw)
+
+
 class _Job:
     __slots__ = ("request", "result", "event", "deltas", "rid", "cancelled",
                  "done_cb")
@@ -659,7 +667,19 @@ class EngineHTTPServer:
                         except Exception:  # noqa: BLE001 - stay healthy
                             logger.debug("prefix summary failed",
                                          exc_info=True)
+                    # burn-rate SLO state rides the probe path too (the
+                    # router's placement penalty reads it); guarded the
+                    # same way — health must answer even if it breaks
+                    slo = getattr(outer.engine, "slo_report", None)
+                    if slo is not None:
+                        try:
+                            payload["slo"] = slo()
+                        except Exception:  # noqa: BLE001 - stay healthy
+                            logger.debug("slo report failed",
+                                         exc_info=True)
                     self._send(200, payload)
+                elif self.path == "/v1/usage":
+                    self._get_usage()
                 elif self.path == "/v1/trace":
                     self._get_trace()
                 elif self.path.startswith("/v1/handoff/"):
@@ -753,6 +773,16 @@ class EngineHTTPServer:
                 self._trace_minted = supplied is None
                 req.trace_id = supplied or new_trace_id()
 
+            def _apply_tenant(self, req: GenerationRequest,
+                              body: dict) -> None:
+                """Anchor the request's cost-attribution tenant from the
+                ``X-LMRS-Tenant`` header (or the ``tenant`` body field —
+                header wins), minted at THIS ingress and propagated like
+                the trace id.  Absent/garbage leaves None: the ledger
+                bills the "default" tenant."""
+                req.tenant = (clean_tenant(self.headers.get("X-LMRS-Tenant"))
+                              or clean_tenant(body.get("tenant")))
+
             def _apply_deadline(self, req: GenerationRequest,
                                 body: dict) -> bool:
                 """Anchor the wire deadline budget (RELATIVE seconds from
@@ -781,6 +811,29 @@ class EngineHTTPServer:
                     return False
                 req.deadline_s = time.time() + budget
                 return True
+
+            # ---------------------------------------------- usage export
+
+            def _get_usage(self) -> None:
+                """``GET /v1/usage``: this host's per-tenant cost-ledger
+                rollups (or, when the engine is a router, the FLEET
+                aggregation — RouterEngine.usage_report pulls every
+                backend's page and merges).  501 when the engine carries
+                no ledger hook."""
+                hook = getattr(outer.engine, "usage_report", None)
+                if hook is None:
+                    self._send(501, {"error": {
+                        "message": "this engine backend has no cost "
+                                   "ledger", "type": "usage_error"}})
+                    return
+                try:
+                    self._send(200, hook())
+                except Exception as e:  # noqa: BLE001 - marked error
+                    logger.exception("usage report failed")
+                    self._send(502, {"error": {
+                        "message": f"usage report failed: "
+                                   f"{type(e).__name__}: {e}",
+                        "type": "usage_error"}})
 
             # --------------------------------------- trace export / profile
 
@@ -931,6 +984,10 @@ class EngineHTTPServer:
                 if (getattr(self, "_trace_minted", False)
                         and clean_trace_id(payload.get("trace_id"))):
                     req.trace_id = payload["trace_id"]
+                # same adoption rule for the tenant label: the decode leg
+                # bills to the tenant the prefill leg was billed to
+                if req.tenant is None and clean_tenant(payload.get("tenant")):
+                    req.tenant = payload["tenant"]
                 return True
 
             def do_DELETE(self):
@@ -960,7 +1017,9 @@ class EngineHTTPServer:
                     code, payload = outer._job_http(
                         "POST", self.path, body,
                         trace_id=clean_trace_id(
-                            self.headers.get("X-LMRS-Trace")))
+                            self.headers.get("X-LMRS-Trace")),
+                        tenant=clean_tenant(
+                            self.headers.get("X-LMRS-Tenant")))
                     self._send(code, payload)
                     return
                 if (self.path == "/v1/sessions"
@@ -968,13 +1027,16 @@ class EngineHTTPServer:
                     code, payload = outer._session_http(
                         "POST", self.path, body,
                         trace_id=clean_trace_id(
-                            self.headers.get("X-LMRS-Trace")))
+                            self.headers.get("X-LMRS-Trace")),
+                        tenant=clean_tenant(
+                            self.headers.get("X-LMRS-Tenant")))
                     self._send(code, payload)
                     return
                 try:
                     if self.path == "/v1/chat/completions":
                         req = _chat_to_request(body, outer.max_tokens_cap)
                         self._apply_trace(req)
+                        self._apply_tenant(req, body)
                         if not self._apply_deadline(req, body):
                             return
                         if not self._apply_handoff(req, body):
@@ -1000,6 +1062,7 @@ class EngineHTTPServer:
                     elif self.path == "/v1/messages":
                         req = _messages_to_request(body, outer.max_tokens_cap)
                         self._apply_trace(req)
+                        self._apply_tenant(req, body)
                         if not self._apply_deadline(req, body):
                             return
                         if not self._apply_handoff(req, body):
@@ -1122,7 +1185,9 @@ class EngineHTTPServer:
                         chunk({}, finish=res.finish_reason,
                               usage={"prompt_tokens": res.prompt_tokens,
                                      "completion_tokens": res.completion_tokens,
-                                     "total_tokens": res.total_tokens}
+                                     "total_tokens": res.total_tokens,
+                                     **({"cost": res.usage}
+                                        if res.usage else {})}
                               if want_usage else None)
                     self._sse("[DONE]")
                 except OSError:  # client went away: stop writing AND abort
@@ -1173,7 +1238,9 @@ class EngineHTTPServer:
                         "delta": {"stop_reason": _anthropic_stop_reason(res),
                                   "stop_sequence": res.stop_sequence},
                         "usage": {"input_tokens": res.prompt_tokens,
-                                  "output_tokens": res.completion_tokens}}),
+                                  "output_tokens": res.completion_tokens,
+                                  **({"cost": res.usage}
+                                     if res.usage else {})}}),
                         event="message_delta")
                     self._sse(json.dumps({"type": "message_stop"}),
                               event="message_stop")
@@ -1200,6 +1267,9 @@ class EngineHTTPServer:
                         "prompt_tokens": res.prompt_tokens,
                         "completion_tokens": res.completion_tokens,
                         "total_tokens": res.total_tokens,
+                        # ledger extension: absent (byte-identical wire)
+                        # with LMRS_COST_LEDGER=0
+                        **({"cost": res.usage} if res.usage else {}),
                     },
                 })
 
@@ -1218,7 +1288,8 @@ class EngineHTTPServer:
                     "stop_reason": _anthropic_stop_reason(res),
                     "stop_sequence": res.stop_sequence,
                     "usage": {"input_tokens": res.prompt_tokens,
-                              "output_tokens": res.completion_tokens},
+                              "output_tokens": res.completion_tokens,
+                              **({"cost": res.usage} if res.usage else {})},
                 })
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
@@ -1227,7 +1298,8 @@ class EngineHTTPServer:
     # ------------------------------------------------ durable-job plumbing
 
     def _job_http(self, method: str, path: str, body: dict | None,
-                  trace_id: str | None = None):
+                  trace_id: str | None = None,
+                  tenant: str | None = None):
         """The /v1/jobs surface: returns ``(status, payload)``.
 
         Local-first: a configured JobManager answers here.  Without one,
@@ -1240,7 +1312,8 @@ class EngineHTTPServer:
             forward = getattr(self.engine, "job_request", None)
             if forward is not None:
                 try:
-                    return forward(method, path, body, trace_id=trace_id)
+                    return forward(method, path, body, trace_id=trace_id,
+                                   tenant=tenant)
                 except Exception as e:  # noqa: BLE001 - marked, never a 500 crash
                     logger.exception("job forward failed")
                     return 502, {"error": {
@@ -1262,7 +1335,7 @@ class EngineHTTPServer:
             try:
                 job = self.jobs.submit(transcript,
                                        (body or {}).get("params"),
-                                       trace_id=trace_id)
+                                       trace_id=trace_id, tenant=tenant)
             except ValueError as e:  # unknown/malformed param values
                 return 400, {"error": {"message": str(e),
                                        "type": "job_error"}}
@@ -1289,7 +1362,8 @@ class EngineHTTPServer:
     # ---------------------------------------------- live-session plumbing
 
     def _session_http(self, method: str, path: str, body: dict | None,
-                      trace_id: str | None = None, query: str = ""):
+                      trace_id: str | None = None, query: str = "",
+                      tenant: str | None = None):
         """The /v1/sessions surface: returns ``(status, payload)``.
 
         Local-first like jobs: a configured SessionManager answers here;
@@ -1302,7 +1376,8 @@ class EngineHTTPServer:
             if forward is not None:
                 try:
                     full = path + (f"?{query}" if query else "")
-                    return forward(method, full, body, trace_id=trace_id)
+                    return forward(method, full, body, trace_id=trace_id,
+                                   tenant=tenant)
                 except Exception as e:  # noqa: BLE001 - marked, never a crash
                     logger.exception("session forward failed")
                     return 502, {"error": {
@@ -1318,7 +1393,8 @@ class EngineHTTPServer:
             if method == "POST" and path.rstrip("/") == "/v1/sessions":
                 session = self.live.create(body.get("params"),
                                            session_id=body.get("session_id"),
-                                           trace_id=trace_id)
+                                           trace_id=trace_id,
+                                           tenant=tenant)
                 return 200, self.live.status_doc(session)
             if method == "GET" and path.rstrip("/") == "/v1/sessions":
                 return 200, {"object": "list",

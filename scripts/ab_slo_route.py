@@ -1,0 +1,151 @@
+"""SLO-aware routing A/B over a mock fleet (ISSUE 15 acceptance).
+
+Two arms over the SAME traffic against N mock-backend lmrs-serve
+instances behind a RouterEngine, with ONE host forced into a degraded
+burn-rate state (its engine carries real per-request latency against a
+tight TTFT objective, so the SLO engine derives ``warn`` from actual
+samples — nothing is hard-coded):
+
+* ``slo_off``: ``slo_route=False`` — today's load/health ordering;
+* ``slo_routed``: the router reads each host's published ``/healthz``
+  SLO state and demotes degraded hosts as a graded placement penalty
+  (serving/router.py ``_targets``).
+
+PASS gate: the degraded host's traffic share DROPS in the routed arm
+while the two arms' outputs stay token-identical (placement never
+changes text — the mock is deterministic per prompt), and the fleet
+``GET /v1/usage`` per-tenant rollups sum to the router-reported totals
+exactly (the ledger-conservation acceptance, fleet level).
+
+CPU-only and fast (~seconds); the same flow is tier-1 gated in
+tests/test_cost_slo.py.
+"""
+
+from __future__ import annotations
+
+import _pathfix  # noqa: F401
+
+import json
+import sys
+import time
+
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.obs.slo import SLOEngine, SLOSpec
+from lmrs_tpu.serving.router import RouterEngine
+from lmrs_tpu.serving.server import EngineHTTPServer
+from lmrs_tpu.utils.env import env_int
+
+N_HOSTS = env_int("LMRS_SLO_AB_HOSTS", 3, lo=2, hi=8)
+N_REQS = env_int("LMRS_SLO_AB_REQUESTS", 30, lo=8)
+DEGRADED_LATENCY_S = 0.08
+TTFT_TARGET_MS = 50.0  # degraded host burns ~1.6x -> warn, healthy ~0x
+
+
+def mk_fleet() -> list[EngineHTTPServer]:
+    """N mock hosts, host 0 degraded: real request latency against a
+    tight TTFT p95 objective — its OWN samples put it in warn."""
+    servers = []
+    for i in range(N_HOSTS):
+        eng = MockEngine(seed=0, latency_s=DEGRADED_LATENCY_S if i == 0
+                         else 0.0)
+        # identical objective on every host (the degraded one differs by
+        # BEHAVIOR, not configuration); short windows so the A/B settles
+        eng.slo = SLOEngine(
+            enabled=True, fast_s=30.0, slow_s=30.0, hold_s=5.0,
+            specs=(SLOSpec("ttft_p95_ms", "latency_p95", TTFT_TARGET_MS),))
+        servers.append(EngineHTTPServer(eng, port=0))
+    for s in servers:
+        s.start_background()
+    return servers
+
+
+def mk_requests() -> list[GenerationRequest]:
+    return [GenerationRequest(
+        prompt=f"Chunk {i}: summarize this deterministic mock content "
+               f"item number {i} carefully and completely.",
+        request_id=i, temperature=0.0, max_new_tokens=48,
+        tenant=f"team{i % 2}") for i in range(N_REQS)]
+
+
+def run_arm(servers: list[EngineHTTPServer], routed: bool) -> dict:
+    hosts = [f"127.0.0.1:{s.port}" for s in servers]
+    router = RouterEngine(hosts, timeout_s=30.0, prefix_route=False,
+                          slo_route=routed, summary_ttl_s=0.5)
+    # warm-up: populate every host's SLO windows past the latency
+    # min-sample guard (min_events samples per host) + the router's
+    # summary cache (states publish through /healthz on the wave
+    # cadence); the measured window starts at the per-host served
+    # counts AFTER it
+    for k in range(4 * N_HOSTS):
+        router.generate_batch([GenerationRequest(
+            prompt=f"warmup {k}", request_id=10_000 + k,
+            temperature=0.0, max_new_tokens=8)])
+        time.sleep(0.05)
+    time.sleep(0.6)  # one summary TTL: states land in the cache
+    served0 = {h.netloc: h.served for h in router.hosts}
+    texts = {}
+    for req in mk_requests():
+        res = router.generate_batch([req])[0]
+        assert res.error is None, res.error
+        texts[req.prompt] = res.text
+        time.sleep(0.02)
+    served = {h.netloc: h.served - served0[h.netloc]
+              for h in router.hosts}
+    total = sum(served.values())
+    em = router.engine_metrics()
+    usage = router.usage_report()
+    router.shutdown()
+    degraded = hosts[0]
+    return {
+        "arm": "slo_routed" if routed else "slo_off",
+        "served": served,
+        "degraded_host": degraded,
+        "degraded_share": round(served[degraded] / max(total, 1), 3),
+        "slo_states": em["slo_route"]["states"],
+        "penalized": em["slo_route"]["penalized"],
+        "usage_totals": usage["totals"],
+        "usage_tenants": {t: r.get("requests", 0)
+                          for t, r in usage["tenants"].items()},
+        "texts": texts,
+        "usage_doc": usage,
+    }
+
+
+def main() -> int:
+    servers_a = mk_fleet()
+    off = run_arm(servers_a, routed=False)
+    for s in servers_a:
+        s.shutdown()
+    servers_b = mk_fleet()
+    routed = run_arm(servers_b, routed=True)
+    for s in servers_b:
+        s.shutdown()
+
+    identical = off["texts"] == routed["texts"]
+    # fleet-conservation acceptance: per-tenant rollups sum to totals
+    u = routed["usage_doc"]
+    tenant_sum = sum(r.get("device_seconds", 0.0)
+                     for r in u["tenants"].values())
+    conserved = abs(tenant_sum - u["totals"].get("device_seconds", 0.0)) \
+        < 1e-9
+    ok = (routed["degraded_share"] < off["degraded_share"]
+          and identical and conserved and routed["penalized"] > 0)
+    report = {
+        "object": "ab_slo_route",
+        "hosts": N_HOSTS, "requests": N_REQS,
+        "degraded_latency_s": DEGRADED_LATENCY_S,
+        "ttft_target_ms": TTFT_TARGET_MS,
+        "arms": [{k: v for k, v in arm.items()
+                  if k not in ("texts", "usage_doc")}
+                 for arm in (off, routed)],
+        "outputs_token_identical": identical,
+        "usage_conserved": conserved,
+        "status": "PASS" if ok else "FAIL",
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,7 @@ def run_arm(mixed: bool) -> dict:
     sched = eng._scheduler
     sched.reset_latency_stats()
     m0 = dict(sched.metrics)
+    cost0 = sched._cost.report()
     t0 = time.time()
     out = eng.generate_batch([mk(1000 + i, PROMPT_WORDS)
                               for i in range(N_MEAS)])
@@ -98,6 +99,12 @@ def run_arm(mixed: bool) -> dict:
         # measured-window mixed stats (warmup's mixed dispatches excluded,
         # same windowing as decode_dispatches above)
         "mixed_batch": sched._mixed_report(m0),
+        # windowed cost/SLO attribution (ISSUE 15): per-tenant device-
+        # seconds + goodput over the measured wave, and the burn-rate
+        # state the wave left the host in — the A/B now reports WHO paid
+        # for each arm's latency, not just the percentiles
+        "cost": sched._cost.report(cost0),
+        "slo": {"state": sched.slo_report().get("state", "ok")},
         "failed": sum(r.error is not None for r in out),
     }
     eng.shutdown()
