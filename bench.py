@@ -420,6 +420,10 @@ def _scheduler_window(sched, before: dict) -> dict:
         # steps instead of dedicated prefill waves — plus the wave gap
         # percentiles above, the MULTICHIP/BENCH tracking trio
         "mixed_batch": sched._mixed_report(before),
+        # ragged-span unified dispatch (ISSUE 16): span tokens and the
+        # distinct program shapes compiled over the window — the roofline
+        # column perf_sentry tracks for the one-bucket-family collapse
+        "rpa": sched._rpa_report(before),
         # disaggregated handoff over the timed window: export/import
         # counts and orphaned pages are zero on a colocated bench by
         # construction — the block exists so MULTICHIP_* rounds that run

@@ -121,6 +121,16 @@ class MockEngine:
         self._mixed_dispatches = 0  # guarded-by: _mixed_lock
         self._mixed_piggybacked = 0  # guarded-by: _mixed_lock
         self._mixed_fill_sum = 0.0  # guarded-by: _mixed_lock
+        # Ragged-span (RPA) knob parity: the jax scheduler routes every
+        # mixed/continuation dispatch through one span-program family
+        # when LMRS_RPA is on.  The mock mirrors the knob and the
+        # accounting block (span tokens, distinct pow2 compile shapes)
+        # so deviceless CI can assert the metrics surface and the
+        # LMRS_RPA=0 kill switch end-to-end; text is untouched.
+        self.rpa = env_bool("LMRS_RPA", True) and self.mixed_batch
+        self._rpa_span_tokens = 0      # guarded-by: _mixed_lock
+        self._rpa_dispatches = 0       # guarded-by: _mixed_lock
+        self._rpa_shapes: set = set()  # guarded-by: _mixed_lock
         self._tok = ApproxTokenizer()
         # Cost ledger + SLO parity (obs/ledger.py, obs/slo.py): the SAME
         # accounting/knob surface as the jax scheduler, deterministically
@@ -224,6 +234,15 @@ class MockEngine:
                     self._mixed_piggybacked += c
                     self._mixed_fill_sum += min(
                         (n_decode + c) / self.mixed_token_budget, 1.0)
+                    if self.rpa:
+                        total = n_decode + c
+                        self._rpa_dispatches += 1
+                        self._rpa_span_tokens += total
+                        # same pow2 bucket family the scheduler compiles
+                        bucket = 16
+                        while bucket < total:
+                            bucket *= 2
+                        self._rpa_shapes.add(bucket)
                     remaining -= c
 
     def _note_prefix(self, req: GenerationRequest) -> None:
@@ -393,6 +412,16 @@ class MockEngine:
                 "dispatches": d,
                 "fill_ratio": round(f / d, 3) if d else 0.0,
                 "prefill_tokens_piggybacked": p,
+            }
+        with self._mixed_lock:
+            rd, rt, rs = (self._rpa_dispatches, self._rpa_span_tokens,
+                          len(self._rpa_shapes))
+        if rd:
+            out["rpa"] = {
+                "enabled": self.rpa,
+                "dispatches": rd,
+                "span_tokens": rt,
+                "compile_shapes": rs,
             }
         with self._prefix_lock:
             if self._prefix_queries:

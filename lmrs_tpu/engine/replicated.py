@@ -445,12 +445,25 @@ class ReplicatedEngine:
                 "prefill_tokens_piggybacked": sum(
                     b.get("prefill_tokens_piggybacked", 0) for b in mixed),
             }}
+        # ragged-span fleet view (ISSUE 16): same summing shape; compile
+        # shapes ADD across replicas — each compiles its own span family
+        rpa = [b for b in (m.get("rpa") for m in per) if b]
+        rpa_block = {}
+        if rpa:
+            rpa_block = {"rpa": {
+                "enabled": any(b.get("enabled") for b in rpa),
+                "dispatches": sum(b.get("dispatches", 0) for b in rpa),
+                "span_tokens": sum(b.get("span_tokens", 0) for b in rpa),
+                "compile_shapes": sum(
+                    b.get("compile_shapes", 0) for b in rpa),
+            }}
         return {
             "replicas": len(per),
             "healthy_replicas": sum(self._healthy),
             "prefill_tokens": prefill,
             "decode_tokens": decode,
             **mixed_block,
+            **rpa_block,
             "prefill_tokens_per_sec": round(prefill / max(secs, 1e-9), 1),
             "decode_tokens_per_sec": round(decode / max(secs, 1e-9), 1),
             "mean_decode_occupancy": round(

@@ -43,6 +43,7 @@ from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
                                       audit_allocator)
 from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.models.transformer import forward_paged
+from lmrs_tpu.ops.paged_attention import pack_spans
 from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, CostLedger,
                           DispatchAttribution, MetricsRegistry, SLOEngine,
                           dump_postmortem, get_tracer, req_tid)
@@ -262,8 +263,22 @@ class ContinuousScheduler:
         # appended in-scan; mixed steps re-seed it instead) and full spec
         # blocks resume once the admission wave's prefill drains — greedy
         # outputs are identical either way (exact-distribution verify).
+        # Ragged span dispatch (RPA, ISSUE 16): ONE kernel family where
+        # every dispatch is a list of (row, query-span) pairs — decode is
+        # q_len=1 rows, verify q_len=k+1 rows, a mixed step decode rows
+        # plus one prefill-slice row, continuation chunks long-span rows.
+        # Compile buckets collapse to (pow2 total-query-tokens, pow2 page
+        # window).  LMRS_RPA=0 restores every legacy path byte-for-byte.
+        # RPA lifts two of the gates above: int8 KV x mixed (per-row
+        # frozen scales ride the span descriptor — a fresh-start slice
+        # owns its slot's scales exactly like a fresh prefill) and
+        # spec x mixed (decode rows carry verify spans in-graph, so spec
+        # no longer yields during prefill windows).
+        self._rpa = env_bool("LMRS_RPA", True)
+        self._rpa_fns: dict[tuple, object] = {}
         self._mixed = (engine_cfg.mixed_batch and env_bool("LMRS_MIXED", True)
-                       and not self._kv_quant and not self._use_ring)
+                       and (self._rpa or not self._kv_quant)
+                       and not self._use_ring)
         self.mixed_token_budget = max(32, engine_cfg.mixed_token_budget)
         self._mixed_fns: dict[tuple[int, int], object] = {}
         # prefix cache constructed AFTER the metrics registry below (the
@@ -430,6 +445,18 @@ class ContinuousScheduler:
         self._c_piggybacked = c("lmrs_prefill_tokens_piggybacked_total",
                                 "prompt tokens prefilled inside mixed "
                                 "decode steps", "tokens")
+        # ragged span dispatch: real query tokens per RPA dispatch (the
+        # padding complement of the pow2 total-token bucket), and the
+        # headline compile-zoo number — distinct (bucket, window) program
+        # shapes built so far (the legacy per-phase matrix this replaces
+        # compiled decode + spec + mixed + chunk families separately)
+        self._h_rpa_span = h("lmrs_rpa_span_tokens",
+                             buckets=POW2_TOKEN_BUCKETS,
+                             help="real query-span tokens per ragged span "
+                                  "dispatch", unit="tokens")
+        self._c_rpa_shapes = c("lmrs_rpa_compile_shapes_total",
+                               "distinct ragged-span program shapes "
+                               "compiled", "shapes")
         self._g_peak_pages = g("lmrs_peak_pages_in_use",
                                "max KV pages simultaneously allocated",
                                "pages")
@@ -606,6 +633,9 @@ class ContinuousScheduler:
             "mixed_dispatches": int(self._h_mixed_fill.count),
             "mixed_fill_sum": self._h_mixed_fill.sum,
             "prefill_tokens_piggybacked": int(self._c_piggybacked.value),
+            "rpa_dispatches": int(self._h_rpa_span.count),
+            "rpa_span_tokens": self._h_rpa_span.sum,
+            "rpa_compile_shapes": int(self._c_rpa_shapes.value),
             "watchdog_fires": int(self._c_watchdog_fires.value),
             "wedged_requests": int(self._c_wedged.value),
         }
@@ -714,6 +744,19 @@ class ContinuousScheduler:
         if self.watchdog is not None:
             self.watchdog.grace_end()
 
+    def _invalidate_compiled(self) -> None:
+        """ONE compile-cache invalidation for every first-run-lowering
+        fallback site (formerly triplicated across the decode / spec /
+        mixed handlers, each independently clearing the caches whose
+        programs captured ``use_ragged`` at build time).  Flipping the
+        kernel gate must drop ALL of them — decode + spec (one dict),
+        mixed, and the ragged span programs — or a stale program would
+        keep dispatching the kernel the fallback just proved unlowerable."""
+        self._use_ragged = False
+        self._decode_fns.clear()   # plain decode + ("specfn", w) entries
+        self._mixed_fns.clear()    # mixed fns captured use_ragged too
+        self._rpa_fns.clear()      # span programs rebuild on the XLA path
+
     def _timed_get(self, x):
         """``jax.device_get`` with the blocking wait charged to the
         ``blocked_seconds`` metric (device-busy attribution; see the
@@ -769,6 +812,7 @@ class ContinuousScheduler:
                                       "captures)",
             "queue_wait_ms": self._h_queue_wait.percentile_report(),
             "mixed_batch": self._mixed_report(),
+            "rpa": self._rpa_report(),
             "host_kv": self._host_kv_report(),
             "perf_attribution": self._perf.report(),
             "cost": self._cost.report(),
@@ -799,6 +843,26 @@ class ContinuousScheduler:
             "prefill_tokens_piggybacked": (
                 m["prefill_tokens_piggybacked"]
                 - b.get("prefill_tokens_piggybacked", 0)),
+        }
+
+    def _rpa_report(self, before: dict | None = None) -> dict:
+        """Ragged-span block of metrics_report() / bench detail: whether
+        RPA dispatch is armed, how many span dispatches ran, the real
+        query tokens they carried, and the HEADLINE number — distinct
+        compiled program shapes (the legacy per-phase matrix compiled
+        decode + spec + mixed + chunk families; the span family is
+        (pow2 tokens, pow2 window) only).  Same windowed-``before``
+        convention as ``_mixed_report``; compile shapes stay cumulative —
+        a zoo is a lifetime property, not a window one."""
+        m = self.metrics
+        b = before or {}
+        return {
+            "enabled": self._rpa,
+            "dispatches": (m["rpa_dispatches"]
+                           - b.get("rpa_dispatches", 0)),
+            "span_tokens": int(m["rpa_span_tokens"]
+                               - b.get("rpa_span_tokens", 0.0)),
+            "compile_shapes": m["rpa_compile_shapes"],
         }
 
     def _prefix_cache_report(self) -> dict:
@@ -2351,6 +2415,36 @@ class ContinuousScheduler:
             per_kernel = time_chain(
                 lambda iters, g=g: make_chain(iters, g), lo, hi, reps)
             out[f"decode_row_us_{name}"] = round(per_kernel / B * 1e6, 3)
+        if self._rpa:
+            # unified span kernel, q_len=1 rows — the per-row number
+            # perf_sentry tracks against the retired fused path
+            # (decode_row_us_rpa: a regression here fails the report arm)
+            from lmrs_tpu.ops.paged_attention import ragged_spans_pallas
+            q_starts_np, total = pack_spans(np.ones((B,), np.int32))
+            qf0 = jnp.asarray(rng.standard_normal(
+                (total, cfg_m.n_heads, hd)), jnp.bfloat16)
+            knf = jnp.asarray(rng.standard_normal((total, kh, hd)),
+                              jnp.bfloat16)
+            vnf = jnp.asarray(rng.standard_normal((total, kh, hd)),
+                              jnp.bfloat16)
+            qs = jnp.asarray(q_starts_np)
+            ql = jnp.ones((B,), jnp.int32)
+
+            def make_chain_rpa(iters: int):
+                @jax.jit
+                def chain(q, kp, vp):
+                    def body(_, carry):
+                        q, kp, vp = carry
+                        o, kp, vp = ragged_spans_pallas(
+                            q, knf, vnf, kp, vp, pt, kl, qs, ql)
+                        return (o.astype(q.dtype), kp, vp)
+
+                    return jax.lax.fori_loop(0, iters, body, (q, kp, vp))
+
+                return lambda: chain(qf0, kp0, vp0)[0]
+
+            per_kernel = time_chain(make_chain_rpa, lo, hi, reps)
+            out["decode_row_us_rpa"] = round(per_kernel / B * 1e6, 3)
         return out
 
     # ------------------------------------------- page growth / preemption
@@ -2681,6 +2775,14 @@ class ContinuousScheduler:
             and slots[b].phase == "decode" for b in range(self.B))
         if pf is None or not has_decode:
             return False, last_block_t
+        if self._rpa:
+            # ragged span dispatch (LMRS_RPA, the default): the mixed step
+            # is a span list through the unified kernel — and under
+            # speculation the decode rows carry verify spans, so spec no
+            # longer yields during prefill windows
+            return self._rpa_mixed_iteration(
+                pf, slots, queue, results, fresh, kv_lens, last_tok,
+                active, temps, top_k, top_p, t_enq, last_block_t)
 
         def rearm(stalled):
             for b in stalled:  # stalled rows rejoin the next dispatch
@@ -2800,9 +2902,7 @@ class ContinuousScheduler:
             logger.warning("mixed multi-token kernel failed to lower; "
                            "falling back to XLA multi decode",
                            exc_info=True)
-            self._use_ragged = False
-            self._decode_fns.clear()
-            self._mixed_fns.clear()
+            self._invalidate_compiled()
             nxt, self.cache.k, self.cache.v = \
                 self._get_mixed_fn(T, w)(*args)
         self._note_ran_ok(key_)
@@ -2935,6 +3035,396 @@ class ContinuousScheduler:
         self._mixed_fns[key_] = mixed_step
         return mixed_step
 
+    # ------------------------------------------- ragged span dispatch (RPA)
+
+    def _get_rpa_fn(self, tpb: int, w: int):
+        """Unified ragged-span program (ISSUE 16 tentpole): every dispatch
+        is a list of (row, query-span) pairs over the paged pool — each
+        row carries (q_start, q_len, kv base, page-table slice) and
+        per-token causal limits mask the padding, so plain decode is
+        q_len=1 rows, verify q_len=k+1 rows (the spec variant below), a
+        mixed step decode rows plus one prefill-slice row, and
+        continuation chunks long-span rows.  ONE compile bucket family:
+        (pow2 total-query-tokens, pow2 page window) replaces the
+        per-phase decode/spec/mixed/chunk matrix.  Samples one token per
+        dispatch row at its host-provided flat gather index."""
+        key_ = ("rpa", tpb, w)
+        if key_ in self._rpa_fns:
+            return self._rpa_fns[key_]
+        cfg = self.model_cfg
+        max_len = self.max_len
+        rope_max = self.max_len
+        use_ragged = self._use_ragged and self._kernel_mesh() is None
+        interp = self._interpret
+        kv_q = bool(self._kv_quant)
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4) if kv_q else (1, 2))
+        def rpa_step(params, k_pages, v_pages, kscale, vscale, srows,
+                     tokens, q_starts, q_lens, row_flat, base, gather_idx,
+                     table, key, temps, tk, tp):
+            nb = base.shape[0]
+            rf = jnp.clip(row_flat, 0, nb - 1)
+            off = jnp.arange(tpb) - q_starts[rf]
+            # rope positions: each span token sits at consecutive absolute
+            # positions from its row's own kv base (the context BEFORE
+            # this dispatch); out-of-span tokens clamp to 0 — they are
+            # masked from every real query and their writes park on the
+            # null page, so the value never matters
+            positions = jnp.clip(base[rf] + off, 0, max_len - 1)[None]
+            out = forward_paged(
+                params, cfg, tokens, positions, k_pages, v_pages, table,
+                base, rope_max, use_ragged_kernel=use_ragged,
+                interpret=interp, packed_last_idx=gather_idx,
+                kv_scales=(kscale, vscale) if kv_q else None,
+                scale_rows=srows if kv_q else None,
+                spans=(q_starts, q_lens, row_flat),
+            )
+            logits, k_pages, v_pages = out[:3]
+            if kv_q:
+                kscale, vscale = out[3]
+            # single step, no scan/vmap wrapper: sample_logits' lax.cond
+            # fast paths are safe here (ops/sampling.py NOTE)
+            nxt = sample_logits(logits[0], key, temps, tk, tp)
+            return nxt, k_pages, v_pages, kscale, vscale
+
+        logger.info("compiling ragged span step: B=%d token_bucket=%d "
+                    "window=%d pages (ragged_kernel=%s)", self.B, tpb, w,
+                    use_ragged)
+        self._c_rpa_shapes.inc()
+        self._rpa_fns[key_] = rpa_step
+        return rpa_step
+
+    def _get_rpa_spec_fn(self, tpb: int, w: int):
+        """Spec-aware ragged span step (the spec x mixed unlock): decode
+        rows carry (1 + spec_k)-token verify spans — the current token
+        plus k n-gram drafts looked up IN-GRAPH from the device history
+        buffer — while the piggybacked prefill slice rides the same
+        dispatch, so speculation no longer yields during prefill windows
+        and mixed steps stop marking rows spec-stale (the buffer appends
+        in-graph).  Non-decode rows verify with n_valid=0: the machinery
+        emits exactly ONE token from their last-span-position
+        distribution — for the prefill row that is its sampled first
+        token, through the same exact-distribution verify that keeps
+        greedy outputs identical to every legacy path."""
+        key_ = ("rpa_spec", tpb, w)
+        if key_ in self._rpa_fns:
+            return self._rpa_fns[key_]
+        cfg = self.model_cfg
+        max_len = self.max_len
+        rope_max = self.max_len
+        use_ragged = self._use_ragged and self._kernel_mesh() is None
+        interp = self._interpret
+        kv_q = bool(self._kv_quant)
+        k = self.spec_k
+        ngram = max(2, self.cfg.speculate_ngram)
+        eos_id = self.tokenizer.eos_id
+
+        from lmrs_tpu.ops.sampling import filtered_probs
+        from lmrs_tpu.ops.speculative import draft_lookup, verify_tokens
+
+        @partial(jax.jit,
+                 donate_argnums=(1, 2, 3, 4, 5) if kv_q else (1, 2, 3))
+        def rpa_spec_step(params, k_pages, v_pages, buf, kscale, vscale,
+                          srows, tokens, q_starts, q_lens, row_flat, base,
+                          is_dec, cur_tok, gather_idx, table, key, temps,
+                          tk, tp):
+            nb = base.shape[0]
+            b_rows = jnp.arange(nb)[:, None]
+            offs = jnp.arange(k + 1)[None, :]
+            # current token enters the history at index == its KV position
+            # (decode rows only: other rows' columns land OOB and drop)
+            col0 = jnp.where(is_dec, jnp.minimum(base, max_len - 1),
+                             max_len)
+            buf = buf.at[jnp.arange(nb), col0].set(cur_tok, mode="drop")
+            draft, n_valid = draft_lookup(buf, base + 1, k, pad_id=eos_id,
+                                          n=ngram)
+            n_valid = jnp.where(is_dec, n_valid, 0)
+            # scatter [current, drafts] into the decode spans of the flat
+            # token row (prefill/pad rows keep their host-built tokens)
+            span_idx = jnp.where(is_dec[:, None],
+                                 q_starts[:, None] + offs, tpb)
+            tokens = tokens.at[0, span_idx].set(
+                jnp.concatenate([cur_tok[:, None], draft], axis=1),
+                mode="drop")
+            rf = jnp.clip(row_flat, 0, nb - 1)
+            off = jnp.arange(tpb) - q_starts[rf]
+            positions = jnp.clip(base[rf] + off, 0, max_len - 1)[None]
+            out = forward_paged(
+                params, cfg, tokens, positions, k_pages, v_pages, table,
+                base, rope_max, use_ragged_kernel=use_ragged,
+                interpret=interp, packed_last_idx=gather_idx,
+                kv_scales=(kscale, vscale) if kv_q else None,
+                scale_rows=srows if kv_q else None,
+                spans=(q_starts, q_lens, row_flat),
+            )
+            logits, k_pages, v_pages = out[:3]
+            if kv_q:
+                kscale, vscale = out[3]
+            # filtered_probs is deliberately cond-free, so this vmap over
+            # the token axis is safe (ops/sampling.py NOTE)
+            probs = jax.vmap(filtered_probs, in_axes=(1, None, None, None),
+                             out_axes=1)(
+                logits[0].reshape(nb, k + 1, -1), temps, tk, tp)
+            key, sub = jax.random.split(key)
+            emit, count = verify_tokens(probs, draft, n_valid, sub)
+            # accepted tokens extend the history (decode rows only; the
+            # final emitted token lands exactly at the next step's write
+            # index — idempotent, same as the spec scan)
+            cols = jnp.minimum(base[:, None] + 1 + offs, max_len - 1)
+            cols = jnp.where((offs < count[:, None]) & is_dec[:, None],
+                             cols, max_len)
+            buf = buf.at[b_rows, cols].set(emit, mode="drop")
+            return emit, count, buf, k_pages, v_pages, kscale, vscale
+
+        logger.info("compiling ragged span spec step: B=%d token_bucket=%d "
+                    "window=%d pages k=%d (ragged_kernel=%s)", self.B, tpb,
+                    w, k, use_ragged)
+        self._c_rpa_shapes.inc()
+        self._rpa_fns[key_] = rpa_spec_step
+        return rpa_spec_step
+
+    def _rpa_mixed_iteration(self, pf, slots, queue, results, fresh,
+                             kv_lens, last_tok, active, temps, top_k,
+                             top_p, t_enq, last_block_t):
+        """One ragged-span mixed step (the RPA default): every live decode
+        row advances as a span — ONE token plain, a (1 + spec_k)-token
+        verify span under speculation — and one prefilling slot's next
+        slice rides the SAME dispatch as a long span row.  Two legacy
+        composition gates are gone here: int8 KV pools mix (a fresh-start
+        slice owns its slot's frozen scales through the span descriptor,
+        every other row clamps to them — the PERF.md follow-up) and spec
+        blocks no longer yield during prefill windows.  Same
+        (handled, last_block_t) contract as _mixed_iteration."""
+        spec = bool(self.spec_k)
+        adv = 1 + self.spec_k if spec else 1
+
+        def rearm(stalled):
+            for b in stalled:  # stalled rows rejoin the next dispatch
+                if slots[b] is not None:
+                    active[b] = True
+
+        stalled = self._ensure_decode_capacity(slots, queue, kv_lens,
+                                               last_tok, active,
+                                               extra_tokens=adv)
+        rows = [b for b in range(self.B)
+                if slots[b] is not None and active[b]
+                and slots[b].phase == "decode"]
+        budget_left = self.mixed_token_budget - adv * len(rows)
+        if not rows or budget_left < 16:
+            rearm(stalled)
+            return False, last_block_t
+        if spec:
+            if self._spec_buf is None:
+                self._spec_buf = jnp.zeros((self.B, self.max_len),
+                                           jnp.int32)
+            if self._spec_stale:
+                # same lazy re-seed as _spec_decode_block: rows advanced
+                # outside the device-appended paths since the last verify
+                for b in sorted(self._spec_stale):
+                    if slots[b] is not None and slots[b].phase == "decode":
+                        self.seed_history(b, slots[b])
+                self._spec_stale.clear()
+
+        st_pf = slots[pf]
+        pos = st_pf.prefill_pos
+        c = min(len(st_pf.prompt_ids) - pos, budget_left,
+                self.prefill_chunk)
+        is_final = pos + c >= len(st_pf.prompt_ids)
+
+        q_lens_np = np.zeros((self.B,), np.int32)
+        base_np = np.zeros((self.B,), np.int32)
+        is_dec_np = np.zeros((self.B,), bool)
+        table_rows = [None] * self.B
+        max_pages = 1
+        live_tokens = 0
+        for b in rows:
+            st = slots[b]
+            q_lens_np[b] = adv
+            base_np[b] = st.kv_len
+            is_dec_np[b] = True
+            table_rows[b] = st.seq
+            live_tokens += st.kv_len
+            max_pages = max(max_pages,
+                            self.cache.pages_needed(st.kv_len + adv))
+        q_lens_np[pf] = c
+        base_np[pf] = pos
+        table_rows[pf] = st_pf.seq
+        max_pages = max(max_pages, self.cache.pages_needed(pos + c))
+        w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
+        table = self.cache.page_table_array(table_rows)
+
+        # host-side span packing: QT-aligned starts, pow2 total bucket —
+        # the padding complement is what lmrs_rpa_span_tokens measures
+        q_starts_np, total = pack_spans(q_lens_np)
+        tpb = _pow2_bucket(total, 16)
+        tokens_np = np.zeros((1, tpb), np.int32)
+        row_flat_np = np.full((tpb,), self.B, np.int32)
+        for b in rows:
+            tokens_np[0, q_starts_np[b]] = last_tok[b]
+            row_flat_np[q_starts_np[b]: q_starts_np[b] + adv] = b
+        tokens_np[0, q_starts_np[pf]: q_starts_np[pf] + c] = \
+            st_pf.prompt_ids[pos: pos + c]
+        row_flat_np[q_starts_np[pf]: q_starts_np[pf] + c] = pf
+        last_of = (q_starts_np + np.maximum(q_lens_np, 1) - 1).astype(
+            np.int32)
+        if spec:
+            offs = np.arange(self.spec_k + 1)[None, :]
+            gidx = np.where(is_dec_np[:, None],
+                            q_starts_np[:, None] + offs,
+                            last_of[:, None]).reshape(-1).astype(np.int32)
+        else:
+            gidx = last_of
+
+        real = adv * len(rows) + c
+        self._h_occupancy.observe(len(rows) / self.B)
+        self._c_decode_dispatches.inc()
+        self._h_mixed_fill.observe(real / self.mixed_token_budget)
+        self._h_rpa_span.observe(real)
+        self._c_piggybacked.inc(c)
+        self._c_prefill_tokens.inc(c)
+        self._h_prefill_batch.observe(c)
+        now = time.time()
+        if last_block_t is not None:
+            self._h_block_gap.observe(now - last_block_t)
+            self._slo.observe_gap(now - last_block_t)
+        last_block_t = now
+        flops = self._perf.prefill_flops(c, kv_start=pos)
+        if self._tr:
+            self._tr.instant("prefill_dispatch",
+                             args={"rows": 1, "tokens": c, "bucket": tpb,
+                                   "mixed": True, "rpa": True,
+                                   "flops_g": round(flops / 1e9, 3)})
+        st_pf.prefill_pos = pos + c
+
+        self._key, sub = jax.random.split(self._key)
+        srows = jnp.arange(self.B, dtype=jnp.int32)
+        common = (jnp.asarray(tokens_np), jnp.asarray(q_starts_np),
+                  jnp.asarray(q_lens_np), jnp.asarray(row_flat_np),
+                  jnp.asarray(base_np))
+        key_ = ("rpa_spec", tpb, w) if spec else ("rpa", tpb, w)
+        warm = key_ in self._ran_ok
+        if not warm:
+            self._wd_grace_cold()
+        t_disp = time.time()
+
+        def dispatch():
+            if spec:
+                return self._get_rpa_spec_fn(tpb, w)(
+                    self.params, self.cache.k, self.cache.v,
+                    self._spec_buf, self.kscale, self.vscale, srows,
+                    *common, jnp.asarray(is_dec_np),
+                    jnp.asarray(last_tok), jnp.asarray(gidx),
+                    jnp.asarray(table[:, :w]), sub, jnp.asarray(temps),
+                    jnp.asarray(top_k), jnp.asarray(top_p))
+            return self._get_rpa_fn(tpb, w)(
+                self.params, self.cache.k, self.cache.v,
+                self.kscale, self.vscale, srows,
+                *common, jnp.asarray(gidx),
+                jnp.asarray(table[:, :w]), sub, jnp.asarray(temps),
+                jnp.asarray(top_k), jnp.asarray(top_p))
+
+        try:
+            out = dispatch()
+        except Exception:
+            # the shared first-run-lowering contract: degrade only before
+            # this shape has ever run (donation happens at execution, so
+            # the args are still valid); proven shapes re-raise
+            if not self._use_ragged or key_ in self._ran_ok:
+                raise
+            logger.warning("ragged span kernel failed to lower; "
+                           "falling back to the XLA span path",
+                           exc_info=True)
+            self._invalidate_compiled()
+            out = dispatch()
+        self._note_ran_ok(key_)
+        if spec:
+            (emit, count, self._spec_buf, self.cache.k, self.cache.v,
+             ks, vs) = out
+            emit, count = self._timed_get((emit, count))
+            emit, count = np.asarray(emit), np.asarray(count)
+        else:
+            nxt, self.cache.k, self.cache.v, ks, vs = out
+            nxt = np.asarray(self._timed_get(nxt))
+        if self._kv_quant:
+            self.kscale, self.vscale = ks, vs
+        t_done = time.time()
+
+        # exact-split attribution with SPAN-LEVEL token counts: the
+        # decode side of a span step is adv tokens per live row, not one
+        extra_flops, cold_pf = self._consume_prefill_attr()
+        nb = self._perf.note_mixed_step(
+            t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
+            warm=warm and not cold_pf, span_tokens=adv * len(rows))
+        self._attr_last_gb = round(nb / 1e9, 3)
+        if self._cost.enabled:
+            dcost, pcost = self._roofline_phase_costs(
+                nb, flops + extra_flops)
+            self._cost.note_step(
+                max(0.0, t_done - t_disp),
+                decode_rows=[(slots[b].req,
+                              int(count[b]) if spec else 1,
+                              len(slots[b].seq.pages)) for b in rows],
+                prefill_rows=(self._consume_prefill_cost()
+                              + [(st_pf.req, c, flops)]),
+                decode_cost_s=dcost, prefill_cost_s=pcost)
+
+        for b in rows:
+            st = slots[b]
+            if spec:
+                cnt = int(count[b])
+                new = [int(t) for t in emit[b, :cnt]]
+                self._c_spec_accepted.inc(max(0, cnt - 1))
+                if cnt > 1:
+                    self._cost.note_saved(st.req, spec_tokens=cnt - 1)
+            else:
+                new = [int(nxt[b])]
+            st.generated.extend(new)
+            st.kv_len += len(new)
+            kv_lens[b] = st.kv_len
+            last_tok[b] = st.generated[-1] if st.generated else 0
+            self._c_decode_tokens.inc(len(new))
+            if self._tr:
+                self._tr.instant("decode_block", ts=now,
+                                 tid=self._tid(st.req),
+                                 args={"tokens": len(new)})
+            self._maybe_finish(b, slots, results, active, fresh,
+                               kv_lens, last_tok)
+        if is_final:
+            # the slice completed the prompt: enter decode with the first
+            # token this very step sampled at its last span position
+            st = st_pf
+            st.phase = "decode"
+            st.t_decode_start = time.time()
+            if self._tr:
+                self._tr.complete("prefill", st.t_admit,
+                                  st.t_decode_start, tid=self._tid(st.req),
+                                  args={"prompt_tokens":
+                                        len(st.prompt_ids)})
+            st.kv_len = len(st.prompt_ids)
+            kv_lens[pf] = st.kv_len
+            active[pf] = True
+            self._cache_insert(st)
+            tok0 = int(emit[pf, 0]) if spec else int(nxt[pf])
+            st.generated.append(tok0)
+            self._note_first_token(st, t_enq)
+            last_tok[pf] = tok0
+            if spec:
+                # the verify graph cannot have appended pf's history (its
+                # span was a prompt slice): seed once at the
+                # prefill -> decode transition, like any admission
+                self.seed_history(pf, st)
+            self._maybe_finish(pf, slots, results, active, fresh,
+                               kv_lens, last_tok)
+        if self._tr:
+            self._tr.complete("decode_block", now, time.time(),
+                              args={"active": len(rows),
+                                    "tokens": adv * len(rows),
+                                    "hbm_gb": self._attr_last_gb,
+                                    "mixed": True, "rpa": True,
+                                    "prefill_tokens": c})
+        rearm(stalled)
+        return True, last_block_t
+
     # ------------------------------------------------------------- prefill
 
     def _advance_prefills(self, slots) -> list[tuple[object, list[tuple[int, int]]]]:
@@ -3008,6 +3498,16 @@ class ContinuousScheduler:
             else:
                 pending.append(self._dispatch_packed(bin_items))
         for (fresh, s_bucket, w, ring), items in groups.items():
+            if (not fresh and self._rpa and self._use_ragged
+                    and self._kernel_mesh() is None):
+                # windowed continuation chunks ride the unified span
+                # program: the per-(s_bucket, w) chunked-prefill matrix
+                # (_prefill_window_fns) never compiles under RPA — chunks
+                # share the mixed step's (token bucket, window) family
+                entry = self._dispatch_rpa_chunks(items)
+                if entry[1]:
+                    pending.append(entry)
+                continue
             n = 1 if len(items) == 1 else self.B
             tokens = np.full((n, s_bucket), self.tokenizer.pad_id, np.int32)
             start = np.zeros((n,), np.int32)
@@ -3096,6 +3596,95 @@ class ContinuousScheduler:
                 pending.append((tok0, rows))
 
         return pending
+
+    def _dispatch_rpa_chunks(self, items) -> tuple[object, list]:
+        """Windowed continuation chunks as ragged SPANS (LMRS_RPA with the
+        kernel armed): every chunk is one long-span row of a single
+        unified dispatch.  Returns the ``(tok0_device_array, [(slot,
+        row)])`` pending-entry contract of ``_advance_prefills``; the
+        sampled array is B-wide and indexed by SLOT (rows ARE slots
+        here).  A first-run lowering failure degrades through
+        ``_invalidate_compiled`` and retries on the XLA span path — the
+        rare-case memory cost of its window materialization is accepted
+        for the retry only; subsequent waves route back through the
+        legacy window programs because ``_use_ragged`` is now off."""
+        q_lens_np = np.zeros((self.B,), np.int32)
+        base_np = np.zeros((self.B,), np.int32)
+        is_final_rows: list[tuple[int, int]] = []
+        table_rows = [None] * self.B
+        max_pages = 1
+        batch_tokens = 0
+        flops = 0.0
+        for (b, st, chunk, pos, is_final) in items:
+            q_lens_np[b] = len(chunk)
+            base_np[b] = pos
+            table_rows[b] = st.seq
+            max_pages = max(max_pages,
+                            self.cache.pages_needed(pos + len(chunk)))
+            batch_tokens += len(chunk)
+            if is_final:
+                is_final_rows.append((b, b))
+        w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
+        table = self.cache.page_table_array(table_rows)
+        q_starts_np, total = pack_spans(q_lens_np)
+        tpb = _pow2_bucket(total, 16)
+        tokens_np = np.zeros((1, tpb), np.int32)
+        row_flat_np = np.full((tpb,), self.B, np.int32)
+        temps = np.ones((self.B,), np.float32)
+        tks = np.zeros((self.B,), np.int32)
+        tps = np.ones((self.B,), np.float32)
+        for (b, st, chunk, pos, _) in items:
+            s, c = int(q_starts_np[b]), len(chunk)
+            tokens_np[0, s: s + c] = chunk
+            row_flat_np[s: s + c] = b
+            temps[b] = st.req.temperature
+            tks[b] = st.req.top_k
+            tps[b] = min(max(st.req.top_p, 0.0), 1.0)
+            st.prefill_pos = pos + c
+            self._c_prefill_tokens.inc(c)
+            f_i = self._perf.prefill_flops(c, kv_start=pos)
+            flops += f_i
+            if self._cost.enabled:
+                self._cost_pending_prefill.append((st.req, c, f_i))
+        gidx = (q_starts_np + np.maximum(q_lens_np, 1) - 1).astype(np.int32)
+        self._h_prefill_batch.observe(batch_tokens)
+        self._h_rpa_span.observe(batch_tokens)
+        self._attr_pending_flops += flops
+        if self._tr:
+            self._tr.instant("prefill_dispatch",
+                             args={"rows": len(items),
+                                   "tokens": batch_tokens, "bucket": tpb,
+                                   "fresh": False, "rpa": True,
+                                   "flops_g": round(flops / 1e9, 3)})
+        self._key, sub = jax.random.split(self._key)
+        srows = jnp.arange(self.B, dtype=jnp.int32)
+        args = (self.params, self.cache.k, self.cache.v,
+                self.kscale, self.vscale, srows,
+                jnp.asarray(tokens_np), jnp.asarray(q_starts_np),
+                jnp.asarray(q_lens_np), jnp.asarray(row_flat_np),
+                jnp.asarray(base_np), jnp.asarray(gidx),
+                jnp.asarray(table[:, :w]), sub, jnp.asarray(temps),
+                jnp.asarray(tks), jnp.asarray(tps))
+        key_ = ("rpa", tpb, w)
+        if key_ not in self._ran_ok:
+            self._attr_prefill_cold = True  # compiling: no MFU sample
+            self._wd_grace_cold()
+        try:
+            tok0, self.cache.k, self.cache.v, ks, vs = \
+                self._get_rpa_fn(tpb, w)(*args)
+        except Exception:
+            if not self._use_ragged or key_ in self._ran_ok:
+                raise
+            logger.warning("ragged span kernel failed to lower; "
+                           "falling back to the XLA span path",
+                           exc_info=True)
+            self._invalidate_compiled()
+            tok0, self.cache.k, self.cache.v, ks, vs = \
+                self._get_rpa_fn(tpb, w)(*args)
+        self._note_ran_ok(key_)
+        if self._kv_quant:
+            self.kscale, self.vscale = ks, vs
+        return tok0, is_final_rows
 
     @staticmethod
     def _pack_bins(items: list, capacity: int) -> list[list]:
@@ -3453,9 +4042,7 @@ class ContinuousScheduler:
                 raise
             logger.warning("ragged decode kernel failed to lower; "
                            "falling back to XLA paged decode", exc_info=True)
-            self._use_ragged = False
-            self._decode_fns.clear()
-            self._mixed_fns.clear()  # mixed fns captured use_ragged too
+            self._invalidate_compiled()
             out = self._get_decode_fn(w)(*args)
         self._note_ran_ok(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
@@ -3608,9 +4195,7 @@ class ContinuousScheduler:
                 raise
             logger.warning("multi-verify kernel failed to lower; "
                            "falling back to XLA multi decode", exc_info=True)
-            self._use_ragged = False
-            self._decode_fns.clear()  # spec fns cache here too
-            self._mixed_fns.clear()  # mixed fns captured use_ragged too
+            self._invalidate_compiled()
             out = self._get_spec_decode_fn(w)(*args)
         self._note_ran_ok(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out

@@ -328,6 +328,14 @@ def forward_paged(
     decode_row_group: int = 1,  # rows per ragged-decode program (multi-row
                                 # page walk, ops/paged_attention.py); 1 =
                                 # per-row grid (the LMRS_MULTIROW=0 path)
+    spans: tuple | None = None,  # (q_starts [B], q_lens [B], row_flat [Tp]):
+                                 # ragged span mode (LMRS_RPA) — tokens is
+                                 # ONE flat [1, Tp] row holding every row's
+                                 # query span; kv_lens is then the context
+                                 # BEFORE this dispatch (span base), and
+                                 # attention runs through the unified span
+                                 # kernel (ops ragged_spans_*).  Use
+                                 # packed_last_idx to gather sampled rows.
 ) -> tuple:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -381,6 +389,8 @@ def forward_paged(
         paged_decode_pallas_fused,
         paged_decode_pallas_multi,
         paged_decode_xla,
+        ragged_spans_pallas,
+        ragged_spans_xla,
     )
     from lmrs_tpu.ops.quant import (kv_dequant, kv_quant, kv_quant_tokens,
                                     kv_scale_from)
@@ -411,7 +421,11 @@ def forward_paged(
     sin, cos = rope_table(rope_max, hd, cfg.rope_theta)
     is_decode = s == 1
 
-    if token_pages is not None:
+    if spans is not None:
+        # span mode: [1, Tp] flat tokens vs [B_rows, W] tables — the span
+        # kernels do their own per-token page addressing
+        page_idx = None
+    elif token_pages is not None:
         page_idx = token_pages  # packed path: host-built per-token pages
     else:
         page_idx = jnp.take_along_axis(
@@ -436,12 +450,61 @@ def forward_paged(
             x, kp_all, vp_all = carry  # pools: [L*P, K, ps, hd]
             ksc = vsc = None
         lp, li = xs  # layer params, layer index
-        g_page_idx = li * n_pool + page_idx      # [B, S] global page ids
+        g_page_idx = (None if page_idx is None
+                      else li * n_pool + page_idx)  # [B, S] global page ids
         g_tables = li * n_pool + page_tables     # [B, W]
         h = rms_norm(x, lp["ln_attn"]["scale"], cfg.norm_eps)
         q, k, v = qkv_proj(lp, cfg, h)
         q = apply_rope(q, positions, sin, cos)
         k = apply_rope(k, positions, sin, cos)
+
+        if spans is not None:
+            # ragged span mode (LMRS_RPA): every phase is a list of
+            # (row, query-span) pairs — write + attention run in the ONE
+            # span kernel (or its XLA twin).  kv_lens here is the context
+            # BEFORE the dispatch: span token j of row r sits at absolute
+            # position kv_lens[r] + j.
+            span_starts, span_lens, row_flat = spans
+            ss = None
+            if kv_scales is not None:
+                # per-row frozen scales ride the span descriptor (the
+                # int8-KV x mixed unlock): a span whose base is 0 is its
+                # prompt's FIRST tokens and owns its slot's scale row —
+                # segment-max over its own tokens, the packed path's
+                # stats exactly; every later span reuses (and clamps to)
+                # the frozen scales, decode spans included.
+                nb = span_starts.shape[0]
+                segx = jnp.clip(row_flat, 0, nb)  # out-of-span -> dropped
+
+                def span_scales(kv):
+                    a = jnp.abs(kv[0].astype(jnp.float32))  # [Tp, K, hd]
+                    m = jax.ops.segment_max(a, segx, num_segments=nb + 1)
+                    return jnp.maximum(m[:nb] / 127.0, 1e-8)
+
+                s_k, s_v = span_scales(k), span_scales(v)
+                rows_i = (jnp.arange(nb, dtype=jnp.int32)
+                          if scale_rows is None else scale_rows)
+                ksc_l, vsc_l = ksc[li][rows_i], vsc[li][rows_i]
+                own = ((kv_lens == 0) & (span_lens > 0))[:, None, None]
+                s_k = jnp.where(own, s_k, ksc_l)
+                s_v = jnp.where(own, s_v, vsc_l)
+                ksc = ksc.at[li, rows_i].set(s_k)
+                vsc = vsc.at[li, rows_i].set(s_v)
+                ss = (s_k, s_v)
+            if use_ragged_kernel:
+                attn, kp_all, vp_all = ragged_spans_pallas(
+                    q[0], k[0], v[0], kp_all, vp_all, g_tables, kv_lens,
+                    span_starts, span_lens, interpret=interpret,
+                    max_pos=rope_max,
+                    kscale=ss[0] if ss is not None else None,
+                    vscale=ss[1] if ss is not None else None)
+            else:
+                attn, kp_all, vp_all = ragged_spans_xla(
+                    q[0], k[0], v[0], kp_all, vp_all, g_tables, kv_lens,
+                    span_starts, span_lens, row_flat,
+                    max_pos=rope_max, kv_scales=ss)
+            return _finish_layer(lp, x, attn[None], kp_all, vp_all,
+                                 ksc, vsc)
 
         row_scales = None  # (k_scale, v_scale) [B, K, hd] for THIS dispatch
         tok_scales = None  # packed: per-token (k, v) scales [B, S, K, hd]

@@ -226,7 +226,7 @@ class DispatchAttribution:
 
     def note_mixed_step(self, t_start: float, t_end: float, n_live: int,
                         live_tokens: int, prefill_flops: float,
-                        warm: bool) -> float:
+                        warm: bool, span_tokens: int | None = None) -> float:
         """One FUSED mixed dispatch (SARATHI mixed batches): ``n_live``
         decode rows advance one token and a prefill slice of known size
         rides the SAME program.  Unlike the sequenced-prefill decode
@@ -239,9 +239,27 @@ class DispatchAttribution:
         the assumption-free number for a step whose two phases share one
         kernel launch (they cannot be timed apart host-side).  Clean
         decode samples alone keep feeding the EMA.  Returns the step's
-        model byte cost (the ``hbm_gb`` trace-span arg)."""
+        model byte cost (the ``hbm_gb`` trace-span arg).
+
+        ``span_tokens`` is the SPAN-LEVEL decode token count from a
+        ragged span dispatch (LMRS_RPA): total decode-side query tokens
+        in the step — ``(1 + spec_k) * n_live`` when decode rows carry
+        verify spans.  Defaults to ``n_live`` (one token per live row,
+        the legacy fused step), under which the byte model is unchanged."""
         self.note_gap(t_start, t_end)
-        nbytes = self.decode_bytes(1, n_live, live_tokens)
+        if span_tokens is None or span_tokens <= n_live or n_live <= 0:
+            nbytes = self.decode_bytes(1, n_live, live_tokens)
+        else:
+            # ragged span step: every query token in a row's span walks
+            # that row's KV, so the walk term scales by the mean span
+            # length instead of the legacy one-token-per-row shape
+            from lmrs_tpu.utils.perf_model import (kv_bytes_per_token,
+                                                   weight_bytes)
+            kv = (kv_bytes_per_token(self.model_cfg)
+                  * live_tokens * span_tokens / n_live)
+            if self._kv_quantized:
+                kv /= 2
+            nbytes = weight_bytes(self.model_cfg, self._quantized) + kv
         self.c_bytes.inc(nbytes)
         if prefill_flops > 0:
             self.c_flops.inc(prefill_flops)

@@ -100,13 +100,15 @@ def balanced_row_order(lengths, row_group: int) -> np.ndarray:
     return np.concatenate([np.asarray(grp, np.int64) for grp in groups])
 
 
-def _n_live_pages(page_tables_ref, kv_lens_ref, row, page_size):
+def _n_live_pages(page_tables_ref, kv_lens_ref, row, page_size, length=None):
     """Live pages of ``row``, clamped to the table width: a row whose
     length exceeds its table (e.g. an inactive row carrying a stale/garbage
     length) must never index page_tables_ref out of bounds — SMEM reads are
-    not range-checked."""
+    not range-checked.  ``length`` overrides the SMEM length (the span
+    kernel's per-tile walks use a running prefix length, not the row's)."""
+    length_ = kv_lens_ref[row] if length is None else length
     return jnp.minimum(
-        jax.lax.div(kv_lens_ref[row] + page_size - 1, page_size),
+        jax.lax.div(length_ + page_size - 1, page_size),
         page_tables_ref.shape[1],
     )
 
@@ -197,6 +199,9 @@ def _ragged_decode_all_heads(
                         # loop) and V's into the accumulator (after it) —
                         # pages stream as raw int8, only a type convert per
                         # page
+    length=None,        # override for kv_lens_ref[row]: the span kernel
+                        # walks each query TILE with a running prefix length
+                        # (base + tiles-so-far * QT), not the row's total
 ):
     """Walk ONE batch row's live pages through a double-buffered DMA
     pipeline — PAGE-major (round 3): each loop step DMAs one page's ALL kv
@@ -213,8 +218,10 @@ def _ragged_decode_all_heads(
     per-row causal limits over the SAME single page walk, so verifying
     k drafts costs one walk, not a full page-window gather."""
     b = pl.program_id(0) if row is None else row
-    length = kv_lens_ref[b]
-    n_pages = _n_live_pages(page_tables_ref, kv_lens_ref, b, page_size)
+    if length is None:
+        length = kv_lens_ref[b]
+    n_pages = _n_live_pages(page_tables_ref, kv_lens_ref, b, page_size,
+                            length=length)
 
     def fetch(p, slot):
         _fetch_page(page_tables_ref, k_hbm, v_hbm, k_scr, v_scr, sem,
@@ -366,8 +373,11 @@ def _make_rmw(
         f"page_size={page_size} would silently alias (scheduler gates this)")
     n_win = 1 if n_tokens == 1 else (n_tokens - 2) // wh + 2
 
-    def for_row(b):
-        length = kv_lens_ref[b]
+    def for_row(b, length=None):
+        # ``length`` override: the span kernel RMWs one QT-token tile at a
+        # time with a running prefix length instead of the row's total
+        if length is None:
+            length = kv_lens_ref[b]
         base = jnp.maximum(length - n_tokens, 0)  # first new token's position
         win0 = jax.lax.div(base, wh) * wh  # provably wh-aligned
         # A window is touched ONLY if it holds a valid token position.  An
@@ -918,6 +928,292 @@ def paged_decode_multi_xla(
     logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bthk,bkhd->bthd", probs.astype(v_win.dtype), v_win)
+    return out, k_pages, v_pages
+
+
+# ------------------------------------------------- ragged span kernel (RPA)
+
+# Query-tile height of the span kernel.  Spans are host-packed to QT-token
+# alignment (pack_spans), so every tile's flat offset is provably aligned
+# for Mosaic's dynamic-slice prover and no tile straddles two spans.
+SPAN_QT = 8
+
+
+def pack_spans(q_lens, floor: int = 16):
+    """Host-side span packer for the ragged span kernel: given per-row real
+    query lengths (0 = inactive row), return ``(q_starts, total)`` where
+    span i occupies flat tokens [q_starts[i], q_starts[i] + q_lens[i]) of a
+    buffer whose rows are SPAN_QT-aligned, and ``total`` is the aligned
+    token count (bucket it pow2 before allocating — the compile key).
+    Pure numpy; never traced."""
+    q_lens = np.asarray(q_lens, np.int64)
+    aligned = -(-q_lens // SPAN_QT) * SPAN_QT
+    q_starts = np.concatenate([[0], np.cumsum(aligned)[:-1]])
+    return q_starts.astype(np.int32), int(max(floor, aligned.sum()))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "max_pos"))
+def ragged_spans_pallas(
+    q: jnp.ndarray,            # [Tp, H, hd] flat query tokens (all spans)
+    k_new: jnp.ndarray,        # [Tp, K, hd] the tokens' K (post-rope)
+    v_new: jnp.ndarray,        # [Tp, K, hd]
+    k_pages: jnp.ndarray,      # [P_total, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P_total, K, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W] GLOBAL page ids
+    kv_lens: jnp.ndarray,      # [B] tokens in cache BEFORE this dispatch
+                               # (the span base positions; NOT including the
+                               # span's own tokens — unlike the multi kernel)
+    q_starts: jnp.ndarray,     # [B] SPAN_QT-aligned flat span offsets
+    q_lens: jnp.ndarray,       # [B] real span lengths (0 = inactive row)
+    interpret: bool = False,
+    max_pos: int | None = None,
+    kscale: jnp.ndarray | None = None,  # [B, K, hd] f32 (int8 pools)
+    vscale: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE ragged kernel for every phase (the RPA shape, PAPERS.md): each
+    dispatch is a list of (row, query-span) pairs over the paged pool —
+    plain decode is q_len=1 rows, speculative verify q_len=k+1 rows, a
+    SARATHI mixed step is decode rows plus one long prefill-slice row, and
+    a prefill continuation chunk is a long-span row.  One program per
+    batch row loops over its span's SPAN_QT-token tiles: per tile it DMAs
+    the tile's q rows and new-token K/V from HBM, RMWs the tokens into the
+    row's pages (``_make_rmw`` with a running prefix length), and walks the
+    prefix pages through the existing double-buffered pipeline with
+    per-token causal limits.  VMEM is bounded by the TILE — span length
+    only moves the trip counts — so the compile bucket family is
+    (pow2 total-query-tokens, page window) instead of the per-phase matrix.
+
+    Token j of row b sits at absolute position ``kv_lens[b] + j``; tile t
+    walks with prefix length ``kv_lens[b] + (t+1)*QT`` so its per-token
+    limits are exact.  The last tile's padding tokens write garbage K/V at
+    FUTURE positions (masked by every real query's limit; overwritten by
+    the row's next real tokens — the mixed path's existing convention) and
+    their query rows compute garbage outputs the consumer never gathers.
+    Flat tokens outside every span are untouched in the output buffer.
+
+    Per-tile page walks restart at page 0 (attention needs the whole
+    prefix), so a c-token span costs ~c/QT partial walks — fine at mixed
+    and chunk sizes where spans ≲ the prefill chunk; the flash path
+    remains the right tool for large FRESH prefills with no prior KV."""
+    tp, h, hd = q.shape
+    kh = k_pages.shape[1]
+    ps = k_pages.shape[2]
+    b = page_tables.shape[0]
+    quantized = kscale is not None
+    assert quantized == (k_pages.dtype == jnp.int8), (
+        "int8 pools need scales and vice versa")
+    assert tp % SPAN_QT == 0, "pad the flat token buffer to SPAN_QT"
+    wh = 32 if quantized else 8
+    n_rep = h // kh
+    n_rep_p = -(-n_rep // 8) * 8
+    qt = SPAN_QT
+    tile_rows = qt * n_rep_p
+    n_win = (qt - 2) // wh + 2
+    sm_scale = hd**-0.5
+
+    # [Tp, H, hd] -> [kh, Tp*n_rep_p, hd], token-major row groups
+    qg = q.reshape(tp, kh, n_rep, hd)
+    if n_rep_p != n_rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, n_rep_p - n_rep), (0, 0)))
+    qg = qg.transpose(1, 0, 2, 3).reshape(kh, tp * n_rep_p, hd)
+    knew = k_new.transpose(1, 0, 2)  # [kh, Tp, hd]
+    vnew = v_new.transpose(1, 0, 2)
+
+    def kernel(pt_ref, len_ref, qs_ref, ql_ref, q_hbm, kn_hbm, vn_hbm,
+               *rest):
+        if quantized:
+            (ksc_ref, vsc_ref, k_hbm, v_hbm, o_hbm, k_out, v_out,
+             k_scr, v_scr, q_scr, o_scr, kn_scr, vn_scr,
+             acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem, dsem) = rest
+            gks = lambda row, ki: ksc_ref[row, ki]
+            gvs = lambda row, ki: vsc_ref[row, ki]
+        else:
+            (k_hbm, v_hbm, o_hbm, k_out, v_out,
+             k_scr, v_scr, q_scr, o_scr, kn_scr, vn_scr,
+             acc_scr, m_scr, l_scr, k8_scr, v8_scr, sem, wsem, dsem) = rest
+            gks = gvs = None
+        bi = pl.program_id(0)
+        ql = ql_ref[bi]
+        base = len_ref[bi]
+        rmw = _make_rmw(
+            pt_ref, len_ref,
+            lambda _row, ki: kn_scr[ki], lambda _row, ki: vn_scr[ki],
+            k_out, v_out, k8_scr, v8_scr, wsem,
+            page_size=ps, kh=kh, n_tokens=qt, t_pad=qt, hd=hd,
+            max_pos=max_pos, wh=wh, get_kscale=gks, get_vscale=gvs,
+        )
+
+        @pl.when(ql > 0)
+        def _row():
+            n_tiles = jax.lax.div(ql + qt - 1, qt)
+
+            def tile(ti, carry):
+                # tile index in QT units: q_starts is QT-aligned, so the
+                # div-mul form gives Mosaic a provably aligned offset
+                t8 = jax.lax.div(qs_ref[bi], qt) + ti
+                cq = pltpu.make_async_copy(
+                    q_hbm.at[:, pl.ds(t8 * tile_rows, tile_rows)],
+                    q_scr, dsem.at[0])
+                ck = pltpu.make_async_copy(
+                    kn_hbm.at[:, pl.ds(t8 * qt, qt)], kn_scr, dsem.at[1])
+                cv = pltpu.make_async_copy(
+                    vn_hbm.at[:, pl.ds(t8 * qt, qt)], vn_scr, dsem.at[2])
+                cq.start()
+                ck.start()
+                cv.start()
+                cq.wait()
+                ck.wait()
+                cv.wait()
+                tile_len = base + (ti + 1) * qt
+                start_reads, blend_write, drain = rmw(bi, length=tile_len)
+                start_reads()
+                blend_write()
+                drain()
+                _ragged_decode_all_heads(
+                    pt_ref, len_ref, q_scr, k_out, v_out, o_scr,
+                    k_scr, v_scr, acc_scr, m_scr, l_scr, sem,
+                    page_size=ps, sm_scale=sm_scale, kh=kh,
+                    n_rep_p=n_rep_p, n_tokens=qt, max_pos=max_pos,
+                    row=bi, length=tile_len,
+                    get_kscale=gks, get_vscale=gvs,
+                )
+                co = pltpu.make_async_copy(
+                    o_scr, o_hbm.at[:, pl.ds(t8 * tile_rows, tile_rows)],
+                    dsem.at[3])
+                co.start()
+                co.wait()
+                return carry
+
+            jax.lax.fori_loop(0, n_tiles, tile, None)
+
+    scale_specs = []
+    operands = [qg, knew, vnew]
+    if quantized:
+        scale_specs = [
+            pl.BlockSpec((b, kh, hd), lambda bi, *_: (0, 0, 0)),
+            pl.BlockSpec((b, kh, hd), lambda bi, *_: (0, 0, 0)),
+        ]
+        operands += [kscale.astype(jnp.float32), vscale.astype(jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # q rows stream per tile
+            pl.BlockSpec(memory_space=pl.ANY),  # knew
+            pl.BlockSpec(memory_space=pl.ANY),  # vnew
+            *scale_specs,
+            pl.BlockSpec(memory_space=pl.ANY),  # k pool
+            pl.BlockSpec(memory_space=pl.ANY),  # v pool
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # o rows stream per tile
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, kh, ps, hd), k_pages.dtype),  # whole pages
+            pltpu.VMEM((2, kh, ps, hd), v_pages.dtype),
+            pltpu.VMEM((kh, tile_rows, hd), q.dtype),    # q tile
+            pltpu.VMEM((kh, tile_rows, hd), q.dtype),    # o tile
+            pltpu.VMEM((kh, qt, hd), k_new.dtype),       # new-token K tile
+            pltpu.VMEM((kh, qt, hd), v_new.dtype),
+            pltpu.VMEM((kh, tile_rows, hd), jnp.float32),
+            pltpu.VMEM((kh, tile_rows, 128), jnp.float32),
+            pltpu.VMEM((kh, tile_rows, 128), jnp.float32),
+            pltpu.VMEM((n_win, kh, wh, hd), k_pages.dtype),
+            pltpu.VMEM((n_win, kh, wh, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((n_win, 2)),
+            pltpu.SemaphoreType.DMA((4,)),  # q/kn/vn loads + o store
+        ],
+    )
+    pool_at = 4 + len(operands)  # k_pages index among ALL (flat) args
+    out, k_pages, v_pages = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((kh, tp * n_rep_p, hd), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        input_output_aliases={pool_at: 1, pool_at + 1: 2},
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q_starts.astype(jnp.int32), q_lens.astype(jnp.int32),
+      *operands, k_pages, v_pages)
+    out = out.reshape(kh, tp, n_rep_p, hd)[:, :, :n_rep]
+    return out.transpose(1, 0, 2, 3).reshape(tp, h, hd), k_pages, v_pages
+
+
+def ragged_spans_xla(
+    q: jnp.ndarray,            # [Tp, H, hd]
+    k_new: jnp.ndarray,        # [Tp, K, hd]
+    v_new: jnp.ndarray,        # [Tp, K, hd]
+    k_pages: jnp.ndarray,      # [P, K, ps, hd]
+    v_pages: jnp.ndarray,      # [P, K, ps, hd]
+    page_tables: jnp.ndarray,  # [B, W]
+    kv_lens: jnp.ndarray,      # [B] tokens in cache BEFORE this dispatch
+    q_starts: jnp.ndarray,     # [B]
+    q_lens: jnp.ndarray,       # [B]
+    row_flat: jnp.ndarray,     # [Tp] owning row per flat token (>= B: none)
+    max_pos: int | None = None,
+    kv_scales=None,            # (k_scale, v_scale) [B, K, hd] for int8 pools
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Scatter + gather reference for the ragged span kernel: same contract
+    on any platform (correctness baseline, the sp>1 path, and the CPU /
+    first-run-lowering fallback).  ``row_flat`` is the host-built inverse
+    of the span list — the kernel derives it from (q_starts, q_lens); XLA
+    wants it materialized.  Out-of-span tokens park their writes on the
+    reserved null page (id 0) and produce zero output rows."""
+    tp, h, hd = q.shape
+    _, kh, ps, _ = k_pages.shape
+    b, w = page_tables.shape
+    rf = jnp.clip(row_flat, 0, b - 1)
+    off = jnp.arange(tp) - q_starts[rf]
+    in_span = (row_flat < b) & (off >= 0) & (off < q_lens[rf])
+    pos = kv_lens[rf] + off  # absolute position of each flat token
+    writable = in_span & (pos < w * ps)
+    if max_pos is not None:
+        writable &= pos < max_pos
+    pos_c = jnp.where(writable, pos, 0)
+    page = jnp.where(
+        writable,
+        page_tables[rf, jnp.clip(pos_c // ps, 0, w - 1)], 0)
+    if kv_scales is not None:
+        # per-token rule: each flat token quantizes with its OWN row's
+        # scales (the span analog of the packed-prefill path)
+        from lmrs_tpu.ops.quant import kv_quant_tokens
+
+        k_new = kv_quant_tokens(k_new, kv_scales[0][rf])
+        v_new = kv_quant_tokens(v_new, kv_scales[1][rf])
+    k_pages = k_pages.at[page, :, pos_c % ps].set(k_new)
+    v_pages = v_pages.at[page, :, pos_c % ps].set(v_new)
+
+    n_rep = h // kh
+    k_win = k_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(
+        b, w * ps, kh, hd)
+    v_win = v_pages[page_tables].transpose(0, 1, 3, 2, 4).reshape(
+        b, w * ps, kh, hd)
+    if kv_scales is not None:
+        from lmrs_tpu.ops.quant import kv_dequant
+
+        k_win = kv_dequant(k_win, kv_scales[0], q.dtype)
+        v_win = kv_dequant(v_win, kv_scales[1], q.dtype)
+    if n_rep > 1:
+        k_win = jnp.repeat(k_win, n_rep, axis=2)
+        v_win = jnp.repeat(v_win, n_rep, axis=2)
+    kt = k_win[rf]  # [Tp, W*ps, H, hd] — per-token window gather
+    vt = v_win[rf]
+    logits = jnp.einsum("thd,tkhd->thk", q, kt).astype(jnp.float32) * hd**-0.5
+    col = jnp.arange(w * ps)[None, None, :]
+    mask = in_span[:, None, None] & (col <= pos[:, None, None])
+    if max_pos is not None:
+        mask &= col < max_pos
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # fully-masked (out-of-span) rows: uniform probs -> zero them explicitly
+    out = jnp.einsum("thk,tkhd->thd", probs.astype(vt.dtype), vt)
+    out = jnp.where(in_span[:, None, None], out, 0).astype(q.dtype)
     return out, k_pages, v_pages
 
 

@@ -5,6 +5,12 @@ attention shape across a batch sweep, for the arms:
 
 * walk       — ``paged_decode_pallas`` (page walk only, no RMW)
 * fused      — ``paged_decode_pallas_fused`` (walk + RMW + cross-row pipeline)
+* rpa        — ``ragged_spans_pallas`` at q_len=1 spans: the unified
+               span program the scheduler now routes EVERY phase through
+               (ISSUE 16), measured at its decode-shaped corner so the
+               us/row fit is directly comparable against the retired
+               fused arm it replaced (perf_sentry tracks the
+               ``decode_row_us_rpa`` bench-detail column)
 * walk_gG / fused_gG — the multi-row kernels at row_group=G (one pair per
                entry in LMRS_ROWCOST_GROUPS, default "2,4,8"): the
                group-size sweep behind EngineConfig.decode_row_group —
@@ -32,8 +38,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from lmrs_tpu.ops.paged_attention import (
+    pack_spans,
     paged_decode_pallas,
     paged_decode_pallas_fused,
+    ragged_spans_pallas,
 )
 from lmrs_tpu.utils.env import env_bool, env_list
 from lmrs_tpu.utils.perf_model import time_chain
@@ -45,12 +53,17 @@ REPS = 5
 INTERPRET = env_bool("LMRS_ROWCOST_INTERPRET", False)
 
 
-def make_chain(arm, iters, kn, vn, pt, kl, row_group=1):
+def make_chain(arm, iters, kn, vn, pt, kl, row_group=1, spans=None):
     @jax.jit
     def chain(q, kp, vp):
         def body(_, carry):
             q, kp, vp = carry
-            if arm.startswith("walk"):
+            if arm == "rpa":
+                qs, ql = spans
+                out, kp, vp = ragged_spans_pallas(
+                    q, kn, vn, kp, vp, pt, kl, qs, ql,
+                    interpret=INTERPRET)
+            elif arm.startswith("walk"):
                 out = paged_decode_pallas(q, kp, vp, pt, kl,
                                           interpret=INTERPRET,
                                           row_group=row_group)
@@ -70,13 +83,14 @@ def main():
     lo, hi, reps = LO, HI, REPS
     if INTERPRET:  # emulator chains are ~1000x slower; keep the harness usable
         lo, hi, reps = 2, 8, 2
+    batches = (4, 8) if INTERPRET else (8, 16, 24, 32)
     groups = [int(g) for g in env_list("LMRS_ROWCOST_GROUPS",
                                        ("2", "4", "8"))]
-    arms = [("walk", 1), ("fused", 1)]
+    arms = [("walk", 1), ("fused", 1), ("rpa", 1)]
     for g in groups:
         arms += [(f"walk_g{g}", g), (f"fused_g{g}", g)]
     results = {}
-    for B in (8, 16, 24, 32):
+    for B in batches:
         P = B + 1
         q = jnp.asarray(rng.standard_normal((B, KH * NREP, HD)), jnp.bfloat16)
         kn = jnp.asarray(rng.standard_normal((B, KH, HD)), jnp.bfloat16)
@@ -87,8 +101,26 @@ def main():
             (1 + np.arange(B))[:, None], jnp.int32)  # one live page per row
         kl = jnp.full((B,), LIVE, jnp.int32)
 
+        # span-shaped inputs for the rpa arm: B decode rows = B q_len=1
+        # spans over a SPAN_QT-aligned flat token buffer (kernel reads
+        # only each span's first row; the padding rows are walked but
+        # never gathered — the cost being measured IS that padding tax)
+        ql_np = np.ones((B,), np.int32)
+        qs_np, total = pack_spans(ql_np)
+        qf = jnp.zeros((total, KH * NREP, HD), jnp.bfloat16)
+        qf = qf.at[jnp.asarray(qs_np)].set(q)
+        knf = jnp.zeros((total, KH, HD), jnp.bfloat16)
+        knf = knf.at[jnp.asarray(qs_np)].set(kn)
+        vnf = jnp.zeros((total, KH, HD), jnp.bfloat16)
+        vnf = vnf.at[jnp.asarray(qs_np)].set(vn)
+        spans = (jnp.asarray(qs_np), jnp.asarray(ql_np))
+
         for arm, g in arms:
             def chain(iters, arm=arm, g=g):
+                if arm == "rpa":
+                    fn = make_chain(arm, iters, knf, vnf, pt, kl,
+                                    spans=spans)
+                    return lambda: fn(qf, kp, vp)[0]
                 fn = make_chain(arm, iters, kn, vn, pt, kl, row_group=g)
                 return lambda: fn(q, kp, vp)[0]
 

@@ -7,7 +7,13 @@ LMRS_SPLIT_QUANT=int8 (int8 weights+KV, e.g. the bench-8b arm),
 LMRS_SPLIT_PS (page_size, default 512),
 LMRS_SPLIT_GROUP (decode_row_group, default 4; LMRS_MULTIROW=0 is the
 per-row A/B control — the refreshed-intercept measurement for the
-multi-row page walk is this script run with both settings).
+multi-row page walk is this script run with both settings),
+LMRS_SPLIT_RPA=1 (sweep the unified ragged-span program — q_len=1 spans
+through scheduler._get_rpa_fn — instead of the legacy decode-block fn:
+the ISSUE-16 A/B is this script run with both settings; note the span
+arm dispatches one step per call where the legacy arm scans
+decode_block steps in-graph, so the intercept carries the per-dispatch
+host cost the decode-block scan amortizes).
 """
 import time
 
@@ -21,7 +27,7 @@ from lmrs_tpu.config import EngineConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 from lmrs_tpu.utils.perf_model import decode_step_bytes, weight_bytes
-from lmrs_tpu.utils.env import env_int, env_str
+from lmrs_tpu.utils.env import env_bool, env_int, env_str
 
 
 def main():
@@ -42,7 +48,17 @@ def main():
     rng = np.random.default_rng(0)
     B, S = sched.B, model.max_seq_len
     w = sched.cache.max_pages_per_slot
-    dfn = sched._get_decode_fn(w)
+    rpa = env_bool("LMRS_SPLIT_RPA", False)
+    if rpa:
+        from lmrs_tpu.engine.scheduler import _pow2_bucket
+        from lmrs_tpu.ops.paged_attention import pack_spans
+
+        qs_np, total = pack_spans(np.ones((B,), np.int32))
+        tpb = _pow2_bucket(total, 16)
+        rfn = sched._get_rpa_fn(tpb, w)
+        print(f"arm=rpa token_bucket={tpb} window={w}", flush=True)
+    else:
+        dfn = sched._get_decode_fn(w)
 
     x = jnp.zeros((8,), jnp.float32)
     np.asarray(jax.device_get(x + 1))
@@ -53,6 +69,41 @@ def main():
     onesB = jnp.ones((B,), jnp.float32)
     results = []
     for live in (64, 512, 1024, 1536, 1920):
+        if rpa:
+            # one q_len=1 span per row through the unified program; each
+            # call is ONE decode step, so chain decode_block of them
+            # async and sync once — the legacy arm's in-graph scan, done
+            # at the dispatch layer
+            tokens = jnp.zeros((1, tpb), jnp.int32).at[0, jnp.asarray(
+                qs_np)].set(jnp.asarray(
+                    rng.integers(1, 255, (B,), dtype=np.int32)))
+            row_flat = jnp.full((tpb,), B, jnp.int32).at[jnp.asarray(
+                qs_np)].set(jnp.arange(B, dtype=jnp.int32))
+            rargs = (jnp.arange(B, dtype=jnp.int32), tokens,
+                     jnp.asarray(qs_np), jnp.ones((B,), jnp.int32),
+                     row_flat, jnp.full((B,), live, jnp.int32),
+                     jnp.asarray(qs_np), table, jax.random.PRNGKey(8),
+                     onesB, jnp.zeros((B,), jnp.int32), onesB)
+            k, v, ks, vs = (sched.cache.k, sched.cache.v, sched.kscale,
+                            sched.vscale)
+            nxt, k, v, ks, vs = rfn(sched.params, k, v, ks, vs, *rargs)
+            np.asarray(jax.device_get(nxt))
+            t0 = time.time()
+            for _ in range(3 * sched.decode_block):
+                nxt, k, v, ks, vs = rfn(sched.params, k, v, ks, vs,
+                                        *rargs)
+            np.asarray(jax.device_get(nxt))
+            wall = time.time() - t0 - rtt
+            sched.cache.k, sched.cache.v = k, v
+            sched.kscale, sched.vscale = ks, vs
+            per_step = wall / (3 * sched.decode_block)
+            gb = decode_step_bytes(model, B * live, quantized=bool(quant),
+                                   kv_quantized=bool(quant)) / 1e9
+            results.append((live, per_step, gb))
+            print(f"live={live:5d}  {per_step*1e3:7.3f} ms/step  "
+                  f"{gb:5.2f} GB/step  {gb/per_step:6.0f} GB/s",
+                  flush=True)
+            continue
         dargs = (jnp.asarray(rng.integers(1, 255, (B,), dtype=np.int32)),
                  jnp.full((B,), live, jnp.int32), table,
                  jnp.ones((B,), bool), jax.random.PRNGKey(8), onesB,

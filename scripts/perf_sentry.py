@@ -46,6 +46,7 @@ TRACKED = {
     "model_flops_utilization": "up",
     "hbm_bw_utilization": "up",
     "decode_step_ms": "down",
+    "decode_row_us_rpa": "down",
     "ttft_ms.p50": "down",
     "decode_block_gap_ms.p50": "down",
 }

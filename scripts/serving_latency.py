@@ -99,6 +99,8 @@ def run_arm(mixed: bool) -> dict:
         # measured-window mixed stats (warmup's mixed dispatches excluded,
         # same windowing as decode_dispatches above)
         "mixed_batch": sched._mixed_report(m0),
+        # measured-window ragged-span stats (same windowing)
+        "rpa": sched._rpa_report(m0),
         # windowed cost/SLO attribution (ISSUE 15): per-tenant device-
         # seconds + goodput over the measured wave, and the burn-rate
         # state the wave left the host in — the A/B now reports WHO paid

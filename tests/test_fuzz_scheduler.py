@@ -279,6 +279,69 @@ def test_fuzzed_mixed_admission_bursts(seed, monkeypatch):
     assert m_on["prefill_tokens_piggybacked"] > 0, scenario
 
 
+@pytest.mark.parametrize("seed", [19, 53])
+def test_fuzzed_rpa_admission_bursts(seed, monkeypatch):
+    """Ragged-span dispatch (ISSUE 16) under the same randomized
+    mid-decode admission bursts: greedy token-identity LMRS_RPA=0 vs 1
+    (the span arm must actually dispatch span programs), span-arm
+    determinism, the request contract, and a clean auditor — the fuzzed
+    counterpart of the hand-written A/B matrix in test_rpa.py."""
+    rng = random.Random(seed)
+    mc = _model()
+    scenario = dict(
+        max_batch_slots=rng.choice((2, 3)),
+        page_size=16,
+        num_pages=rng.choice((1, 32)),
+        decode_block=rng.choice((2, 4)),
+        prefill_chunk=rng.choice((64, 4096)),
+        mixed_token_budget=rng.choice((48, 256)),
+        speculate_k=rng.choice((0, 3)),
+    )
+    initial = _requests(rng, rng.randint(2, 4))
+    bursts = [_requests(random.Random(seed + 1 + i), rng.randint(1, 3))
+              for i in range(2)]
+    for i, batch in enumerate(bursts):
+        for r in batch:
+            r.request_id += 100 * (i + 1)
+    trigger = {initial[0].request_id: 0,
+               initial[-1].request_id: 1}
+
+    def run(rpa: str):
+        monkeypatch.setenv("LMRS_RPA", rpa)
+        eng = JaxEngine(EngineConfig(backend="jax", scheduler="continuous",
+                                     max_tokens=24, seed=0, **scenario), mc)
+        fired = set()
+
+        def on_result(res, submit):
+            i = trigger.get(res.request_id)
+            if i is not None and i not in fired:
+                fired.add(i)
+                submit(list(bursts[i]))
+
+        out = eng.generate_batch(list(initial), on_result=on_result)
+        assert eng._scheduler.audit() == []
+        m = dict(eng._scheduler.metrics)
+        eng.shutdown()
+        every = initial + [r for b in bursts for r in b]
+        assert {r.request_id for r in out} == {r.request_id for r in every}
+        by_id = {r.request_id: r for r in every}
+        for res in out:
+            req = by_id[res.request_id]
+            assert res.error is None, res
+            assert res.finish_reason in ("stop", "length")
+            assert res.completion_tokens <= req.max_new_tokens
+        return sorted((r.request_id, r.text, r.finish_reason,
+                       r.completion_tokens) for r in out), m
+
+    base, m_off = run("0")
+    assert m_off["rpa_dispatches"] == 0
+    span1, m_on = run("1")
+    span2, _ = run("1")
+    assert span1 == span2, scenario  # determinism
+    assert span1 == base, scenario   # greedy A/B identity
+    assert m_on["rpa_dispatches"] > 0, scenario
+
+
 def test_fuzzed_slot_reuse_with_interpret_kernels(monkeypatch):
     """Slot recycling + varied lengths through the REAL kernel path
     (interpret): the exact conditions of the r1 stale-length SMEM bug —

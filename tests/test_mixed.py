@@ -119,7 +119,9 @@ def test_mixed_identity_on_interpret_kernels(monkeypatch, spec_k):
     sched = on._scheduler
     assert sched.metrics["mixed_dispatches"] > 0, "mixed path not exercised"
     assert sched._use_ragged, "multi-token kernel silently degraded"
-    assert sched._mixed_fns, "no mixed shape compiled"
+    # RPA (the default) compiles span programs; LMRS_RPA=0 the legacy
+    # mixed family — either way a fused shape must actually have built
+    assert sched._rpa_fns or sched._mixed_fns, "no mixed shape compiled"
     assert sched.audit() == []
     on.shutdown()
     assert got == want
@@ -186,9 +188,11 @@ def test_mixed_metrics_and_report_shape():
     eng.shutdown()
 
 
-def test_mixed_gated_off_under_int8_kv():
-    """kv_quantize=int8 cannot own a mixed chunk's prefill scales: the
+def test_mixed_gated_off_under_int8_kv(monkeypatch):
+    """LEGACY dispatch (LMRS_RPA=0): kv_quantize=int8 cannot own a mixed
+    chunk's prefill scales through the [B, T] fused path, so the
     dispatcher must disarm itself (and say so in the report)."""
+    monkeypatch.setenv("LMRS_RPA", "0")
     mc = tiny_model()
     eng = JaxEngine(_cfg(True, page_size=32, kv_quantize="int8",
                          prefix_cache=False), mc)
@@ -198,6 +202,26 @@ def test_mixed_gated_off_under_int8_kv():
     assert all(r.error is None for r in out)
     assert eng._scheduler.metrics["mixed_dispatches"] == 0
     eng.shutdown()
+
+
+def test_mixed_int8_kv_armed_under_rpa(monkeypatch):
+    """The retired composition gate (ISSUE 16): under ragged span
+    dispatch int8 KV x mixed RUNS — per-row frozen scales ride the span
+    descriptor (a fresh-start slice owns its slot's scales, every other
+    row clamps) — with greedy token identity against the int8
+    alternating path and a clean audit."""
+    mc = tiny_model()
+    reqs = _mix_requests()
+    cfg = lambda mixed: _cfg(mixed, page_size=32, kv_quantize="int8",
+                             prefix_cache=False)
+    monkeypatch.setenv("LMRS_MIXED", "0")
+    want, m_off = _run(cfg(True), mc, reqs)
+    assert m_off["mixed_dispatches"] == 0
+    monkeypatch.setenv("LMRS_MIXED", "1")
+    got, m_on = _run(cfg(True), mc, reqs)
+    assert m_on["mixed_dispatches"] > 0, "int8 x mixed not exercised"
+    assert m_on["rpa_dispatches"] > 0
+    assert got == want
 
 
 def test_mixed_budget_floor_falls_back_to_alternating():

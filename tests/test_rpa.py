@@ -1,0 +1,367 @@
+"""Unified ragged-span dispatch (ISSUE 16): one kernel for every phase.
+
+Two layers of contract:
+
+* ops-level — ``ragged_spans_pallas`` (interpret mode) must reproduce the
+  kernels it retires at their own shapes: the fused single-token decode
+  kernel at q_len=1 spans, the multi-token verify kernel at q_len=T
+  spans, and the ``ragged_spans_xla`` scatter+gather reference on mixed
+  span lists (decode rows + a long prefill-slice row + inactive rows),
+  bf16-free f32 inputs and int8 pools both.  Pool comparisons are
+  restricted to each row's VALID prefix (positions < base + q_len): the
+  span kernel's tile-padding tokens write garbage at FUTURE positions by
+  the mixed path's existing convention, where the references park them
+  on the null page.
+
+* scheduler-level — greedy outputs must be token-identical with
+  ``LMRS_RPA=0`` (legacy per-phase dispatch) vs ``1`` across the
+  prefix-cache x speculation x int8-KV matrix, the kill switch must be
+  byte-for-byte (legacy program caches populated, span caches empty),
+  and the one-bucket-family claim must show up as a compile-shape count
+  no larger than the legacy per-phase families for the same workload.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.ops.paged_attention import (
+    pack_spans,
+    paged_decode_pallas_fused,
+    paged_decode_pallas_multi,
+    ragged_spans_pallas,
+    ragged_spans_xla,
+)
+
+# --------------------------------------------------------------- ops level
+
+
+def _span_fixture(seed, q_lens, h=8, kh=4, hd=128, ps=16, n_pages=32,
+                  width=3):
+    """Flat span buffers + per-row pools/tables.  Every flat row gets
+    random q/k/v — including the alignment-padding rows — so parity also
+    proves the padding is masked, not merely zero."""
+    b = len(q_lens)
+    qs, total = pack_spans(np.asarray(q_lens, np.int32))
+    rng = jax.random.split(jax.random.PRNGKey(seed), 5)
+    qf = jax.random.normal(rng[0], (total, h, hd), jnp.float32)
+    knf = jax.random.normal(rng[1], (total, kh, hd), jnp.float32)
+    vnf = jax.random.normal(rng[2], (total, kh, hd), jnp.float32)
+    k_pages = jax.random.normal(rng[3], (n_pages, kh, ps, hd), jnp.float32)
+    v_pages = jax.random.normal(rng[4], (n_pages, kh, ps, hd), jnp.float32)
+    tables = jnp.asarray(
+        np.random.default_rng(seed).permutation(n_pages - 1)[: b * width]
+        .reshape(b, width) + 1, jnp.int32)
+    row_flat = np.full((total,), b, np.int32)
+    for i, (s, l) in enumerate(zip(qs, q_lens)):
+        row_flat[s:s + l] = i
+    return qs, total, qf, knf, vnf, k_pages, v_pages, tables, row_flat
+
+
+def _valid_windows(pool, tables, upto, ps):
+    """Per-row gathered window prefix [upto[b], K, hd] — the region both
+    implementations must agree on bit-for-bit (past it lies the span
+    kernel's future-position padding garbage)."""
+    win = np.asarray(pool)[np.asarray(tables)]          # [B, W, K, ps, hd]
+    win = win.transpose(0, 1, 3, 2, 4).reshape(
+        win.shape[0], -1, win.shape[2], win.shape[4])   # [B, W*ps, K, hd]
+    return [win[i, :int(u)] for i, u in enumerate(np.asarray(upto))]
+
+
+def _assert_pool_parity(got_pool, ref_pool, tables, upto, ps):
+    for g, r in zip(_valid_windows(got_pool, tables, upto, ps),
+                    _valid_windows(ref_pool, tables, upto, ps)):
+        np.testing.assert_array_equal(g, r)
+
+
+def test_rpa_decode_parity_vs_fused():
+    """q_len=1 spans vs the retired fused single-token decode kernel:
+    same attention outputs (each span's one real row) and same pool
+    contents over every row's valid prefix.  Ragged bases including a
+    fresh (base 0) row and an inactive (q_len=0) row."""
+    q_lens = [1, 0, 1, 1, 1]
+    bases = np.asarray([39, 0, 16, 47, 0], np.int32)
+    ps = 16
+    qs, total, qf, knf, vnf, kp, vp, tables, row_flat = _span_fixture(
+        0, q_lens, ps=ps)
+
+    got, k_out, v_out = ragged_spans_pallas(
+        qf, knf, vnf, kp, vp, tables, jnp.asarray(bases),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32), interpret=True)
+
+    # the fused kernel's kv_lens INCLUDE the written token; inactive = 0
+    q1 = jnp.stack([qf[s] for s in qs])
+    kn1 = jnp.stack([knf[s] for s in qs])
+    vn1 = jnp.stack([vnf[s] for s in qs])
+    fused_lens = jnp.asarray(
+        [b + l for b, l in zip(bases, q_lens)], jnp.int32)
+    want, k_ref, v_ref = paged_decode_pallas_fused(
+        q1, kn1, vn1, kp, vp, tables, fused_lens, interpret=True)
+
+    for i, l in enumerate(q_lens):
+        if l:
+            np.testing.assert_allclose(np.asarray(got[qs[i]]),
+                                       np.asarray(want[i]),
+                                       rtol=2e-5, atol=2e-5)
+    upto = bases + np.asarray(q_lens)
+    _assert_pool_parity(k_out, k_ref, tables, upto, ps)
+    _assert_pool_parity(v_out, v_ref, tables, upto, ps)
+
+
+def test_rpa_verify_parity_vs_multi():
+    """q_len=T spans vs the retired multi-token verify kernel: all T
+    per-token outputs and the written span, across page-straddling,
+    in-page, window-straddling, and fresh (base 0) rows."""
+    t, ps = 3, 16
+    bases = np.asarray([15, 3, 32, 0], np.int32)
+    q_lens = [t] * 4
+    qs, total, qf, knf, vnf, kp, vp, tables, row_flat = _span_fixture(
+        1, q_lens, ps=ps)
+
+    got, k_out, v_out = ragged_spans_pallas(
+        qf, knf, vnf, kp, vp, tables, jnp.asarray(bases),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32), interpret=True)
+
+    qm = jnp.stack([qf[s:s + t] for s in qs])       # [B, T, H, hd]
+    knm = jnp.stack([knf[s:s + t] for s in qs])
+    vnm = jnp.stack([vnf[s:s + t] for s in qs])
+    multi_lens = jnp.asarray(bases + t, jnp.int32)  # includes the T tokens
+    want, k_ref, v_ref = paged_decode_pallas_multi(
+        qm, knm, vnm, kp, vp, tables, multi_lens, interpret=True)
+
+    for i, s in enumerate(qs):
+        np.testing.assert_allclose(np.asarray(got[s:s + t]),
+                                   np.asarray(want[i]),
+                                   rtol=2e-5, atol=2e-5)
+    upto = bases + t
+    _assert_pool_parity(k_out, k_ref, tables, upto, ps)
+    _assert_pool_parity(v_out, v_ref, tables, upto, ps)
+
+
+def test_rpa_mixed_spans_match_xla_reference():
+    """A genuinely MIXED span list — decode rows, a long prefill-slice
+    row whose length is not a SPAN_QT multiple, and an inactive row —
+    against the scatter+gather reference (the sp>1 / CPU-fallback path):
+    in-span outputs agree and pools agree over every valid prefix."""
+    q_lens = [1, 13, 1, 0]
+    bases = np.asarray([20, 7, 0, 0], np.int32)
+    ps = 16
+    qs, total, qf, knf, vnf, kp, vp, tables, row_flat = _span_fixture(
+        2, q_lens, ps=ps)
+
+    got, k_out, v_out = ragged_spans_pallas(
+        qf, knf, vnf, kp, vp, tables, jnp.asarray(bases),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32), interpret=True)
+    want, k_ref, v_ref = ragged_spans_xla(
+        qf, knf, vnf, kp, vp, tables, jnp.asarray(bases),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32),
+        jnp.asarray(row_flat))
+
+    in_span = row_flat < len(q_lens)
+    np.testing.assert_allclose(np.asarray(got)[in_span],
+                               np.asarray(want)[in_span],
+                               rtol=2e-5, atol=2e-5)
+    upto = bases + np.asarray(q_lens)
+    _assert_pool_parity(k_out, k_ref, tables, upto, ps)
+    _assert_pool_parity(v_out, v_ref, tables, upto, ps)
+
+
+def test_rpa_mixed_spans_int8_parity():
+    """The same mixed span list over int8 pools (the composition the
+    legacy dispatcher forbade): per-token quantization through the span
+    RMW must reproduce the XLA reference bit-for-bit over every valid
+    prefix, and the dequantized walk must agree on in-span outputs."""
+    q_lens = [1, 13, 1, 0]
+    bases = np.asarray([20, 7, 0, 0], np.int32)
+    b, kh, hd, ps, n_pages = 4, 4, 128, 64, 12
+    qs, total, qf, knf, vnf, _, _, _, row_flat = _span_fixture(
+        3, q_lens, ps=ps, n_pages=n_pages, width=2)
+    rng = np.random.default_rng(3)
+    kq = jnp.asarray(rng.integers(-127, 128, (n_pages, kh, ps, hd)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.integers(-127, 128, (n_pages, kh, ps, hd)),
+                     jnp.int8)
+    tables = jnp.asarray(rng.permutation(n_pages - 1)[: b * 2]
+                         .reshape(b, 2) + 1, jnp.int32)
+    ks = jnp.asarray(rng.uniform(0.01, 0.05, (b, kh, hd)), jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.05, (b, kh, hd)), jnp.float32)
+
+    got, k_out, v_out = ragged_spans_pallas(
+        qf, knf, vnf, kq, vq, tables, jnp.asarray(bases),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32), interpret=True,
+        kscale=ks, vscale=vs)
+    want, k_ref, v_ref = ragged_spans_xla(
+        qf, knf, vnf, kq, vq, tables, jnp.asarray(bases),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32),
+        jnp.asarray(row_flat), kv_scales=(ks, vs))
+
+    in_span = row_flat < b
+    np.testing.assert_allclose(np.asarray(got)[in_span],
+                               np.asarray(want)[in_span],
+                               rtol=2e-5, atol=2e-5)
+    upto = bases + np.asarray(q_lens)
+    _assert_pool_parity(k_out, k_ref, tables, upto, ps)
+    _assert_pool_parity(v_out, v_ref, tables, upto, ps)
+
+
+# --------------------------------------------------------- scheduler level
+
+
+def tiny_model():
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+def _cfg(**kw) -> EngineConfig:
+    base = dict(backend="jax", scheduler="continuous", max_tokens=16,
+                max_batch_slots=2, seed=0, decode_block=3,
+                prefill_chunk=64, mixed_batch=True)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _mix_requests(n: int = 4) -> list[GenerationRequest]:
+    pre = "shared span preamble alpha beta "
+    reqs = []
+    for i in range(n):
+        body = (f"request {i} " + "span probe words here " * (1 + 5 * (i % 2)))
+        reqs.append(GenerationRequest(
+            prompt=(pre if i % 2 else "") + body, request_id=i,
+            temperature=0.0, max_new_tokens=12 + i))
+    return reqs
+
+
+def _run(cfg: EngineConfig, mc, reqs):
+    """Returns (texts, metrics, program-cache key sets) for one engine
+    run; audits clean."""
+    eng = JaxEngine(cfg, mc)
+    out = eng.generate_batch(reqs)
+    sched = eng._scheduler
+    assert sched.audit() == []
+    assert all(r.error is None for r in out)
+    texts = [(r.text, r.finish_reason, r.completion_tokens) for r in out]
+    m = dict(sched.metrics)
+    caches = {"rpa": set(sched._rpa_fns),
+              "mixed": set(sched._mixed_fns),
+              "window": set(sched._prefill_window_fns),
+              "decode": set(sched._decode_fns)}
+    eng.shutdown()
+    return texts, m, caches
+
+
+@pytest.mark.parametrize("prefix_cache", [True, False])
+@pytest.mark.parametrize("spec_k", [0, 3])
+def test_rpa_greedy_identity_matrix(monkeypatch, prefix_cache, spec_k):
+    """LMRS_RPA=0 vs 1 greedy token identity across prefix-cache x
+    speculation with mixed batches armed — the ISSUE 16 acceptance bar.
+    The span arm must actually dispatch span programs."""
+    mc = tiny_model()
+    reqs = _mix_requests()
+    cfg = lambda: _cfg(prefix_cache=prefix_cache, speculate_k=spec_k)
+    monkeypatch.setenv("LMRS_RPA", "0")
+    want, m_off, _ = _run(cfg(), mc, reqs)
+    assert m_off["rpa_dispatches"] == 0  # kill switch really off
+    monkeypatch.setenv("LMRS_RPA", "1")
+    got, m_on, _ = _run(cfg(), mc, reqs)
+    assert m_on["rpa_dispatches"] > 0, "span path not exercised"
+    assert got == want
+
+
+@pytest.mark.parametrize("spec_k", [0, 3])
+def test_rpa_greedy_identity_int8_kv(monkeypatch, spec_k):
+    """The forbidden compositions, armed: int8 KV x mixed (x spec) runs
+    through the span path with greedy outputs identical to the legacy
+    per-phase dispatch of the same int8 engine."""
+    mc = tiny_model()
+    reqs = _mix_requests()
+    cfg = lambda: _cfg(page_size=32, kv_quantize="int8",
+                       prefix_cache=False, speculate_k=spec_k)
+    monkeypatch.setenv("LMRS_RPA", "0")
+    want, m_off, _ = _run(cfg(), mc, reqs)
+    assert m_off["rpa_dispatches"] == 0
+    monkeypatch.setenv("LMRS_RPA", "1")
+    got, m_on, _ = _run(cfg(), mc, reqs)
+    assert m_on["rpa_dispatches"] > 0, "int8 span path not exercised"
+    assert m_on["mixed_dispatches"] > 0, "int8 x mixed not armed"
+    assert got == want
+
+
+def test_rpa_killswitch_byte_for_byte(monkeypatch):
+    """LMRS_RPA=0 restores the legacy dispatch layer wholesale: no span
+    program compiles, the legacy mixed family compiles instead, and the
+    outputs match the span arm byte for byte."""
+    mc = tiny_model()
+    reqs = _mix_requests()
+    monkeypatch.setenv("LMRS_RPA", "0")
+    want, m_off, c_off = _run(_cfg(), mc, reqs)
+    assert not c_off["rpa"], "legacy arm compiled a span program"
+    assert c_off["mixed"], "legacy mixed family did not compile"
+    assert m_off["rpa_compile_shapes"] == 0
+    monkeypatch.setenv("LMRS_RPA", "1")
+    got, m_on, c_on = _run(_cfg(), mc, reqs)
+    assert c_on["rpa"], "span arm compiled no span program"
+    assert not c_on["mixed"], "span arm still compiled legacy mixed fns"
+    assert m_on["rpa_compile_shapes"] == len(c_on["rpa"])
+    assert got == want
+
+
+def test_rpa_compile_shapes_do_not_exceed_legacy(monkeypatch):
+    """One bucket family: for the same workload the span arm's distinct
+    compiled program count must not exceed the legacy per-phase families
+    it replaces (mixed [t,w] + prefill-window [s,w]), and the span
+    metric must report real span tokens."""
+    mc = tiny_model()
+    reqs = _mix_requests(6)
+    monkeypatch.setenv("LMRS_RPA", "0")
+    _, m_off, c_off = _run(_cfg(max_batch_slots=3), mc, reqs)
+    legacy = len(c_off["mixed"]) + len(c_off["window"])
+    assert legacy > 0, "workload never exercised the retired families"
+    monkeypatch.setenv("LMRS_RPA", "1")
+    _, m_on, c_on = _run(_cfg(max_batch_slots=3), mc, reqs)
+    assert 0 < len(c_on["rpa"]) <= legacy
+    assert m_on["rpa_span_tokens"] > 0
+    assert m_on["rpa_span_tokens"] >= m_on["rpa_dispatches"]
+
+
+def test_rpa_report_block_shape():
+    """The windowed ``rpa`` report block bench/serving_latency consume:
+    keys exist, dispatch counts agree with the counters, compile_shapes
+    stays cumulative."""
+    mc = tiny_model()
+    eng = JaxEngine(_cfg(), mc)
+    eng.generate_batch(_mix_requests())
+    sched = eng._scheduler
+    m = sched.metrics
+    blk = sched.metrics_report()["rpa"]
+    assert blk["enabled"] is True
+    assert blk["dispatches"] == m["rpa_dispatches"]
+    assert blk["span_tokens"] == m["rpa_span_tokens"]
+    assert blk["compile_shapes"] == m["rpa_compile_shapes"]
+    eng.shutdown()
+
+
+def test_mock_engine_rpa_block(monkeypatch):
+    """No-device knob parity: the mock exposes the same ``rpa`` metrics
+    block and the LMRS_RPA kill switch disarms it."""
+    from lmrs_tpu.engine.mock import MockEngine
+
+    reqs = [GenerationRequest(prompt="one " * 30, request_id=0),
+            GenerationRequest(prompt="two " * 50, request_id=1),
+            GenerationRequest(prompt="three " * 20, request_id=2)]
+    eng = MockEngine(mixed_token_budget=64)
+    assert eng.generate_batch(reqs)
+    blk = eng.engine_metrics()["rpa"]
+    assert blk["enabled"] and blk["dispatches"] > 0
+    assert blk["span_tokens"] >= blk["dispatches"]
+    assert blk["compile_shapes"] >= 1
+    monkeypatch.setenv("LMRS_RPA", "0")
+    off = MockEngine(mixed_token_budget=64)
+    off.generate_batch(reqs)
+    assert "rpa" not in off.engine_metrics()
