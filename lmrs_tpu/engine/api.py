@@ -86,6 +86,14 @@ class GenerationRequest:
     # job/session for free).  None = the engine bills the request to the
     # "default" tenant.
     tenant: str | None = None
+    # QoS priority class (fleet/qos.py): "interactive" | "batch".
+    # Stamped at ingress from the ``X-LMRS-QoS-Class`` header (or the
+    # ``qos_class`` body field) and propagated like the tenant label;
+    # jobs stamp their fan-out "batch", live sessions "interactive".
+    # None resolves to "interactive" — QoS can never demote traffic
+    # that predates the label.  Stamping only happens while LMRS_QOS is
+    # armed, so the kill switch keeps the wire byte-identical.
+    qos_class: str | None = None
 
 
 def preamble_text(system_prompt: str | None, prompt: str,
@@ -280,9 +288,17 @@ class TenantStampEngine:
     the managers already accept."""
 
     def __init__(self, engine: "Engine", tenant: str | None,
-                 publish=None, seed: dict | None = None):
+                 publish=None, seed: dict | None = None,
+                 qos_class: str | None = None):
         self._engine = engine
         self.tenant = tenant
+        # priority-class stamp (fleet/qos.py): jobs pass "batch", live
+        # sessions "interactive"; only applied while LMRS_QOS is armed
+        # (the kill switch must keep the wire byte-identical), and never
+        # over a class the submit already labeled
+        from lmrs_tpu.fleet.qos import qos_enabled
+
+        self.qos_class = qos_class if qos_enabled() else None
         # ``publish`` receives an atomic SNAPSHOT dict after every merge:
         # readers (job/session status docs on HTTP handler threads) hold
         # a reference that is replaced, never mutated — json.dumps can
@@ -296,10 +312,7 @@ class TenantStampEngine:
 
     def generate_batch(self, requests: list["GenerationRequest"],
                        on_result=None, on_tokens=None):
-        if self.tenant:
-            for req in requests:
-                if req.tenant is None:
-                    req.tenant = self.tenant
+        self._stamp(requests)
 
         def absorb(res: "GenerationResult") -> None:
             if res.usage:
@@ -321,16 +334,20 @@ class TenantStampEngine:
             absorb(res)
 
             def stamped_submit(more: list["GenerationRequest"]) -> None:
-                if self.tenant:
-                    for req in more:
-                        if req.tenant is None:
-                            req.tenant = self.tenant
+                self._stamp(more)
                 submit(more)
 
             on_result(res, stamped_submit)
 
         return self._engine.generate_batch(requests, on_result=wrapped,
                                            on_tokens=on_tokens)
+
+    def _stamp(self, requests: list["GenerationRequest"]) -> None:
+        for req in requests:
+            if self.tenant and req.tenant is None:
+                req.tenant = self.tenant
+            if self.qos_class and req.qos_class is None:
+                req.qos_class = self.qos_class
 
     def __getattr__(self, name: str):
         return getattr(self._engine, name)

@@ -272,6 +272,13 @@ class JaxEngine:
             return {"enabled": False, "state": "ok", "specs": {}}
         return self._scheduler.slo_report()
 
+    def qos_report(self) -> dict:
+        """Optional Engine hook: the fair-share window state exported as
+        the ``GET /v1/usage`` ``qos`` block (fleet/qos.py)."""
+        if self._scheduler is None:
+            return {"object": "qos", "enabled": False}
+        return self._scheduler.qos_report()
+
     # ---------------------------------------- disaggregated handoff hooks
     # (optional Engine surface, same getattr convention as ``cancel``):
     # the continuous scheduler implements the real page pin/export/import

@@ -70,7 +70,9 @@ class MockEngine:
                  host_kv: bool | None = None,
                  host_kv_gb: float = 1.0,
                  cost_ledger: bool | None = None,
-                 slo: bool | None = None):
+                 slo: bool | None = None,
+                 slots: int = 0,
+                 qos: bool | None = None):
         from lmrs_tpu.utils.env import env_bool
 
         self.seed = seed
@@ -166,6 +168,70 @@ class MockEngine:
         # while generate_batch pins
         self._pinned: dict[int, dict] = {}
         self._pinned_lock = threading.Lock()
+        # Multi-tenant QoS parity (fleet/qos.py): the same fair-share
+        # admission surface as the jax scheduler.  slots=0 (default) is
+        # byte-identical to the pre-QoS mock: every generate_batch call
+        # runs immediately, no gate, no reordering.  slots>0 bounds the
+        # number of concurrently *running* requests across handler
+        # threads; waiting tickets are admitted FIFO when QoS is
+        # disarmed and in fair-share order (class, windowed usage,
+        # arrival) when armed — the contention source the fairness A/B
+        # needs on a deviceless host.
+        from lmrs_tpu.fleet.qos import maybe_qos
+
+        self.qos = maybe_qos() if (qos is None or bool(qos)) else None
+        if self.qos is not None:
+            # same lock-ordering contract as the scheduler: the ledger
+            # fires the observer after releasing its own lock
+            self.ledger.observer = self.qos.note_usage
+        self.slots = max(0, int(slots))
+        self._adm_cv = threading.Condition()
+        self._adm_queue: list = []  # waiting (seq, req) tickets  guarded-by: _adm_cv
+        self._adm_seq = 0           # guarded-by: _adm_cv
+        self._adm_running = 0       # guarded-by: _adm_cv
+
+    def _adm_pick_locked(self):
+        """Next ticket to admit.  FIFO by arrival seq when QoS is
+        disarmed; the policy's fair-share order when armed.  The queue
+        list stays append-ordered, so list index == FIFO rank and
+        pick_index's tie-break matches arrival order."""
+        # holds-lock: _adm_cv
+        if self.qos is None:
+            return self._adm_queue[0]
+        return self._adm_queue[self.qos.pick_index(
+            [t[1] for t in self._adm_queue])]
+
+    def _admit_wait(self, req: GenerationRequest) -> None:
+        """Block until a run slot is free and this request is the
+        admission policy's pick.  No-op when slots=0 (unlimited)."""
+        if self.slots <= 0:
+            return
+        with self._adm_cv:
+            ticket = (self._adm_seq, req)
+            self._adm_seq += 1
+            self._adm_queue.append(ticket)
+            while not (self._adm_running < self.slots
+                       and self._adm_pick_locked() is ticket):
+                # timed wait: a lost wakeup only delays, never deadlocks
+                self._adm_cv.wait(timeout=0.2)
+            self._adm_queue.remove(ticket)
+            self._adm_running += 1
+            # another slot may still be free for the next pick
+            self._adm_cv.notify_all()
+
+    def _admit_release(self) -> None:
+        if self.slots <= 0:
+            return
+        with self._adm_cv:
+            self._adm_running -= 1
+            self._adm_cv.notify_all()
+
+    def qos_report(self) -> dict:
+        """Per-tenant fair-share snapshot — same shape as the
+        scheduler's (served under /v1/usage as the "qos" block)."""
+        if self.qos is None:
+            return {"object": "qos", "enabled": False}
+        return self.qos.report()
 
     def generate_batch(self, requests: list[GenerationRequest],
                        on_result=None, on_tokens=None) -> list[GenerationResult]:
@@ -180,6 +246,13 @@ class MockEngine:
         faults.fire("engine.batch")
 
         def one(req: GenerationRequest) -> GenerationResult:
+            self._admit_wait(req)
+            try:
+                return _one_admitted(req)
+            finally:
+                self._admit_release()
+
+        def _one_admitted(req: GenerationRequest) -> GenerationResult:
             tr = get_tracer()
             t0 = time.time()
             res = self._one(req)
@@ -572,6 +645,8 @@ class MockEngine:
                     payload["trace_id"] = req.trace_id
                 if req.tenant:
                     payload["tenant"] = req.tenant
+                if req.qos_class:
+                    payload["qos_class"] = req.qos_class
                 with self._pinned_lock:
                     self._pinned[req.request_id] = {
                         "payload": payload,

@@ -42,6 +42,7 @@ from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
 from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
                                       audit_allocator)
 from lmrs_tpu.engine.prefix_cache import PrefixCache
+from lmrs_tpu.fleet.qos import maybe_qos
 from lmrs_tpu.models.transformer import forward_paged
 from lmrs_tpu.ops.paged_attention import pack_spans
 from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, CostLedger,
@@ -561,6 +562,16 @@ class ContinuousScheduler:
         # audit().  LMRS_COST_LEDGER=0 turns every note into a no-op
         # (pure host bookkeeping; outputs byte-identical either way).
         self._cost = CostLedger(self.registry)
+        # Fair-share QoS (fleet/qos.py): admission picks by (class rank,
+        # windowed device-seconds / weight, FIFO) and preemption
+        # victimizes over-quota bulk work first.  The ledger's per-
+        # dispatch apportionment feeds the policy's sliding window (the
+        # observer fires outside the ledger lock).  LMRS_QOS=0 leaves
+        # _qos None and every hook below is a single is-None branch —
+        # byte-for-byte today's FIFO admission and youngest-victim rule.
+        self._qos = maybe_qos(self.registry)
+        if self._qos is not None:
+            self._cost.observer = self._qos.note_usage
         # per-row prefill work issued since the last consumption —
         # (req, tokens, flops) mirrors of _attr_pending_flops, consumed
         # by whichever dispatch fetch charges the wave's wall
@@ -695,6 +706,12 @@ class ContinuousScheduler:
     def slo_report(self) -> dict:
         """Burn-rate SLO evaluation (the ``/healthz`` ``slo`` block)."""
         return self._slo.report()
+
+    def qos_report(self) -> dict:
+        """Fair-share window state (the ``GET /v1/usage`` ``qos`` block)."""
+        if self._qos is None:
+            return {"object": "qos", "enabled": False}
+        return self._qos.report()
 
     def cost_finish(self, req: GenerationRequest, res: GenerationResult
                     ) -> None:
@@ -1076,6 +1093,22 @@ class ContinuousScheduler:
             for b in range(self.B):
                 if slots[b] is not None:
                     continue
+                # Fair-share admission (fleet/qos.py): promote the policy's
+                # pick from the queue's head window to the front — best
+                # (class rank, normalized windowed usage, FIFO) entry.
+                # The remaining entries keep their relative order (this is
+                # a targeted promotion, not a rotation — skipped entries
+                # must not migrate to the back and starve).  Head window
+                # bounded so a deep backlog costs O(window) per slot, not
+                # O(queue).  _qos is None under LMRS_QOS=0: FIFO exactly.
+                if self._qos is not None and len(queue) > 1:
+                    win = min(len(queue), 64)
+                    k = self._qos.pick_index(
+                        [queue[i][0] for i in range(win)])
+                    if k:
+                        ent = queue[k]
+                        del queue[k]
+                        queue.appendleft(ent)
                 # Deadline admission control (load shedding): drop head
                 # entries whose remaining budget cannot cover the TTFT
                 # estimate — a fast explicit rejection BEFORE prefill beats
@@ -1912,6 +1945,10 @@ class ContinuousScheduler:
         # decode pod bills its share of the request to the same tenant
         if st.req.tenant:
             payload["tenant"] = st.req.tenant
+        # ... and the QoS class: the decode leg competes in the class
+        # the prefill leg was admitted under (fleet/qos.py)
+        if st.req.qos_class:
+            payload["qos_class"] = st.req.qos_class
         # budget-overshoot pages (decode-capacity growth past the prompt)
         # are NOT part of the handoff — release them before pinning
         if len(st.seq.pages) > keep:
@@ -2480,8 +2517,12 @@ class ContinuousScheduler:
                     self.cache.grow(st.seq, target)
                     break
                 except OutOfPages:
-                    victim = self._youngest_decode_slot(slots, active,
-                                                        exclude=b)
+                    if self._qos is not None:
+                        victim = self._qos_victim_slot(slots, active,
+                                                       exclude=b)
+                    else:
+                        victim = self._youngest_decode_slot(slots, active,
+                                                            exclude=b)
                     if victim is None:
                         stalled.append(b)
                         active[b] = False
@@ -2490,6 +2531,25 @@ class ContinuousScheduler:
                     self._preempt(victim, slots, queue, kv_lens, last_tok,
                                   active)
         return stalled
+
+    def _qos_victim_slot(self, slots, active, exclude: int) -> int | None:
+        """QoS preemption policy (fleet/qos.py): the WORST active decode
+        slot by (batch class first, highest normalized windowed usage,
+        youngest) — over-quota bulk work pays for the pool before a live
+        session does.  Uniform traffic ties the first two keys and the
+        rule degenerates to the youngest-slot order below."""
+        best, best_key = None, None
+        for b in range(self.B):
+            st = slots[b]
+            if (b == exclude or st is None or not active[b]
+                    or st.phase != "decode"):
+                continue
+            key = self._qos.victim_key(st.req, st.t_start)
+            if best_key is None or key >= best_key:
+                best, best_key = b, key
+        if best is not None:
+            self._qos.note_preempt()
+        return best
 
     def _youngest_decode_slot(self, slots, active, exclude: int) -> int | None:
         """Latest-admitted active decode slot, or None if only ``exclude``

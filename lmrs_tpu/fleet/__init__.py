@@ -1,0 +1,28 @@
+"""Fleet-level QoS enforcement and elasticity (PR 17).
+
+PR 14 built the measurement half of a multi-tenant platform — per-request
+phase-split device-seconds (obs/ledger.py) and burn-rate SLO states
+(obs/slo.py).  This package is the enforcement half:
+
+* :mod:`lmrs_tpu.fleet.qos` — fair-share admission over a sliding window
+  of ledger device-seconds, ``interactive`` > ``batch`` priority classes,
+  and the preemption policy that victimizes over-quota bulk work first;
+* :mod:`lmrs_tpu.fleet.autoscale` — an elastic pool control loop on the
+  router that resizes prefill/decode pools from measured SLO burn and
+  windowed cost, spawning supervised engines and draining hosts through
+  the breaker before removal.
+
+Both halves are pure policy over existing substrates: ``LMRS_QOS=0``
+restores FIFO admission byte-for-byte, ``LMRS_AUTOSCALE=0`` (the
+default) never spawns or drains anything.
+"""
+
+from lmrs_tpu.fleet.autoscale import (Autoscaler, SupervisedHostPool,
+                                      autoscale_enabled, maybe_autoscaler)
+from lmrs_tpu.fleet.qos import (DEFAULT_CLASS, QoSPolicy, class_rank,
+                                clean_qos_class, maybe_qos, qos_enabled)
+
+__all__ = ["Autoscaler", "DEFAULT_CLASS", "QoSPolicy",
+           "SupervisedHostPool", "autoscale_enabled", "class_rank",
+           "clean_qos_class", "maybe_autoscaler", "maybe_qos",
+           "qos_enabled"]

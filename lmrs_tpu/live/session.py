@@ -821,9 +821,15 @@ class SessionManager:
             # serialize a snapshot, never the dict a merge is resizing
             session.usage = snap
 
+        # QoS class rides the refresh's deadline class: an interactive
+        # refresh outranks batch job fan-out by policy (fleet/qos.py);
+        # a bulk refresh competes as batch like any other bulk work
         stamp = TenantStampEngine(self.engine, session.tenant,
                                   publish=_publish_usage,
-                                  seed=session.usage)
+                                  seed=session.usage,
+                                  qos_class=("interactive"
+                                             if klass == "interactive"
+                                             else "batch"))
         executor = MapExecutor(stamp, engine_cfg)
         with session.ctl:
             session._executor = executor

@@ -131,6 +131,11 @@ class CostLedger:
                         if enabled is None else bool(enabled))
         self.max_tenants = env_int("LMRS_COST_TENANTS_MAX", 512, lo=1)
         self.clock = clock or time.time
+        # usage observer (fleet/qos.py fair-share window): called with the
+        # (tenant, device_seconds) pairs of each apportioned dispatch,
+        # AFTER _lock is released — the two locks never nest, so the
+        # policy may read the ledger from its own callers freely
+        self.observer = None
         self._lock = threading.Lock()
         self._entries: dict[int, _Entry] = {}   # guarded-by: _lock
         self._tenants: dict[str, dict] = {}     # guarded-by: _lock
@@ -168,6 +173,10 @@ class CostLedger:
                 "wasted": c("lmrs_cost_wasted_tokens_total",
                             "completion tokens of failed/cancelled/wedged "
                             "outcomes", "tokens"),
+                "overflow": c("lmrs_cost_tenants_overflow_total",
+                              "finished requests whose tenant rollup "
+                              "folded into the aggregate bucket past "
+                              "LMRS_COST_TENANTS_MAX"),
             }
 
     # ----------------------------------------------------------- entry feed
@@ -252,10 +261,13 @@ class CostLedger:
         else:
             decode_wall, prefill_wall = 0.0, wall_s
         page_s = 0.0
+        tenant_s: dict[str, float] = {}  # this dispatch's per-tenant bill
         with self._lock:
             self._wall_seconds += wall_s
-            self._apportion_locked(decode_wall, decode_rows, "decode")
-            self._apportion_locked(prefill_wall, prefill_rows, "prefill")
+            self._apportion_locked(decode_wall, decode_rows, "decode",
+                                   tenant_s)
+            self._apportion_locked(prefill_wall, prefill_rows, "prefill",
+                                   tenant_s)
             # KV page-seconds bill on the FULL dispatch wall: the pages
             # are resident for the whole kernel launch, including a fused
             # step's prefill share (the module-doc / metrics-catalog
@@ -271,8 +283,12 @@ class CostLedger:
             self._c["prefill_s"].inc(prefill_wall)
             if page_s:
                 self._c["page_s"].inc(page_s)
+        obs = self.observer
+        if obs is not None and tenant_s:
+            obs(tenant_s.items())
 
-    def _apportion_locked(self, wall: float, rows, phase: str) -> None:
+    def _apportion_locked(self, wall: float, rows, phase: str,
+                          tenant_s: dict | None = None) -> None:
         """Caller holds self._lock."""  # holds-lock: _lock
         if not rows:
             return
@@ -293,6 +309,8 @@ class CostLedger:
             spent += share
             e = self._entry_locked(req)
             e.vals[field] += share
+            if tenant_s is not None and share > 0:
+                tenant_s[e.tenant] = tenant_s.get(e.tenant, 0.0) + share
             self._step_tokens += tokens
             if phase == "decode":
                 e.attr_decode_tokens += tokens
@@ -318,6 +336,7 @@ class CostLedger:
         # two surfaces can never disagree about the same traffic)
         usable = (res.error is None
                   and res.finish_reason in ("stop", "length", "handoff"))
+        overflowed = False
         with self._lock:
             e = self._entries.pop(res.request_id, None)
             if e is None:
@@ -340,7 +359,8 @@ class CostLedger:
             # so park them in the tenant rollup's hidden counters
             roll = self._tenants.get(e.tenant)
             if roll is None:
-                if len(self._tenants) >= self.max_tenants:
+                if len(self._tenants) >= self.max_tenants \
+                        and e.tenant != OVERFLOW_TENANT:
                     # cardinality cap: fold into the aggregate bucket —
                     # conservation keeps holding because the hidden token
                     # counters travel with whichever rollup is billed
@@ -352,6 +372,7 @@ class CostLedger:
                             self.max_tenants, OVERFLOW_TENANT)
                     roll = self._tenants.setdefault(OVERFLOW_TENANT,
                                                     _zero())
+                    overflowed = True
                 else:
                     roll = self._tenants[e.tenant] = _zero()
             roll.setdefault("_attr_prefill_tokens", 0)
@@ -371,6 +392,8 @@ class CostLedger:
             }
         if self._c:
             self._c["finished"].inc()
+            if overflowed:
+                self._c["overflow"].inc()
             if usage["goodput_tokens"]:
                 self._c["goodput"].inc(usage["goodput_tokens"])
             if usage["wasted_tokens"]:

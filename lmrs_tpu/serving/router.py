@@ -83,6 +83,13 @@ def _request_body(req: GenerationRequest) -> dict:
         # dropped hint silently bloats the remote tree with per-chunk
         # unique bodies
         body["cache_prefix"] = int(req.cache_prefix)
+    if req.qos_class is not None:
+        # fair-share admission runs on the BACKEND scheduler: the class
+        # label the front door resolved must cross the wire or every
+        # forwarded request lands in the default class.  None when QoS
+        # is disarmed (api.TenantStampEngine gates the stamp), so the
+        # LMRS_QOS=0 wire shape is byte-identical to before.
+        body["qos_class"] = req.qos_class
     return body
 
 
@@ -133,9 +140,15 @@ class _Host:
         self._down = False
         self.breaker_state = "closed"  # closed | open | half_open
         self.breaker_opened_t = 0.0    # clock() when last opened
+        # Drain flag (autoscaler scale-down, fleet/autoscale.py): a
+        # draining host leaves the dispatch order like an open breaker
+        # but is NEVER probed back — in-flight requests finish, nothing
+        # new lands, and remove_host() completes the exit once idle.
+        self.draining = False
         self._count_lock = threading.Lock()
         self.served = 0  # guarded-by: _count_lock
         self.failed = 0  # guarded-by: _count_lock
+        self.inflight = 0  # request legs on this host now  guarded-by: _count_lock
         self.consec_failures = 0  # guarded-by: _count_lock
         self.breaker_opens = 0    # guarded-by: _count_lock
         # earliest clock time the next recovery probe may launch (probe
@@ -144,10 +157,17 @@ class _Host:
 
     @property
     def healthy(self) -> bool:
-        """Request-path availability: connect-phase belief AND breaker.
-        A half-open host stays OUT of the dispatch order — only its
-        canary may touch it until the breaker closes."""
-        return not self._down and self.breaker_state == "closed"
+        """Request-path availability: connect-phase belief AND breaker
+        AND not draining.  A half-open host stays OUT of the dispatch
+        order — only its canary may touch it until the breaker closes."""
+        return (not self._down and not self.draining
+                and self.breaker_state == "closed")
+
+    def note_leg(self, delta: int) -> None:
+        """In-flight leg accounting (drain-until-idle needs an exact
+        count, and concurrent legs make bare ``+=`` lossy)."""
+        with self._count_lock:
+            self.inflight += delta
 
     @healthy.setter
     def healthy(self, value: bool) -> None:
@@ -394,6 +414,20 @@ class RouterEngine:
         self.slo_route = (env_bool("LMRS_SLO_ROUTE", True)
                           if slo_route is None else bool(slo_route))
         self._slo_penalized = 0     # guarded-by: _stats_lock
+        # Chargeback-aware placement (docs/SERVING.md § Tenant QoS): a
+        # tenant's traffic sticks to the host that LAST SERVED it — warm
+        # prefixes and spilled KV live there, so repeat traffic from the
+        # same tenant hits instead of re-prefetching fleet-wide.  Weakest
+        # placement opinion: consulted only when prefix placement has
+        # none, and _targets still drops it when the host's published
+        # SLO degrades (stickiness never outranks burn).  The map is a
+        # bounded LRU cache, not truth — an evicted tenant just round-
+        # robins until it lands again.  LMRS_TENANT_ROUTE=0 disarms
+        # byte-for-byte.
+        self.tenant_route = env_bool("LMRS_TENANT_ROUTE", True)
+        self._tenant_hosts: dict[str, str] = {}  # guarded-by: _stats_lock
+        self._tenant_hosts_max = 1024
+        self._tenant_routed = 0     # guarded-by: _stats_lock
         # Tail hedging (LMRS_HEDGE_MS, default 0 = off): a straggling
         # NON-STREAMED request duplicates to a sibling host after a
         # p99-derived delay; first non-error result wins, the loser is
@@ -511,6 +545,9 @@ class RouterEngine:
                               "penalized": self._slo_penalized,
                               "states": {h.netloc: self._slo_penalty(h)
                                          for h in self.hosts}},
+                "tenant_route": {"enabled": self.tenant_route,
+                                 "routed": self._tenant_routed,
+                                 "tenants": len(self._tenant_hosts)},
                 "per_host": per}
 
     def prometheus_metrics(self) -> str:
@@ -646,6 +683,10 @@ class RouterEngine:
                      "dispatch orders whose first choice was demoted by a "
                      "published SLO state (LMRS_SLO_ROUTE)"
                      ).inc(self._slo_penalized)
+        hreg.counter("lmrs_router_tenant_routed_total",
+                     "requests placed sticky on the tenant's last-served "
+                     "host (LMRS_TENANT_ROUTE chargeback affinity)"
+                     ).inc(self._tenant_routed)
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
 
@@ -682,6 +723,13 @@ class RouterEngine:
         per_host: list[dict] = []
         unreachable: list[str] = []
         enabled = False
+        # fleet fair-share rollup: windowed device-seconds SUM across
+        # hosts per tenant; weights are config (identical fleet-wide by
+        # contract, max tolerates skew during a rolling knob change)
+        qos_burn: dict[str, float] = {}
+        qos_weight: dict[str, float] = {}
+        qos_window = 0.0
+        qos_on = False
         for h, fut in futures:
             try:
                 doc = fut.result(timeout=10.0)
@@ -695,16 +743,122 @@ class RouterEngine:
                              "totals": doc.get("totals") or {}})
             for t, roll in (doc.get("tenants") or {}).items():
                 merge_usage(tenants.setdefault(t, {}), roll)
+            q = doc.get("qos")
+            if isinstance(q, dict) and q.get("enabled"):
+                qos_on = True
+                qos_window = max(qos_window, float(q.get("window_s") or 0.0))
+                for t, row in (q.get("tenants") or {}).items():
+                    qos_burn[t] = (qos_burn.get(t, 0.0)
+                                   + float(row.get("window_device_seconds")
+                                           or 0.0))
+                    qos_weight[t] = max(qos_weight.get(t, 0.0),
+                                        float(row.get("weight") or 1.0))
         totals = totals_from_tenants(tenants)
         with self._stats_lock:
             router = {"hedges": self._hedges,
                       "hedge_wins": self._hedge_wins,
                       "handoff_retries": self._handoff_retries,
-                      "slo_penalized": self._slo_penalized}
-        return {"object": "usage", "enabled": enabled, "fleet": True,
-                "tenants": tenants, "totals": totals,
-                "per_host": per_host, "unreachable": unreachable,
-                "router": router}
+                      "slo_penalized": self._slo_penalized,
+                      "tenant_routed": self._tenant_routed}
+        doc = {"object": "usage", "enabled": enabled, "fleet": True,
+               "tenants": tenants, "totals": totals,
+               "per_host": per_host, "unreachable": unreachable,
+               "router": router}
+        if qos_on:
+            # recompute shares over the FLEET sums — per-host shares do
+            # not average into a fleet share; the block is omitted
+            # entirely when every host is disarmed (LMRS_QOS=0 wire
+            # parity, same rule as the backend's /v1/usage)
+            total = sum(qos_burn.values())
+            wsum = sum(qos_weight.get(t, 1.0) for t in qos_burn) or 1.0
+            qt = {}
+            for t, s in sorted(qos_burn.items()):
+                w = qos_weight.get(t, 1.0)
+                fair = total * w / wsum
+                qt[t] = {"weight": w,
+                         "window_device_seconds": round(s, 6),
+                         "share": round(s / total, 4) if total > 0 else 0.0,
+                         "fair_share": round(w / wsum, 4),
+                         "over_quota": bool(len(qos_burn) > 1 and s > fair)}
+            doc["qos"] = {"object": "qos", "enabled": True, "fleet": True,
+                          "window_s": qos_window,
+                          "window_device_seconds": round(total, 6),
+                          "tenants": qt}
+        return doc
+
+    # ---------------------------------------------------- fleet elasticity
+
+    def add_host(self, url: str, role: str = "both") -> "_Host":
+        """Admit a new backend into the fleet (autoscaler scale-up, or
+        an operator joining capacity to a live router).  Idempotent by
+        netloc: re-adding an existing host just clears its drain flag
+        and returns it.  The new host enters healthy — the first failed
+        request demotes it through the normal breaker machinery, so a
+        pod that never came up costs one failover leg, not an outage."""
+        h = _Host(url, role, clock=self._clock)
+        for existing in self.hosts:
+            if existing.netloc == h.netloc:
+                existing.draining = False
+                return existing
+        # append order: list mutation is GIL-atomic and dispatch only
+        # ever iterates, so a concurrent wave sees the fleet before or
+        # after the join — never a torn list
+        self.hosts.append(h)
+        self.pools.setdefault(h.role, []).append(h)
+        logger.info("fleet: host %s joined (role %s, %d hosts)",
+                    h.netloc, h.role, len(self.hosts))
+        return h
+
+    def drain_host(self, netloc: str) -> bool:
+        """Begin a graceful exit: the host leaves the dispatch order
+        (``healthy`` goes False) but keeps its in-flight requests; the
+        recovery probes skip it so nothing re-admits it.  Returns False
+        for an unknown netloc."""
+        for h in self.hosts:
+            if h.netloc == netloc:
+                h.draining = True
+                logger.info("fleet: host %s draining (%d legs in flight)",
+                            netloc, h.inflight)
+                return True
+        return False
+
+    def host_idle(self, netloc: str) -> bool:
+        """True when the host has no request legs in flight (the
+        drain-complete signal the autoscaler polls)."""
+        for h in self.hosts:
+            if h.netloc == netloc:
+                return h.inflight == 0
+        return True
+
+    def remove_host(self, netloc: str, force: bool = False) -> bool:
+        """Complete a drain: drop the host from the fleet and every
+        routing structure.  Refuses (returns False) while request legs
+        are still in flight unless ``force`` — the last host in the
+        fleet can never be removed (the router's own invariant)."""
+        for h in list(self.hosts):
+            if h.netloc != netloc:
+                continue
+            if h.inflight and not force:
+                return False
+            if len(self.hosts) <= 1:
+                logger.warning("fleet: refusing to remove last host %s",
+                               netloc)
+                return False
+            self.hosts.remove(h)
+            for pool in self.pools.values():
+                if h in pool:
+                    pool.remove(h)
+            with self._summary_lock:
+                self._summaries.pop(netloc, None)
+                self._summary_inflight.discard(netloc)
+            with self._stats_lock:
+                for t, n in list(self._tenant_hosts.items()):
+                    if n == netloc:
+                        del self._tenant_hosts[t]
+            logger.info("fleet: host %s removed (%d hosts remain)",
+                        netloc, len(self.hosts))
+            return True
+        return False
 
     # ------------------------------------------------------ trace stitching
 
@@ -1124,6 +1278,9 @@ class RouterEngine:
             for host in self.hosts:
                 if host.healthy or now < host.next_probe_t:
                     continue
+                if host.draining:
+                    # draining is deliberate: recovery must not re-admit
+                    continue
                 if (not host._down and host.breaker_state == "open"
                         and not host.breaker_due()):
                     continue  # cooldown running: no canary yet
@@ -1191,6 +1348,39 @@ class RouterEngine:
         if prefer is not None and prefer in out:
             out = [prefer] + [h for h in out if h is not prefer]
         return out
+
+    def _tenant_pref(self, req: GenerationRequest,
+                     role: str) -> "_Host | None":
+        """Chargeback-aware stickiness: the host that last served this
+        tenant, while it is healthy and its published SLO state has not
+        degraded.  No opinion (None) otherwise — the request falls back
+        to plain load/health ordering."""
+        if not self.tenant_route or not req.tenant:
+            return None
+        with self._stats_lock:
+            netloc = self._tenant_hosts.get(req.tenant)
+        if netloc is None:
+            return None
+        for h in self._role_pool(role):
+            if h.netloc == netloc:
+                if h.healthy and self._slo_penalty(h) == 0:
+                    with self._stats_lock:
+                        self._tenant_routed += 1
+                    return h
+                return None
+        return None
+
+    def _note_tenant_host(self, req: GenerationRequest,
+                          host: _Host) -> None:
+        """Record a successful placement as the tenant's warm host
+        (bounded LRU: re-insert moves to the back, oldest evicts)."""
+        if not self.tenant_route or not req.tenant:
+            return
+        with self._stats_lock:
+            self._tenant_hosts.pop(req.tenant, None)
+            self._tenant_hosts[req.tenant] = host.netloc
+            while len(self._tenant_hosts) > self._tenant_hosts_max:
+                self._tenant_hosts.pop(next(iter(self._tenant_hosts)))
 
     def _slo_penalty(self, host: _Host) -> int:
         """Graded placement penalty from the host's last published SLO
@@ -1393,6 +1583,11 @@ class RouterEngine:
         # X-LMRS-Trace header, so one request is ONE trace fleet-wide
         if req.trace_id is None:
             req.trace_id = new_trace_id()
+        if prefer is None:
+            # prefix placement had no opinion: fall back to tenant
+            # affinity (chargeback-aware routing, weakest preference)
+            prefer = self._tenant_pref(
+                req, "prefill" if self._disagg_ready() else "full")
         if self._disagg_ready():
             res = self._one_disagg(i, req, on_tokens, cancelled, prefer)
             if res is not None:
@@ -1440,6 +1635,7 @@ class RouterEngine:
                     self._note_latency(time.time() - t_leg)
                 host.note_served()
                 host.healthy = True
+                self._note_tenant_host(req, host)
                 return res
             except Exception as e:  # noqa: BLE001 - degrade per request
                 if rid in cancelled:
@@ -1615,6 +1811,7 @@ class RouterEngine:
                 if res.finish_reason != "error":
                     host.note_served()
                     host.healthy = True
+                    self._note_tenant_host(req, host)
                     if is_hedge:
                         self._count("_hedge_wins")
                     winner = res
@@ -1778,6 +1975,7 @@ class RouterEngine:
             # dispatch thread past the request's own deadline budget
             timeout = max(1.0, min(timeout, rem + 5.0))
         conn = host.connect(timeout)
+        host.note_leg(+1)
         with self._inflight_lock:
             self._inflight[rid] = conn
         try:
@@ -1817,6 +2015,7 @@ class RouterEngine:
                 usage=usage.get("cost") or None,
             )
         finally:
+            host.note_leg(-1)
             with self._inflight_lock:
                 self._inflight.pop(rid, None)
             try:
@@ -1843,6 +2042,7 @@ class RouterEngine:
             # thread for the full worst-case-generation timeout
             timeout = max(1.0, min(timeout, rem + 5.0))
         conn = host.connect(timeout)
+        host.note_leg(+1)
         rid = req.request_id
         # hedged legs register under their own key so two concurrent legs
         # of ONE rid never clobber each other's hangup target; the plain
@@ -1900,6 +2100,7 @@ class RouterEngine:
                 usage=usage.get("cost") or None,
             )
         finally:
+            host.note_leg(-1)
             with self._inflight_lock:
                 self._inflight.pop(key, None)
             try:

@@ -41,6 +41,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from lmrs_tpu.engine.api import Engine, GenerationRequest, GenerationResult
 from lmrs_tpu.obs import get_tracer, new_trace_id
+from lmrs_tpu.obs.ledger import DEFAULT_TENANT
 from lmrs_tpu.serving.handoff import (ImportLog, TicketRegistry,
                                       decode_payload, encode_payload)
 from lmrs_tpu.testing import faults
@@ -60,12 +61,25 @@ def clean_trace_id(raw) -> str | None:
     return raw if _TRACE_ID_RE.match(raw) else None
 
 
-def clean_tenant(raw) -> str | None:
+def clean_tenant(raw, default: str | None = None) -> str | None:
     """A wire-supplied ``X-LMRS-Tenant`` label, validated against the
     same safe alphabet as trace ids (it rides journals, usage rollup
-    keys, and Prometheus-adjacent docs); None when absent/garbage — the
-    ledger then bills the "default" tenant."""
-    return clean_trace_id(raw)
+    keys, and Prometheus-adjacent docs); ``default`` when absent or
+    garbage.  Completion ingress passes ``DEFAULT_TENANT`` so anonymous
+    traffic is MINTED an explicit tenant (QoS weights and quota reports
+    can then name unlabeled traffic, docs/SERVING.md); label-adoption
+    sites (handoff payloads, job/session submits that default to their
+    own identity) keep ``default=None`` so absence stays observable."""
+    return clean_trace_id(raw) or default
+
+
+def clean_qos_class(raw) -> str | None:
+    """A wire-supplied ``X-LMRS-QoS-Class`` label (or ``qos_class`` body
+    field): "interactive" | "batch", else None (fleet/qos.py resolves
+    None to "interactive")."""
+    from lmrs_tpu.fleet.qos import clean_qos_class as _clean
+
+    return _clean(raw)
 
 
 class _Job:
@@ -247,6 +261,25 @@ class _Batcher:
                 jobs.append(nxt)
             self._run(jobs)
 
+    def _qos_order(self, jobs: list[_Job]) -> list[_Job]:
+        """Fair-share wave order (fleet/qos.py): when the engine carries
+        an armed QoS policy (the mock's admission gate; the jax
+        scheduler reorders in its own admit loop instead), the wave
+        dispatches in repeated-policy-pick order — interactive before
+        batch, under-served tenants before flooding ones.  Identity when
+        the engine has no policy or ``LMRS_QOS=0`` (the engine attribute
+        is then None), so the kill-switch wave order is byte-for-byte
+        FIFO."""
+        pol = getattr(self.engine, "qos", None)
+        if pol is None or len(jobs) < 2:
+            return jobs
+        remaining = list(jobs)
+        out: list[_Job] = []
+        while remaining:
+            out.append(remaining.pop(
+                pol.pick_index([j.request for j in remaining])))
+        return out
+
     def _drain_on_shutdown(self) -> None:
         """Jobs enqueued behind the shutdown sentinel (multiple shutdown()
         calls can race a submit past an earlier sentinel) would otherwise
@@ -280,7 +313,7 @@ class _Batcher:
         # undispatched rid, cleared at the engine run's end.
         self._inflight = {j.rid: j for j in jobs}
         skipped = [j for j in jobs if j.cancelled]
-        jobs = [j for j in jobs if not j.cancelled]
+        jobs = self._qos_order([j for j in jobs if not j.cancelled])
         for job in skipped:
             job.result = GenerationResult(request_id=job.rid,
                                           finish_reason="cancelled")
@@ -778,10 +811,27 @@ class EngineHTTPServer:
                 """Anchor the request's cost-attribution tenant from the
                 ``X-LMRS-Tenant`` header (or the ``tenant`` body field —
                 header wins), minted at THIS ingress and propagated like
-                the trace id.  Absent/garbage leaves None: the ledger
-                bills the "default" tenant."""
-                req.tenant = (clean_tenant(self.headers.get("X-LMRS-Tenant"))
-                              or clean_tenant(body.get("tenant")))
+                the trace id.  Absent/garbage mints the explicit
+                "default" tenant — anonymous ingress shares ONE named
+                bucket QoS weights can be configured for, instead of an
+                implicit None.  The QoS priority class
+                (``X-LMRS-QoS-Class`` / ``qos_class`` body field) rides
+                the same ingress, parsed only while LMRS_QOS is armed so
+                the kill switch keeps the wire byte-identical."""
+                supplied = (clean_tenant(self.headers.get("X-LMRS-Tenant"))
+                            or clean_tenant(body.get("tenant")))
+                # minted-here flag (the _trace_minted analog): a locally
+                # minted "default" yields to the tenant a handoff payload
+                # carried across the pod boundary (_apply_handoff)
+                self._tenant_minted = supplied is None
+                req.tenant = supplied or DEFAULT_TENANT
+                from lmrs_tpu.fleet.qos import qos_enabled
+
+                if qos_enabled():
+                    req.qos_class = (
+                        clean_qos_class(
+                            self.headers.get("X-LMRS-QoS-Class"))
+                        or clean_qos_class(body.get("qos_class")))
 
             def _apply_deadline(self, req: GenerationRequest,
                                 body: dict) -> bool:
@@ -827,7 +877,24 @@ class EngineHTTPServer:
                                    "ledger", "type": "usage_error"}})
                     return
                 try:
-                    self._send(200, hook())
+                    doc = hook()
+                    # per-tenant quota/burn chargeback block (fleet/
+                    # qos.py): windowed device-seconds against configured
+                    # weight.  Guarded getattr like the /healthz slo
+                    # block — engines without the policy (or routers
+                    # whose report already aggregated one) just omit it.
+                    qos = getattr(outer.engine, "qos_report", None)
+                    if qos is not None and "qos" not in doc:
+                        try:
+                            q = qos()
+                            # omitted (not enabled:false) when disarmed:
+                            # LMRS_QOS=0 keeps the wire byte-identical
+                            if q.get("enabled"):
+                                doc["qos"] = q
+                        except Exception:  # noqa: BLE001 - stay healthy
+                            logger.debug("qos report failed",
+                                         exc_info=True)
+                    self._send(200, doc)
                 except Exception as e:  # noqa: BLE001 - marked error
                     logger.exception("usage report failed")
                     self._send(502, {"error": {
@@ -985,9 +1052,19 @@ class EngineHTTPServer:
                         and clean_trace_id(payload.get("trace_id"))):
                     req.trace_id = payload["trace_id"]
                 # same adoption rule for the tenant label: the decode leg
-                # bills to the tenant the prefill leg was billed to
-                if req.tenant is None and clean_tenant(payload.get("tenant")):
+                # bills to the tenant the prefill leg was billed to (a
+                # locally-MINTED "default" counts as absent — only a
+                # client-supplied label outranks the payload's)
+                if ((req.tenant is None
+                     or getattr(self, "_tenant_minted", False))
+                        and clean_tenant(payload.get("tenant"))):
                     req.tenant = payload["tenant"]
+                # the class label rides the payload the same way — the
+                # decode leg competes in the class the prefill leg was
+                # admitted under
+                if req.qos_class is None:
+                    req.qos_class = clean_qos_class(
+                        payload.get("qos_class"))
                 return True
 
             def do_DELETE(self):

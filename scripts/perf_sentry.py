@@ -1,7 +1,9 @@
 """perf_sentry — noise-aware perf-regression checker over the bench history.
 
 Every hardware round appends a ``BENCH_r*.json`` / ``BENCH8B_r*.json`` /
-``MULTICHIP_r*.json`` artifact to the repo root, but nothing READ them:
+``MULTICHIP_r*.json`` artifact to the repo root (and fairness A/B rounds
+append ``FAIRNESS_r*.json``, scripts/ab_fairness.py), but nothing READ
+them:
 a regression slipped into a round would sit unnoticed until a human
 diffed the trajectory.  The sentry makes the history a gate:
 
@@ -49,6 +51,10 @@ TRACKED = {
     "decode_row_us_rpa": "down",
     "ttft_ms.p50": "down",
     "decode_block_gap_ms.p50": "down",
+    # fairness A/B rounds (FAIRNESS_r*.json, scripts/ab_fairness.py):
+    # the quiet tenant's protected TTFT and the QoS-on/off separation
+    "quiet_ttft_p95_ms_qos_on": "down",
+    "fairness_gain": "up",
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
@@ -190,7 +196,7 @@ def main(argv: list[str] | None = None) -> int:
     root = Path(args.dir)
     regressions: list[dict] = []
     families: dict[str, dict] = {}
-    for prefix in ("BENCH", "BENCH8B"):
+    for prefix in ("BENCH", "BENCH8B", "FAIRNESS"):
         rounds = load_bench_rounds(root, prefix)
         if not rounds:
             continue

@@ -1,0 +1,669 @@
+"""Multi-tenant QoS enforcement + fleet elasticity (ISSUE 17).
+
+The tier-1 ``qos`` gate: the fair-share policy rules must be
+deterministic given the same usage window, greedy outputs must be
+byte-identical with ``LMRS_QOS`` on vs off (QoS reorders admission,
+never generation), the mock admission gate must order waiters by class
+then deficit when armed and strictly FIFO when disarmed, ledger
+conservation must survive concurrent TenantStampEngine traffic through
+a slot-limited gate, anonymous ingress must bill to the minted
+``default`` tenant, the overflow counter must fire past the tenant
+cardinality cap, the router's elasticity surface (add/drain/idle/
+remove) must hold its invariants, and the autoscaler control loop must
+scale up on burn, drain before removal, and never touch
+operator-configured capacity.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import logging
+import threading
+import time
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
+                                 TenantStampEngine)
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.fleet.autoscale import Autoscaler, maybe_autoscaler
+from lmrs_tpu.fleet.qos import (QoSPolicy, class_rank, clean_qos_class,
+                                maybe_qos, parse_weights, request_class)
+from lmrs_tpu.obs.ledger import CostLedger
+from lmrs_tpu.obs.metrics import MetricsRegistry
+
+
+def tiny_model():
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+def _cfg(**kw) -> EngineConfig:
+    base = dict(backend="jax", scheduler="continuous", max_tokens=16,
+                max_batch_slots=2, seed=0, decode_block=3,
+                prefill_chunk=64, retry_delay=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _req(rid: int, tenant=None, klass=None, prompt="x") -> GenerationRequest:
+    return GenerationRequest(prompt=prompt, request_id=rid,
+                             temperature=0.0, max_new_tokens=8,
+                             tenant=tenant, qos_class=klass)
+
+
+# ------------------------------------------------------------ policy units
+
+
+def test_parse_weights_drops_malformed_entries():
+    out = parse_weights(["gold:4", "silver:0.5", "junk", "bad:-1",
+                         "nan:x", ":2", "zero:0"])
+    assert out == {"gold": 4.0, "silver": 0.5}
+
+
+def test_clean_qos_class_and_ranks():
+    assert clean_qos_class(" Batch ") == "batch"
+    assert clean_qos_class("INTERACTIVE") == "interactive"
+    assert clean_qos_class("weird") is None
+    assert clean_qos_class(7) is None
+    assert class_rank(None) == 0 and class_rank("batch") == 1
+    # unlabeled / dict-shaped requests degrade to interactive, never crash
+    assert request_class(object()) == "interactive"
+    assert request_class(_req(0, klass="batch")) == "batch"
+
+
+def test_window_usage_expires_off_the_left_edge(monkeypatch):
+    monkeypatch.setenv("LMRS_QOS_WINDOW_S", "10")
+    clk = _Clock()
+    pol = QoSPolicy(enabled=True, clock=clk)
+    pol.note_usage([("a", 5.0)])
+    clk.t += 5
+    pol.note_usage([("b", 1.0), ("a", 0.0)])  # zero-cost events dropped
+    assert pol.normalized_usage("a") == 5.0
+    clk.t += 6  # a's event is now 11s old, past the 10s window
+    assert pol.normalized_usage("a") == 0.0
+    assert set(pol.report()["tenants"]) == {"b"}
+
+
+def test_pick_index_class_then_deficit_then_fifo(monkeypatch):
+    monkeypatch.setenv("LMRS_QOS_WEIGHTS", "heavy:10")
+    clk = _Clock()
+    reg = MetricsRegistry()
+    pol = QoSPolicy(reg, enabled=True, clock=clk)
+    pol.note_usage([("noisy", 10.0), ("quiet", 1.0), ("heavy", 20.0)])
+    # class outranks any deficit: the only interactive entry wins even
+    # though its tenant burned more than the batch ones
+    reqs = [_req(0, "quiet", "batch"), _req(1, "noisy", "batch"),
+            _req(2, "noisy", "interactive")]
+    assert pol.pick_index(reqs) == 2
+    # within one class the lowest normalized usage wins (20/10 < 10/1)
+    reqs = [_req(0, "noisy", "batch"), _req(1, "heavy", "batch")]
+    assert pol.pick_index(reqs) == 1
+    # full tie (same tenant, same class) degrades to FIFO
+    reqs = [_req(0, "noisy", "batch"), _req(1, "noisy", "batch")]
+    assert pol.pick_index(reqs) == 0
+    # every non-head pick above incremented the reorder counter
+    assert reg.counter("lmrs_qos_reorders_total").value == 2.0
+    assert reg.gauge("lmrs_qos_window_device_seconds").value == 31.0
+
+
+def test_victim_key_targets_over_quota_bulk_first():
+    clk = _Clock()
+    pol = QoSPolicy(enabled=True, clock=clk)
+    pol.note_usage([("noisy", 10.0), ("quiet", 1.0)])
+    rows = [(_req(0, "quiet", "interactive"), 1.0),
+            (_req(1, "quiet", "batch"), 2.0),
+            (_req(2, "noisy", "batch"), 3.0),
+            (_req(3, "noisy", "batch"), 4.0)]
+    ranked = sorted(rows, key=lambda r: pol.victim_key(r[0], r[1]))
+    # victim = max key: the YOUNGEST over-quota batch row; the
+    # interactive row is the safest slot in the pool
+    assert ranked[-1][0].request_id == 3
+    assert ranked[0][0].request_id == 0
+
+
+def test_over_quota_is_self_normalizing():
+    clk = _Clock()
+    pol = QoSPolicy(enabled=True, clock=clk)
+    pol.weights = {"gold": 3.0}
+    # a lone tenant is never over quota (its fair share is 100%)
+    pol.note_usage([("solo", 100.0)])
+    assert not pol.over_quota("solo")
+    pol = QoSPolicy(enabled=True, clock=clk)
+    pol.weights = {"gold": 3.0}
+    pol.note_usage([("gold", 70.0), ("base", 30.0)])
+    # gold's fair share of the 100s window is 75 (weight 3 of 4): under;
+    # base's is 25: over
+    assert not pol.over_quota("gold")
+    assert pol.over_quota("base")
+    rep = pol.report()
+    assert rep["tenants"]["base"]["over_quota"] is True
+    assert rep["tenants"]["gold"]["over_quota"] is False
+    assert rep["tenants"]["gold"]["fair_share"] == 0.75
+
+
+def test_maybe_qos_kill_switch(monkeypatch):
+    monkeypatch.setenv("LMRS_QOS", "0")
+    assert maybe_qos() is None
+    monkeypatch.setenv("LMRS_QOS", "1")
+    pol = maybe_qos()
+    assert pol is not None and pol.enabled
+    rep = pol.report()
+    assert rep["object"] == "qos" and rep["enabled"] is True
+    assert set(rep) == {"object", "enabled", "window_s",
+                        "window_device_seconds", "classes", "tenants"}
+
+
+def test_preempt_counter(monkeypatch):
+    reg = MetricsRegistry()
+    pol = QoSPolicy(reg, enabled=True, clock=_Clock())
+    pol.note_preempt()
+    pol.note_preempt()
+    assert reg.counter("lmrs_qos_preempt_victims_total").value == 2.0
+
+
+# --------------------------------------------------- ledger observer hooks
+
+
+def test_ledger_observer_receives_conserved_pairs():
+    led = CostLedger(enabled=True)
+    captured: list[list] = []
+    led.observer = lambda pairs: captured.append(list(pairs))
+    ra, rb = _req(0, "a"), _req(1, "b")
+    led.note_step(0.2, decode_rows=[(ra, 3, 1), (rb, 5, 1)])
+    assert led.audit() == []
+    total = sum(s for batch in captured for _, s in batch)
+    assert abs(total - 0.2) < 1e-9
+    assert {t for batch in captured for t, _ in batch} == {"a", "b"}
+
+
+def test_overflow_counter_and_warn_once(monkeypatch, caplog):
+    """Regression for the lmrs_cost_tenants_overflow_total counter: past
+    LMRS_COST_TENANTS_MAX each folded FINISH increments it, and the
+    cardinality warning fires exactly once."""
+    monkeypatch.setenv("LMRS_COST_TENANTS_MAX", "1")
+    reg = MetricsRegistry()
+    led = CostLedger(reg, enabled=True)
+    with caplog.at_level(logging.WARNING):
+        for i, tenant in enumerate(("a", "b", "c")):
+            r = _req(i, tenant)
+            led.note_step(0.25, decode_rows=[(r, 2, 1)])
+            led.finish(r, GenerationResult(request_id=i,
+                                           completion_tokens=2,
+                                           prompt_tokens=1))
+    assert reg.counter("lmrs_cost_tenants_overflow_total").value == 2.0
+    assert caplog.text.count("cardinality cap") == 1
+    assert led.audit() == []
+    doc = led.usage_report()
+    assert set(doc["tenants"]) == {"a", "other"}
+    assert doc["tenants"]["other"]["requests"] == 2
+
+
+# ------------------------------------------------- mock admission ordering
+
+
+def _gate_order(qos_on: bool) -> tuple[list[str], dict]:
+    """Fill a slots=1 MockEngine's admission queue in a deterministic
+    arrival order while the only slot is held, then release and record
+    completion order (slot serialization makes it the admission order)."""
+    eng = MockEngine(seed=0, latency_s=0.03, slots=1, qos=qos_on)
+    blocker = _req(99, "noisy", "batch", prompt="blocker")
+    eng._admit_wait(blocker)  # occupy the only slot
+    if qos_on:
+        assert eng.qos is not None
+        eng.qos.note_usage([("noisy", 5.0)])
+    else:
+        assert eng.qos is None
+    done: list[str] = []
+    done_lock = threading.Lock()
+
+    def run(tag: str, tenant: str, klass: str, rid: int) -> None:
+        res = eng.generate_batch([_req(rid, tenant, klass,
+                                       prompt=f"req {tag}")])[0]
+        assert res.error is None, res.error
+        with done_lock:
+            done.append(tag)
+
+    waiters = [("b0", "noisy", "batch"), ("b1", "noisy", "batch"),
+               ("quiet", "quiet", "interactive"), ("b2", "noisy", "batch")]
+    threads = []
+    for i, (tag, tenant, klass) in enumerate(waiters):
+        t = threading.Thread(target=run, args=(tag, tenant, klass, i),
+                             daemon=True)
+        t.start()
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            with eng._adm_cv:
+                if len(eng._adm_queue) == i + 1:
+                    break
+            time.sleep(0.005)
+        threads.append(t)
+    eng._admit_release()  # free the slot: admission begins
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not any(t.is_alive() for t in threads)
+    u = eng.ledger.usage_report()
+    assert u["live_requests"] == 0
+    return done, eng.qos_report()
+
+
+def test_mock_gate_qos_admits_interactive_first():
+    done, rep = _gate_order(qos_on=True)
+    # the interactive waiter jumps the flooded queue; the batch waiters
+    # keep their FIFO order among themselves
+    assert done == ["quiet", "b0", "b1", "b2"]
+    assert rep["enabled"] is True
+
+
+def test_mock_gate_disarmed_is_strict_fifo():
+    done, rep = _gate_order(qos_on=False)
+    assert done == ["b0", "b1", "quiet", "b2"]
+    assert rep == {"object": "qos", "enabled": False}
+
+
+def test_tenant_stamp_rollups_conserve_under_concurrent_gate():
+    """Concurrent TenantStampEngine facades (the job/session billing
+    path) through one slot-limited gate: every facade's rollup counts
+    its own requests exactly and the shared ledger conserves."""
+    eng = MockEngine(seed=0, latency_s=0.005, slots=1)
+    assert eng.qos is not None
+    facades = {
+        "job-a": TenantStampEngine(eng, "job-a", qos_class="batch"),
+        "job-b": TenantStampEngine(eng, "job-b", qos_class="batch"),
+        "live": TenantStampEngine(eng, "live", qos_class="interactive"),
+    }
+    n = 6
+    errors: list[str] = []
+
+    def run(k: int, name: str, fac: TenantStampEngine) -> None:
+        try:
+            for i in range(n):
+                res = fac.generate_batch([GenerationRequest(
+                    prompt=f"{name} chunk {i} with enough words to bill",
+                    request_id=k * 1000 + i, temperature=0.0,
+                    max_new_tokens=8)])[0]
+                assert res.error is None, res.error
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=run, args=(k, name, fac),
+                                daemon=True)
+               for k, (name, fac) in enumerate(facades.items())]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    assert not errors and not any(t.is_alive() for t in threads)
+    for name, fac in facades.items():
+        assert fac.usage_rollup.get("requests") == n, name
+    doc = eng.ledger.usage_report()
+    assert eng.ledger.audit() == []
+    assert doc["live_requests"] == 0
+    assert {t: r["requests"] for t, r in doc["tenants"].items()} == {
+        "job-a": n, "job-b": n, "live": n}
+    tenant_dev = sum(r["device_seconds"] for r in doc["tenants"].values())
+    assert abs(tenant_dev - doc["totals"]["device_seconds"]) < 1e-9
+
+
+# ------------------------------------------- scheduler kill-switch parity
+
+
+def test_scheduler_qos_kill_switch_token_identity(monkeypatch):
+    """LMRS_QOS=0 vs 1 on the continuous scheduler: greedy outputs are
+    byte-identical (the policy reorders admission and preemption order,
+    never any request's tokens) and conservation holds in both arms."""
+    from lmrs_tpu.engine.jax_engine import JaxEngine
+
+    def reqs():
+        pre = "shared qos preamble alpha beta "
+        return [GenerationRequest(
+            prompt=(pre if i % 2 else "") + f"request {i} "
+            + "lorem ipsum dolor sit amet " * (1 + 3 * (i % 2)),
+            request_id=i, temperature=0.0, max_new_tokens=10 + i,
+            tenant=("bulk" if i % 2 else "live"),
+            qos_class=("batch" if i % 2 else "interactive"))
+            for i in range(4)]
+
+    def run():
+        eng = JaxEngine(_cfg(), tiny_model())
+        out = eng.generate_batch(reqs())
+        assert eng._scheduler.audit() == []
+        texts = [(r.text, r.finish_reason, r.completion_tokens)
+                 for r in out]
+        rep = eng.qos_report()
+        eng.shutdown()
+        return texts, rep
+
+    monkeypatch.setenv("LMRS_QOS", "0")
+    texts_off, rep_off = run()
+    assert rep_off == {"object": "qos", "enabled": False}
+    monkeypatch.setenv("LMRS_QOS", "1")
+    texts_on, rep_on = run()
+    assert rep_on["enabled"] is True
+    assert texts_on == texts_off
+
+
+# --------------------------------------------------- server-tier surfaces
+
+
+def _post(port, body, headers=None):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    c.request("POST", "/v1/chat/completions", json.dumps(body),
+              {"Content-Type": "application/json", **(headers or {})})
+    r = c.getresponse()
+    out = json.loads(r.read())
+    c.close()
+    return r.status, out
+
+
+def _get(port, path):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    c.request("GET", path)
+    r = c.getresponse()
+    out = json.loads(r.read())
+    c.close()
+    return r.status, out
+
+
+def _chat_body(text="summarize this deterministic transcript please"):
+    return {"messages": [{"role": "user", "content": text}],
+            "max_tokens": 16}
+
+
+def test_server_mints_default_tenant_for_anonymous_ingress():
+    """Ingress without X-LMRS-Tenant bills to the minted ``default``
+    tenant (SERVING.md): anonymous traffic is visible in fair-share and
+    chargeback instead of invisible."""
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(MockEngine(seed=0), port=0)
+    srv.start_background()
+    try:
+        st, out = _post(srv.port, _chat_body())
+        assert st == 200
+        assert out["usage"]["cost"]["tenant"] == "default"
+        st, out = _post(srv.port, _chat_body(),
+                        headers={"X-LMRS-Tenant": "acme"})
+        assert st == 200 and out["usage"]["cost"]["tenant"] == "acme"
+        st, u = _get(srv.port, "/v1/usage")
+        assert st == 200 and set(u["tenants"]) == {"default", "acme"}
+    finally:
+        srv.shutdown()
+
+
+def test_usage_qos_block_wire_parity(monkeypatch):
+    """GET /v1/usage carries the qos block only while armed — with
+    LMRS_QOS=0 the key is ABSENT (byte parity), not enabled:false."""
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    def run():
+        srv = EngineHTTPServer(MockEngine(seed=0, latency_s=0.01), port=0)
+        srv.start_background()
+        try:
+            st, _ = _post(srv.port, _chat_body(),
+                          headers={"X-LMRS-Tenant": "acme"})
+            assert st == 200
+            return _get(srv.port, "/v1/usage")[1]
+        finally:
+            srv.shutdown()
+
+    monkeypatch.setenv("LMRS_QOS", "1")
+    on = run()
+    monkeypatch.setenv("LMRS_QOS", "0")
+    off = run()
+    assert on["qos"]["enabled"] is True and "tenants" in on["qos"]
+    assert "qos" not in off
+
+
+def test_batcher_wave_order_follows_policy(monkeypatch):
+    """The micro-batcher's wave order: identity (FIFO) when the engine
+    carries no policy, repeated fair-share picks when armed."""
+    from lmrs_tpu.serving.server import _Batcher
+
+    class _J:
+        def __init__(self, req):
+            self.request = req
+
+    def jobs():
+        return [_J(_req(0, "noisy", "batch")),
+                _J(_req(1, "noisy", "batch")),
+                _J(_req(2, "quiet", "interactive"))]
+
+    monkeypatch.setenv("LMRS_QOS", "0")
+    b = _Batcher(MockEngine(seed=0))
+    try:
+        js = jobs()
+        assert b._qos_order(js) is js  # disarmed: the very same list
+    finally:
+        b.shutdown()
+    monkeypatch.setenv("LMRS_QOS", "1")
+    b = _Batcher(MockEngine(seed=0))
+    try:
+        b.engine.qos.note_usage([("noisy", 10.0)])
+        js = jobs()
+        out = b._qos_order(js)
+        assert [j.request.request_id for j in out] == [2, 0, 1]
+    finally:
+        b.shutdown()
+
+
+# ------------------------------------------------- router fleet elasticity
+
+
+def test_router_fleet_elasticity_api():
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1", "h2:2"], timeout_s=1.0)
+    try:
+        h3 = router.add_host("h3:3")
+        assert len(router.hosts) == 3
+        assert router.add_host("h3:3") is h3  # idempotent by netloc
+        assert len(router.hosts) == 3
+        assert router.drain_host("h3:3") is True
+        assert not h3.healthy  # draining leaves the dispatch order
+        assert router.drain_host("nope:9") is False
+        assert router.add_host("h3:3") is h3  # re-add clears the drain
+        assert h3.healthy
+        router.drain_host("h3:3")
+        h3.note_leg(+1)
+        assert router.host_idle("h3:3") is False
+        assert router.remove_host("h3:3") is False  # legs still in flight
+        assert router.remove_host("h3:3", force=True) is True
+        assert len(router.hosts) == 2
+        # removal purges the tenant-affinity map
+        req = _req(0, "acme")
+        router._note_tenant_host(req, router.hosts[1])
+        assert router.remove_host("h2:2") is True
+        with router._stats_lock:
+            assert "acme" not in router._tenant_hosts
+        # the last host can never be removed
+        assert router.remove_host("h1:1", force=True) is False
+        assert router.remove_host("ghost:0") is False
+    finally:
+        router.shutdown()
+
+
+def test_router_tenant_affinity_lru_and_slo_gating():
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1", "h2:2"], timeout_s=1.0)
+    try:
+        req = _req(0, "acme")
+        assert router._tenant_pref(req, "full") is None  # no history yet
+        router._note_tenant_host(req, router.hosts[0])
+        assert router._tenant_pref(req, "full") is router.hosts[0]
+        assert router._tenant_routed == 1
+        # a draining warm host yields no opinion (falls back to load
+        # ordering) rather than routing into the drain
+        router.drain_host("h1:1")
+        assert router._tenant_pref(req, "full") is None
+        router.add_host("h1:1")
+        assert router._tenant_pref(req, "full") is router.hosts[0]
+        # anonymous requests never stick
+        assert router._tenant_pref(_req(1), "full") is None
+        # bounded LRU: oldest entry evicts past the cap, re-insert
+        # refreshes recency
+        router._tenant_hosts_max = 2
+        for i, t in enumerate(("t0", "t1", "t2")):
+            router._note_tenant_host(_req(2 + i, t), router.hosts[0])
+        with router._stats_lock:
+            assert set(router._tenant_hosts) == {"t1", "t2"}
+        router._note_tenant_host(_req(5, "t1"), router.hosts[1])
+        router._note_tenant_host(_req(6, "t3"), router.hosts[0])
+        with router._stats_lock:
+            assert set(router._tenant_hosts) == {"t1", "t3"}
+        # kill switch: no stickiness, no recording
+        router.tenant_route = False
+        assert router._tenant_pref(req, "full") is None
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------- autoscaler control
+
+
+def test_autoscaler_scales_up_on_burn_with_cooldown():
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1"], timeout_s=1.0)
+    try:
+        router._slo_penalty = lambda h: 1  # every healthy host burning
+        clk = _Clock()
+        reg = MetricsRegistry()
+        seq = itertools.count()
+        a = Autoscaler(router, lambda: f"up{next(seq)}:9001",
+                       clock=clk, registry=reg, enabled=True,
+                       interval_s=1.0, min_hosts=1, max_hosts=3,
+                       cooldown_ticks=2, drain_timeout_s=10.0)
+        s1 = a.tick()
+        assert any(x.startswith("spawned:") for x in s1["actions"])
+        assert len(router.hosts) == 2
+        clk.t += 1
+        assert a.tick()["actions"] == []  # cooldown paces the staircase
+        clk.t += 1
+        a.tick()
+        assert len(router.hosts) == 3
+        for _ in range(3):  # at max_hosts: no further spawns
+            clk.t += 1
+            a.tick()
+        assert len(router.hosts) == 3
+        assert reg.counter("lmrs_autoscale_scale_ups_total").value == 2.0
+        assert reg.gauge("lmrs_autoscale_pool_size").value == 3.0
+        rep = a.report()
+        assert rep["pool"] == 3 and len(rep["spawned"]) == 2
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_drains_then_removes_idle_spawned_host():
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1"], timeout_s=1.0)
+    try:
+        router._slo_penalty = lambda h: 1
+        clk = _Clock()
+        reg = MetricsRegistry()
+        removed: list[str] = []
+        a = Autoscaler(router, lambda: "up0:9001",
+                       remove_cb=removed.append, clock=clk, registry=reg,
+                       enabled=True, interval_s=1.0, min_hosts=1,
+                       max_hosts=2, cooldown_ticks=1, drain_timeout_s=5.0)
+        a.tick()  # burn -> spawn
+        assert len(router.hosts) == 2
+        router._slo_penalty = lambda h: 0  # burn clears, traffic idles
+        clk.t += 1
+        s = a.tick()
+        assert s["actions"] == ["draining:up0:9001"]
+        assert next(h for h in router.hosts
+                    if h.netloc == "up0:9001").draining
+        clk.t += 1
+        s = a.tick()
+        assert s["actions"] == ["removed:up0:9001"]
+        assert len(router.hosts) == 1 and removed == ["up0:9001"]
+        assert reg.counter("lmrs_autoscale_drains_total").value == 1.0
+        assert reg.counter("lmrs_autoscale_scale_downs_total").value == 1.0
+        # at min_hosts nothing further shrinks
+        clk.t += 1
+        assert a.tick()["actions"] == []
+        assert len(router.hosts) == 1
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_force_removes_wedged_drain():
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1"], timeout_s=1.0)
+    try:
+        router._slo_penalty = lambda h: 1
+        clk = _Clock()
+        a = Autoscaler(router, lambda: "up0:9001", clock=clk,
+                       enabled=True, interval_s=1.0, min_hosts=1,
+                       max_hosts=2, cooldown_ticks=1, drain_timeout_s=3.0)
+        a.tick()
+        router._slo_penalty = lambda h: 0
+        clk.t += 1
+        assert a.tick()["actions"] == ["draining:up0:9001"]
+        victim = next(h for h in router.hosts if h.netloc == "up0:9001")
+        victim.note_leg(+1)  # a leg that never finishes
+        clk.t += 1
+        s = a.tick()  # not idle, inside the timeout: drain holds
+        assert not any(x.startswith("removed") for x in s["actions"])
+        assert len(router.hosts) == 2
+        clk.t += 5  # past drain_timeout_s: the wedged victim cannot
+        s = a.tick()  # pin the loop forever
+        assert s["actions"] == ["removed:up0:9001:forced"]
+        assert len(router.hosts) == 1
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_never_drains_operator_capacity():
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1", "h2:2"], timeout_s=1.0)
+    try:
+        clk = _Clock()
+        a = Autoscaler(router, lambda: None, clock=clk, enabled=True,
+                       interval_s=1.0, min_hosts=1, max_hosts=4,
+                       cooldown_ticks=1)
+        a.tick()
+        clk.t += 1
+        s = a.tick()  # idle + size > min, but neither host was spawned
+        assert s["actions"] == [] and len(router.hosts) == 2
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_kill_switch(monkeypatch):
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1"], timeout_s=1.0)
+    try:
+        monkeypatch.delenv("LMRS_AUTOSCALE", raising=False)
+        assert maybe_autoscaler(router, lambda: None) is None  # default OFF
+        monkeypatch.setenv("LMRS_AUTOSCALE", "0")
+        assert maybe_autoscaler(router, lambda: None) is None
+        monkeypatch.setenv("LMRS_AUTOSCALE", "1")
+        a = maybe_autoscaler(router, lambda: None)
+        assert a is not None and a.enabled
+        # a disabled instance observes but never acts, even under burn
+        router._slo_penalty = lambda h: 2
+        off = Autoscaler(router, lambda: "up0:9001", clock=_Clock(),
+                         enabled=False, interval_s=1.0, min_hosts=1,
+                         max_hosts=4)
+        s = off.tick()
+        assert s == {"enabled": False, "pool": 1, "actions": []}
+        assert len(router.hosts) == 1
+    finally:
+        router.shutdown()
