@@ -303,6 +303,7 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
     sched.reset_latency_stats()
     metrics_before = dict(sched.metrics)
     cost_before = sched._cost.report()
+    anatomy_before = sched.anatomy_snapshot()
     reps = env_int("LMRS_BENCH_REPS", 3, lo=1)
     rep_rows = _partial_reps  # shared with the watchdog (see start_watchdog)
     for _ in range(reps):
@@ -335,6 +336,13 @@ def run_bench(trace_out: str | None = None) -> tuple[float, dict]:
         "cost": sched._cost.report(cost_before),
         "slo": _slo_summary(sched.slo_report()),
     })
+    # windowed step anatomy (ISSUE 18, obs/anatomy.py): named host
+    # segments + ragged-span bucket economics over the timed reps only —
+    # the block perf_sentry's anatomy.host_overhead_us_step /
+    # anatomy.rpa_pad_waste_ratio columns resolve against.  Omitted (not
+    # enabled:false) under LMRS_ANATOMY=0, wire-parity rule.
+    if sched._an.enabled:
+        detail["anatomy"] = sched.anatomy_report(anatomy_before)
     # live-vs-offline agreement (ISSUE 8 acceptance): the live attribution
     # gauges gathered DURING the timed reps against the RTT-amortized
     # roofline probe — rel = live/offline - 1 (within ±0.05 = agreeing)

@@ -279,6 +279,14 @@ class JaxEngine:
             return {"object": "qos", "enabled": False}
         return self._scheduler.qos_report()
 
+    def anatomy_report(self) -> dict:
+        """Optional Engine hook: the step-anatomy document behind ``GET
+        /v1/anatomy`` (obs/anatomy.py).  The static scheduler has no
+        iteration loop to decompose: disabled shape."""
+        if self._scheduler is None:
+            return {"object": "anatomy", "enabled": False}
+        return self._scheduler.anatomy_report()
+
     # ---------------------------------------- disaggregated handoff hooks
     # (optional Engine surface, same getattr convention as ``cancel``):
     # the continuous scheduler implements the real page pin/export/import

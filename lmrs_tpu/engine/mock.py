@@ -21,7 +21,9 @@ from lmrs_tpu.engine.api import (GenerationRequest, GenerationResult,
                                  apply_stop_sequences, preamble_key,
                                  preamble_text)
 from lmrs_tpu.obs import get_tracer, req_tid
+from lmrs_tpu.obs.anatomy import CLASSES, SEGMENTS, _pct, anatomy_enabled
 from lmrs_tpu.testing import faults
+from lmrs_tpu.utils.perf_model import pow2_bucket
 
 _TS_RE = re.compile(r"\[(?:\d+:)?\d{2}:\d{2}\]")
 
@@ -133,6 +135,18 @@ class MockEngine:
         self._rpa_span_tokens = 0      # guarded-by: _mixed_lock
         self._rpa_dispatches = 0       # guarded-by: _mixed_lock
         self._rpa_shapes: set = set()  # guarded-by: _mixed_lock
+        # Step-anatomy parity (obs/anatomy.py): the same report shape the
+        # scheduler's profiler exposes, deterministically emulated — every
+        # segment derives from token counts at EMU_SECONDS_PER_TOKEN,
+        # never wall clocks, so two arms running identical traffic
+        # produce byte-identical anatomy documents, and wall == segment
+        # sum exactly (residual 0) by construction.  LMRS_ANATOMY=0
+        # disarms the whole surface (report shape / wire parity with the
+        # scheduler's kill switch).
+        self._an_lock = threading.Lock()
+        self._an_segs = {s: 0.0 for s in SEGMENTS}  # guarded-by: _an_lock
+        self._an_cls: dict[str, list] = {c: [] for c in CLASSES}
+        self._an_buckets: dict[tuple[int, int], dict] = {}
         self._tok = ApproxTokenizer()
         # Cost ledger + SLO parity (obs/ledger.py, obs/slo.py): the SAME
         # accounting/knob surface as the jax scheduler, deterministically
@@ -257,6 +271,11 @@ class MockEngine:
             t0 = time.time()
             res = self._one(req)
             self._bill(req, res)
+            # one emulated "plain" scheduler iteration per request:
+            # dispatch carries the prompt, fetch the completion
+            self._note_anatomy("plain",
+                               dispatch_tokens=res.prompt_tokens,
+                               fetch_tokens=res.completion_tokens)
             self.slo.observe_ttft(time.time() - t0)
             self.slo.note_result(res.finish_reason, res.completion_tokens,
                                  res.error)
@@ -312,11 +331,116 @@ class MockEngine:
                         self._rpa_dispatches += 1
                         self._rpa_span_tokens += total
                         # same pow2 bucket family the scheduler compiles
-                        bucket = 16
-                        while bucket < total:
-                            bucket *= 2
+                        # (one shared definition — utils/perf_model)
+                        bucket = pow2_bucket(total, 16)
                         self._rpa_shapes.add(bucket)
+                        self._note_rpa_bucket(bucket, total)
+                    # each emulated slice is one "mixed" iteration:
+                    # dispatch carries the span, fetch the decode tokens
+                    self._note_anatomy("mixed",
+                                       dispatch_tokens=n_decode + c,
+                                       fetch_tokens=n_decode)
                     remaining -= c
+
+    def _note_anatomy(self, cls: str, *, dispatch_tokens: int,
+                      fetch_tokens: int) -> None:
+        """One emulated scheduler iteration (obs/anatomy.py parity, see
+        __init__): fixed one-token admit/plan/finish segments plus
+        token-count-derived dispatch/fetch, all at EMU_SECONDS_PER_TOKEN
+        — wall equals the segment sum exactly, so the mock's anatomy is
+        conservation-perfect and byte-reproducible."""
+        if not anatomy_enabled():
+            return
+        spt = self.EMU_SECONDS_PER_TOKEN
+        segs = {s: 0.0 for s in SEGMENTS}
+        segs["admit"] = spt
+        segs["plan"] = spt
+        segs["dispatch"] = max(0, int(dispatch_tokens)) * spt
+        segs["fetch"] = max(0, int(fetch_tokens)) * spt
+        segs["finish"] = spt
+        with self._an_lock:
+            for s in SEGMENTS:
+                self._an_segs[s] += segs[s]
+            self._an_cls[cls].append(
+                (sum(segs.values()), tuple(segs[s] for s in SEGMENTS)))
+
+    def _note_rpa_bucket(self, tpb: int, real_tokens: int) -> None:
+        """Bucket-economics parity for one emulated ragged-span dispatch:
+        the real-vs-padded split the scheduler's profiler counts, with a
+        deterministic emulated compile cost (bucket * EMU_SECONDS_PER_
+        TOKEN) on first sight of a shape."""
+        if not anatomy_enabled():
+            return
+        pages = -(-max(1, int(real_tokens)) // self.EMU_PAGE_TOKENS)
+        w = pow2_bucket(pages, 4)
+        with self._an_lock:
+            first = (tpb, w) not in self._an_buckets
+            rec = self._an_buckets.setdefault((tpb, w), {
+                "dispatches": 0, "real": 0, "padded": 0, "compile_s": 0.0})
+            rec["dispatches"] += 1
+            rec["real"] += int(real_tokens)
+            rec["padded"] += max(tpb - int(real_tokens), 0)
+            if first:
+                rec["compile_s"] = tpb * self.EMU_SECONDS_PER_TOKEN
+
+    def anatomy_report(self) -> dict:
+        """Optional Engine hook: the ``GET /v1/anatomy`` document — same
+        shape as the scheduler's (obs/anatomy.py ``StepAnatomy.report``),
+        deterministically derived from token counts."""
+        if not anatomy_enabled():
+            return {"object": "anatomy", "enabled": False}
+        with self._an_lock:
+            segs = dict(self._an_segs)
+            cls_recs = {c: list(rs) for c, rs in self._an_cls.items()}
+            bucket_recs = {k: dict(v) for k, v in self._an_buckets.items()}
+        iters = sum(len(rs) for rs in cls_recs.values())
+        wall = sum(segs.values())  # residual is 0 by construction
+        host = wall - segs["dispatch"] - segs["fetch"]
+        classes: dict[str, dict] = {}
+        for cls in CLASSES:
+            rs = cls_recs[cls]
+            if not rs:
+                continue
+            walls = sorted(r[0] for r in rs)
+            p50: dict[str, float] = {}
+            p95: dict[str, float] = {}
+            for i, s in enumerate(SEGMENTS):
+                vals = sorted(r[1][i] for r in rs)
+                p50[s] = round(_pct(vals, 50) * 1e6, 1)
+                p95[s] = round(_pct(vals, 95) * 1e6, 1)
+            p50["wall"] = round(_pct(walls, 50) * 1e6, 1)
+            p95["wall"] = round(_pct(walls, 95) * 1e6, 1)
+            classes[cls] = {"iterations": len(rs),
+                            "p50_us": p50, "p95_us": p95}
+        buckets: dict[str, dict] = {}
+        tot_real = tot_pad = 0
+        for (tpb, w), rec in sorted(bucket_recs.items()):
+            span = rec["real"] + rec["padded"]
+            buckets[f"{tpb}x{w}"] = {
+                "dispatches": rec["dispatches"],
+                "real_tokens": rec["real"],
+                "padded_tokens": rec["padded"],
+                "pad_waste": round(rec["padded"] / span, 4) if span else 0.0,
+                "compile_ms": round(rec["compile_s"] * 1e3, 1),
+            }
+            tot_real += rec["real"]
+            tot_pad += rec["padded"]
+        return {
+            "object": "anatomy",
+            "enabled": True,
+            "iterations": iters,
+            "aborted_iterations": 0,
+            "wall_ms": round(wall * 1e3, 3),
+            "residual_ms": 0.0,
+            "segments_ms": {s: round(segs[s] * 1e3, 3) for s in SEGMENTS},
+            "host_overhead_us_step": (round(host * 1e6 / iters, 1)
+                                      if iters > 0 else None),
+            "classes": classes,
+            "buckets": buckets,
+            "rpa_pad_waste_ratio": (
+                round(tot_pad / (tot_real + tot_pad), 4)
+                if (tot_real + tot_pad) else None),
+        }
 
     def _note_prefix(self, req: GenerationRequest) -> None:
         """Deterministic prefix-cache + spill-tier accounting for one
@@ -525,6 +649,13 @@ class MockEngine:
         # SLO burns are wall-clock-fed — consumers read slo_report()
         if self.ledger.enabled and self.ledger.finished_count:
             out["cost"] = self.ledger.report()
+        # anatomy block: deterministic (token-count-derived), same
+        # report-nothing-when-idle + LMRS_ANATOMY=0 shape contract as the
+        # scheduler's metrics_report
+        if anatomy_enabled():
+            an = self.anatomy_report()
+            if an.get("iterations"):
+                out["anatomy"] = an
         # no work recorded at all: the mock reports no engine metrics,
         # as it always has
         return out

@@ -396,6 +396,19 @@ class ReplicatedEngine:
         return {"object": "usage", "enabled": enabled, "tenants": tenants,
                 "totals": totals_from_tenants(tenants)}
 
+    def anatomy_report(self) -> dict:
+        """Optional Engine hook: replica anatomy documents merged with the
+        one merge rule (obs.merge_anatomy) — additive totals sum exactly,
+        per-class percentiles are iteration-weighted estimates."""
+        from lmrs_tpu.obs.anatomy import merge_anatomy
+
+        docs = []
+        for r in self.replicas:
+            hook = getattr(r, "anatomy_report", None)
+            if hook is not None:
+                docs.append(hook())
+        return merge_anatomy(docs)
+
     def slo_report(self) -> dict:
         """Optional Engine hook: the replicated engine's health is the
         WORST replica's SLO state (one degraded shard degrades the

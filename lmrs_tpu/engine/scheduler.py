@@ -44,22 +44,19 @@ from lmrs_tpu.engine.kv_cache import (OutOfPages, PagedKVCache, SequencePages,
 from lmrs_tpu.engine.prefix_cache import PrefixCache
 from lmrs_tpu.fleet.qos import maybe_qos
 from lmrs_tpu.models.transformer import forward_paged
-from lmrs_tpu.ops.paged_attention import pack_spans
+from lmrs_tpu.ops.paged_attention import pack_spans, pow2_bucket
 from lmrs_tpu.obs import (POW2_TOKEN_BUCKETS, RATIO_BUCKETS, CostLedger,
                           DispatchAttribution, MetricsRegistry, SLOEngine,
-                          dump_postmortem, get_tracer, req_tid)
+                          dump_postmortem, get_tracer, maybe_anatomy, req_tid)
 from lmrs_tpu.ops.sampling import sample_logits
 from lmrs_tpu.testing import faults
 from lmrs_tpu.utils.env import env_bool, env_float, env_int, env_str
 
 logger = logging.getLogger("lmrs.scheduler")
 
-
-def _pow2_bucket(n: int, lo: int) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+# bucket edges shared with the kernel family and the bucket-economics
+# accounting (ops/paged_attention.pow2_bucket — one definition)
+_pow2_bucket = pow2_bucket
 
 
 # NOTE: quarter-step sequence buckets (p*1.25/1.5/1.75 between powers of
@@ -584,6 +581,14 @@ class ContinuousScheduler:
         # stream's own TTFT / block-gap / outcome samples; /healthz and
         # the router's placement penalty read slo_report().
         self._slo = SLOEngine(self.registry, metrics_cb=lambda: self.metrics)
+        # Step-anatomy profiler (obs/anatomy.py): every run() iteration is
+        # split into named host segments via _an.seg(...), conservation-
+        # audited (wall == segments + residual) in audit(), plus bucket
+        # economics for the ragged-span pow2 family.  LMRS_ANATOMY=0
+        # swaps in the shared null object — no metrics registered, every
+        # call a no-op, outputs and wire byte-identical.
+        self._an = maybe_anatomy(self.registry,
+                                 metrics_cb=lambda: self.metrics)
         # LMRS_PROFILE_ON_SLOW_STEP: a decode block slower than the
         # threshold (warm shapes only) triggers ONE jax.profiler capture
         # per process into LMRS_PROFILE_DIR — the "why was that step
@@ -713,6 +718,20 @@ class ContinuousScheduler:
             return {"object": "qos", "enabled": False}
         return self._qos.report()
 
+    def anatomy_report(self, before: dict | None = None) -> dict:
+        """Step-anatomy decomposition + ragged bucket economics (the
+        ``GET /v1/anatomy`` document and the ``anatomy`` block of
+        metrics_report()/bench detail).  ``before`` is an
+        ``anatomy_snapshot()`` window anchor; the RTT rides along so the
+        report can flag a stale sample instead of letting it skew the
+        dispatch/fetch split (obs/anatomy.py)."""
+        return self._an.report(before, rtt=self._perf.rtt_sample())
+
+    def anatomy_snapshot(self) -> dict:
+        """Window anchor for ``anatomy_report(before=...)`` (bench /
+        serving_latency delta their measurement window off this)."""
+        return self._an.snapshot()
+
     def cost_finish(self, req: GenerationRequest, res: GenerationResult
                     ) -> None:
         """Finalize a request's ledger entry for a result synthesized
@@ -834,6 +853,10 @@ class ContinuousScheduler:
             "perf_attribution": self._perf.report(),
             "cost": self._cost.report(),
             "slo": self._slo.report(),
+            # kill-switch shape contract: NO anatomy key at all under
+            # LMRS_ANATOMY=0 — the pre-anatomy report is byte-identical
+            **({"anatomy": self.anatomy_report()}
+               if self._an.enabled else {}),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
             **({"prefix_cache": self._prefix_cache_report()}
@@ -1109,6 +1132,13 @@ class ContinuousScheduler:
                         ent = queue[k]
                         del queue[k]
                         queue.appendleft(ent)
+                        if tr:
+                            # fleet-drift contract (trace.py): a QoS
+                            # promotion is an auditable scheduling decision
+                            tr.instant("qos_reorder",
+                                       args={"picked": k, "window": win,
+                                             "tenant": ent[0].tenant
+                                             or "default"})
                 # Deadline admission control (load shedding): drop head
                 # entries whose remaining budget cannot cover the TTFT
                 # estimate — a fast explicit rejection BEFORE prefill beats
@@ -1277,44 +1307,54 @@ class ContinuousScheduler:
             wd.run_started()
         try:
             while True:
-                # injection site: a fired plan fails this scheduler
-                # iteration the way a bad dispatch would — exercising the
-                # pool-recovery path in the except below
-                faults.fire("scheduler.step")
-                # injection site + heartbeat (hang survival, engine/
-                # watchdog.py): a "stall" plan here wedges the loop the
-                # way a hung chip would — no beat lands, the watchdog
-                # declares the wedge.  With LMRS_WATCHDOG=0 the same
-                # stall simply hangs the run (today's behavior).
-                faults.fire("scheduler.heartbeat")
-                if wd is not None:
-                    wd.beat()
-                # sweep cancellations first (block boundary): their results are
-                # then delivered with this iteration's fresh batch
-                if self._cancelled:
-                    self._sweep_cancelled(queue, slots, results, active, fresh,
-                                          kv_lens, last_tok)
-                # acked/orphaned handoff releases parked by handler/sweeper
-                # threads free here, on the scheduler thread (see
-                # release_handoff) — their pages rejoin the pool within
-                # one block of the ack
-                if self._release_deferred:
-                    self._drain_released()
-                # deadline expiry rides the same block-boundary cadence as
-                # the cancel sweep: an in-flight request expires within one
-                # decode block of its deadline
-                if self._any_deadline:
-                    self._sweep_deadlines(queue, slots, results, active,
-                                          fresh, kv_lens, last_tok)
+                # step anatomy (obs/anatomy.py): one iteration record per
+                # pass; every ``continue``/bottom closes it with iter_end
+                # (classed), the exit break discards it, and the finally
+                # aborts whatever a fault left open
+                self._an.iter_begin()
+                with self._an.seg("admit"):
+                    # injection site: a fired plan fails this scheduler
+                    # iteration the way a bad dispatch would — exercising
+                    # the pool-recovery path in the except below
+                    faults.fire("scheduler.step")
+                    # injection site + heartbeat (hang survival, engine/
+                    # watchdog.py): a "stall" plan here wedges the loop the
+                    # way a hung chip would — no beat lands, the watchdog
+                    # declares the wedge.  With LMRS_WATCHDOG=0 the same
+                    # stall simply hangs the run (today's behavior).
+                    faults.fire("scheduler.heartbeat")
+                    if wd is not None:
+                        wd.beat()
+                    # sweep cancellations first (block boundary): their
+                    # results are then delivered with this iteration's
+                    # fresh batch
+                    if self._cancelled:
+                        self._sweep_cancelled(queue, slots, results, active,
+                                              fresh, kv_lens, last_tok)
+                    # acked/orphaned handoff releases parked by handler/
+                    # sweeper threads free here, on the scheduler thread
+                    # (see release_handoff) — their pages rejoin the pool
+                    # within one block of the ack
+                    if self._release_deferred:
+                        self._drain_released()
+                    # deadline expiry rides the same block-boundary cadence
+                    # as the cancel sweep: an in-flight request expires
+                    # within one decode block of its deadline
+                    if self._any_deadline:
+                        self._sweep_deadlines(queue, slots, results, active,
+                                              fresh, kv_lens, last_tok)
                 # deliver fresh results first: the callback may submit new work,
                 # which the loop-exit check below must see (a reduce batch
                 # submitted by the LAST map result must still run)
-                if on_result is not None:
-                    while fresh:
-                        on_result(results[fresh.popleft()], submit)
+                with self._an.seg("io"):
+                    if on_result is not None:
+                        while fresh:
+                            on_result(results[fresh.popleft()], submit)
                 if not (queue or any(s is not None for s in slots)):
+                    self._an.iter_discard()
                     break
-                admit()
+                with self._an.seg("admit"):
+                    admit()
                 # SARATHI mixed step: when a prompt is mid-prefill WHILE
                 # other slots decode, fuse one prompt slice into the
                 # decode step as a single multi-token dispatch — decode
@@ -1324,10 +1364,15 @@ class ContinuousScheduler:
                 # prefill / pure decode iterations are unchanged, so
                 # LMRS_MIXED=0 restores today's dispatch byte-for-byte).
                 if self._mixed:
-                    did, last_block_t = self._mixed_iteration(
-                        slots, queue, results, fresh, kv_lens, last_tok,
-                        active, temps, top_k, top_p, t_enq, last_block_t)
+                    # anatomy: the mixed handler re-segments its own
+                    # draft/dispatch/fetch/finish internally; the "plan"
+                    # wrapper catches the remaining operand plumbing
+                    with self._an.seg("plan"):
+                        did, last_block_t = self._mixed_iteration(
+                            slots, queue, results, fresh, kv_lens, last_tok,
+                            active, temps, top_k, top_p, t_enq, last_block_t)
                     if did:
+                        self._an.iter_end("spec" if self.spec_k else "mixed")
                         continue
                 # advance every prefilling slot by ONE prompt chunk, then give
                 # decode a turn — long prompts never monopolize the device.
@@ -1339,27 +1384,33 @@ class ContinuousScheduler:
                 # last_tok input, and rides back in the decode block's single
                 # device_get — one fewer ~full-RTT host sync per admission wave.
                 t_pf = time.time()  # prefill-wave dispatch-issue anchor
-                pending = self._advance_prefills(slots)
+                with self._an.seg("plan"):
+                    # operand build inside; the jitted calls re-segment
+                    # themselves as "dispatch" (pause semantics)
+                    pending = self._advance_prefills(slots)
                 deferred: list[tuple[int, int, int]] = []  # (slot, pend idx, row)
-                for p, (tok0_dev, rows) in enumerate(pending):
-                    for b, row in rows:
-                        st = slots[b]
-                        st.phase = "decode"
-                        st.t_decode_start = time.time()
-                        if tr:
-                            tr.complete(
-                                "prefill", st.t_admit, st.t_decode_start,
-                                tid=self._tid(st.req),
-                                args={"prompt_tokens": len(st.prompt_ids)})
-                        st.kv_len = len(st.prompt_ids)
-                        kv_lens[b] = st.kv_len
-                        active[b] = True
-                        # donate the prompt's full-page prefix to the prefix
-                        # cache NOW (not at finish): the dispatch writing
-                        # these pages is already issued, and later
-                        # admissions in the same run can hit immediately
-                        self._cache_insert(st)
-                        deferred.append((b, p, row))
+                with self._an.seg("finish"):
+                    for p, (tok0_dev, rows) in enumerate(pending):
+                        for b, row in rows:
+                            st = slots[b]
+                            st.phase = "decode"
+                            st.t_decode_start = time.time()
+                            if tr:
+                                tr.complete(
+                                    "prefill", st.t_admit, st.t_decode_start,
+                                    tid=self._tid(st.req),
+                                    args={"prompt_tokens":
+                                          len(st.prompt_ids)})
+                            st.kv_len = len(st.prompt_ids)
+                            kv_lens[b] = st.kv_len
+                            active[b] = True
+                            # donate the prompt's full-page prefix to the
+                            # prefix cache NOW (not at finish): the dispatch
+                            # writing these pages is already issued, and
+                            # later admissions in the same run can hit
+                            # immediately
+                            self._cache_insert(st)
+                            deferred.append((b, p, row))
                 if pending and (self.spec_k or not self.defer_tok0
                                 or any(slots[b] is not None
                                        and slots[b].req.handoff_export
@@ -1371,44 +1422,13 @@ class ContinuousScheduler:
                     # the sync fetch finishes (pins) them here and the prefill
                     # pod never burns a decode-block dispatch on tokens the
                     # handoff would trim anyway.
-                    fetched = self._timed_get([t for t, _ in pending])
+                    with self._an.seg("fetch"):
+                        fetched = self._timed_get([t for t, _ in pending])
                     # clean prefill MFU sample: the wall from dispatch
                     # issue to this fetch covers exactly the prefill
                     # compute (+1 RTT) — the prefill pod's whole life
                     t_fetch = time.time()
-                    flops, cold = self._consume_prefill_attr()
-                    self._perf.note_prefill_sync(flops, t_pf, t_fetch,
-                                                 warm=not cold)
-                    self._cost.note_step(
-                        max(0.0, t_fetch - t_pf),
-                        prefill_rows=self._consume_prefill_cost(),
-                        prefill_cost_s=1.0)
-                    for (b, p, row) in deferred:
-                        st = slots[b]
-                        tok0 = int(fetched[p][row])
-                        st.generated.append(tok0)
-                        self._note_first_token(st, t_enq)
-                        last_tok[b] = tok0
-                        self.seed_history(b, st)
-                        self._maybe_finish(b, slots, results, active, fresh,
-                                           kv_lens, last_tok)
-                    deferred = []
-                    pending = []
-                if not any(active):
-                    continue
-                # grow every decode slot's pages to cover the coming block;
-                # under pool pressure the youngest decode slot is preempted
-                # back to the queue (its pending tok0, if any, is simply
-                # re-sampled when it re-prefills)
-                stalled = self._ensure_decode_capacity(slots, queue, kv_lens,
-                                                       last_tok, active)
-                if not any(active):
-                    if deferred:
-                        # no dispatch will carry these first tokens: fetch them
-                        # now — a stalled slot's tok0 is real output and must
-                        # not be dropped (preempted slots resample theirs)
-                        fetched = self._timed_get([t for t, _ in pending])
-                        t_fetch = time.time()
+                    with self._an.seg("finish"):
                         flops, cold = self._consume_prefill_attr()
                         self._perf.note_prefill_sync(flops, t_pf, t_fetch,
                                                      warm=not cold)
@@ -1417,17 +1437,56 @@ class ContinuousScheduler:
                             prefill_rows=self._consume_prefill_cost(),
                             prefill_cost_s=1.0)
                         for (b, p, row) in deferred:
-                            if slots[b] is None:
-                                continue
+                            st = slots[b]
                             tok0 = int(fetched[p][row])
-                            slots[b].generated.append(tok0)
-                            self._note_first_token(slots[b], t_enq)
+                            st.generated.append(tok0)
+                            self._note_first_token(st, t_enq)
                             last_tok[b] = tok0
-                            self._maybe_finish(b, slots, results, active, fresh,
-                                               kv_lens, last_tok)
+                            with self._an.seg("draft"):
+                                self.seed_history(b, st)
+                            self._maybe_finish(b, slots, results, active,
+                                               fresh, kv_lens, last_tok)
+                    deferred = []
+                    pending = []
+                if not any(active):
+                    self._an.iter_end("prefill")
+                    continue
+                # grow every decode slot's pages to cover the coming block;
+                # under pool pressure the youngest decode slot is preempted
+                # back to the queue (its pending tok0, if any, is simply
+                # re-sampled when it re-prefills)
+                with self._an.seg("admit"):
+                    stalled = self._ensure_decode_capacity(
+                        slots, queue, kv_lens, last_tok, active)
+                if not any(active):
+                    if deferred:
+                        # no dispatch will carry these first tokens: fetch them
+                        # now — a stalled slot's tok0 is real output and must
+                        # not be dropped (preempted slots resample theirs)
+                        with self._an.seg("fetch"):
+                            fetched = self._timed_get([t for t, _ in pending])
+                        t_fetch = time.time()
+                        with self._an.seg("finish"):
+                            flops, cold = self._consume_prefill_attr()
+                            self._perf.note_prefill_sync(flops, t_pf, t_fetch,
+                                                         warm=not cold)
+                            self._cost.note_step(
+                                max(0.0, t_fetch - t_pf),
+                                prefill_rows=self._consume_prefill_cost(),
+                                prefill_cost_s=1.0)
+                            for (b, p, row) in deferred:
+                                if slots[b] is None:
+                                    continue
+                                tok0 = int(fetched[p][row])
+                                slots[b].generated.append(tok0)
+                                self._note_first_token(slots[b], t_enq)
+                                last_tok[b] = tok0
+                                self._maybe_finish(b, slots, results, active,
+                                                   fresh, kv_lens, last_tok)
                     for b in stalled:  # re-arm before looping back
                         if slots[b] is not None:
                             active[b] = True
+                    self._an.iter_end("prefill")
                     continue
                 n_live = int(np.sum(active))
                 self._h_occupancy.observe(n_live / self.B)
@@ -1437,80 +1496,91 @@ class ContinuousScheduler:
                     self._h_block_gap.observe(now - last_block_t)
                     self._slo.observe_gap(now - last_block_t)
                 last_block_t = now
+                # anatomy: the block methods re-segment their own draft/
+                # dispatch/fetch internally; the "plan" wrapper catches
+                # the operand build + result scatter plumbing around them
                 if self.spec_k:
-                    emitted = self._spec_decode_block(
-                        slots, last_tok, kv_lens, active, temps, top_k, top_p)
+                    with self._an.seg("plan"):
+                        emitted = self._spec_decode_block(
+                            slots, last_tok, kv_lens, active, temps, top_k,
+                            top_p)
                 else:
-                    toks, n_valid, tok0s = self._decode_block(
-                        slots, last_tok, kv_lens, active, temps, top_k, top_p,
-                        pending)
-                    emitted = [toks[b, : int(n_valid[b])].tolist()
-                               for b in range(self.B)]
-                if self._cost.enabled and self._cost_step is not None:
-                    # the dispatch wall stashed by _decode_block /
-                    # _spec_decode_block meets its per-row token counts
-                    # here — one ledger note per dispatch, issued BEFORE
-                    # any of this iteration's finishes (the mixed path's
-                    # ordering): a row finishing on this very block must
-                    # have its final share billed while its entry is
-                    # still open, not re-created as an orphan after
-                    # finish() already rolled it up
-                    wall, dcost, pcost, prows = self._cost_step
-                    self._cost_step = None
-                    self._cost.note_step(
-                        wall,
-                        decode_rows=[(slots[b].req, len(emitted[b]),
-                                      len(slots[b].seq.pages))
-                                     for b in range(self.B)
-                                     if slots[b] is not None and active[b]],
-                        prefill_rows=prows,
-                        decode_cost_s=dcost, prefill_cost_s=pcost)
-                if not self.spec_k:
-                    for (b, p, row) in deferred:
-                        if slots[b] is None:
-                            continue  # preempted: tok0 is resampled on re-prefill
-                        tok0 = int(tok0s[p][row])
-                        slots[b].generated.append(tok0)
-                        self._note_first_token(slots[b], t_enq)
-                        last_tok[b] = tok0
-                        if not active[b]:
-                            # STALLED this dispatch (no pages to grow): the slot
-                            # emitted nothing, but its first token is real output
-                            # — record it and check for an early finish; the
-                            # emitted loop below skips inactive rows
-                            self._maybe_finish(b, slots, results, active, fresh,
-                                               kv_lens, last_tok)
-                block_tokens = 0
-                for b in range(self.B):
-                    st = slots[b]
-                    if st is None or not active[b]:
-                        continue
-                    new = emitted[b]
-                    st.generated.extend(new)
-                    st.kv_len += len(new)
-                    kv_lens[b] = st.kv_len
-                    last_tok[b] = st.generated[-1] if st.generated else 0
-                    self._c_decode_tokens.inc(len(new))
-                    block_tokens += len(new)
-                    if tr and new:
-                        tr.instant("decode_block", ts=now,
-                                   tid=self._tid(st.req),
-                                   args={"tokens": len(new)})
-                    self._maybe_finish(b, slots, results, active, fresh,
-                                       kv_lens, last_tok)
-                if tr:
-                    # scheduler-track span: dispatch issue through host-side
-                    # result processing; start timestamps are the former
-                    # LMRS_TRACE_DISPATCH list (Tracer.timestamps).
-                    # hbm_gb = the block's model byte cost (perf
-                    # attribution; 0 for spec blocks, whose model differs)
-                    tr.complete("decode_block", now, time.time(),
-                                args={"active": n_live,
-                                      "tokens": block_tokens,
-                                      "hbm_gb": self._attr_last_gb})
-                for b in stalled:  # stalled rows rejoin the next dispatch
-                    if slots[b] is not None:
-                        active[b] = True
+                    with self._an.seg("plan"):
+                        toks, n_valid, tok0s = self._decode_block(
+                            slots, last_tok, kv_lens, active, temps, top_k,
+                            top_p, pending)
+                        emitted = [toks[b, : int(n_valid[b])].tolist()
+                                   for b in range(self.B)]
+                with self._an.seg("finish"):
+                    if self._cost.enabled and self._cost_step is not None:
+                        # the dispatch wall stashed by _decode_block /
+                        # _spec_decode_block meets its per-row token counts
+                        # here — one ledger note per dispatch, issued BEFORE
+                        # any of this iteration's finishes (the mixed path's
+                        # ordering): a row finishing on this very block must
+                        # have its final share billed while its entry is
+                        # still open, not re-created as an orphan after
+                        # finish() already rolled it up
+                        wall, dcost, pcost, prows = self._cost_step
+                        self._cost_step = None
+                        self._cost.note_step(
+                            wall,
+                            decode_rows=[(slots[b].req, len(emitted[b]),
+                                          len(slots[b].seq.pages))
+                                         for b in range(self.B)
+                                         if slots[b] is not None
+                                         and active[b]],
+                            prefill_rows=prows,
+                            decode_cost_s=dcost, prefill_cost_s=pcost)
+                    if not self.spec_k:
+                        for (b, p, row) in deferred:
+                            if slots[b] is None:
+                                continue  # preempted: tok0 resampled later
+                            tok0 = int(tok0s[p][row])
+                            slots[b].generated.append(tok0)
+                            self._note_first_token(slots[b], t_enq)
+                            last_tok[b] = tok0
+                            if not active[b]:
+                                # STALLED this dispatch (no pages to grow):
+                                # the slot emitted nothing, but its first
+                                # token is real output — record it and
+                                # check for an early finish; the emitted
+                                # loop below skips inactive rows
+                                self._maybe_finish(b, slots, results, active,
+                                                   fresh, kv_lens, last_tok)
+                    block_tokens = 0
+                    for b in range(self.B):
+                        st = slots[b]
+                        if st is None or not active[b]:
+                            continue
+                        new = emitted[b]
+                        st.generated.extend(new)
+                        st.kv_len += len(new)
+                        kv_lens[b] = st.kv_len
+                        last_tok[b] = st.generated[-1] if st.generated else 0
+                        self._c_decode_tokens.inc(len(new))
+                        block_tokens += len(new)
+                        if tr and new:
+                            tr.instant("decode_block", ts=now,
+                                       tid=self._tid(st.req),
+                                       args={"tokens": len(new)})
+                        self._maybe_finish(b, slots, results, active, fresh,
+                                           kv_lens, last_tok)
+                    if tr:
+                        # scheduler-track span: dispatch issue through
+                        # host-side result processing; start timestamps are
+                        # the former LMRS_TRACE_DISPATCH list
+                        # (Tracer.timestamps).  hbm_gb = the block's model
+                        # byte cost (perf attribution; 0 for spec blocks,
+                        # whose model differs)
+                        tr.complete("decode_block", now, time.time(),
+                                    args={"active": n_live,
+                                          "tokens": block_tokens,
+                                          "hbm_gb": self._attr_last_gb})
+                    for b in stalled:  # stalled rows rejoin the next dispatch
+                        if slots[b] is not None:
+                            active[b] = True
+                self._an.iter_end("spec" if self.spec_k else "plain")
 
         except Exception as run_exc:
             # Dispatch/step failure mid-run.  The exception re-raises —
@@ -1588,6 +1658,10 @@ class ContinuousScheduler:
             # clamped (same reason as _timed_get) — doubly important here:
             # this runs in a finally, where a raise would mask the real error
             self._c_run_seconds.inc(max(0.0, time.time() - t_run))
+            # an iteration a fault left open contributes NOTHING to the
+            # anatomy totals (iter_abort discards) — conservation survives
+            # the chaos arms by construction; no-op after a clean close
+            self._an.iter_abort()
             if wd is not None:
                 wd.run_ended()
             self._on_tokens = None
@@ -1794,6 +1868,10 @@ class ContinuousScheduler:
                               "record(s) overwrote an existing result "
                               "(termination-exactly-once broken)")
         violations += self._cost.audit()
+        # anatomy conservation: iteration wall == segment sums + residual
+        # (obs/anatomy.py; totals only advance at iter_end, so this is
+        # safe to call mid-run from a callback)
+        violations += self._an.audit()
         if violations:
             # an invariant break is exactly the moment the last-N spans
             # and counters matter; no-op unless the recorder is armed
@@ -2549,6 +2627,13 @@ class ContinuousScheduler:
                 best, best_key = b, key
         if best is not None:
             self._qos.note_preempt()
+            if self._tr:
+                # fleet-drift contract (trace.py): a QoS preemption is an
+                # auditable scheduling decision, visible in the trace
+                self._tr.instant("qos_preempt",
+                                 args={"slot": best,
+                                       "tenant": slots[best].req.tenant
+                                       or "default"})
         return best
 
     def _youngest_decode_slot(self, slots, active, exclude: int) -> int | None:
@@ -2949,96 +3034,103 @@ class ContinuousScheduler:
         if not warm:
             self._wd_grace_cold()
         t_disp = time.time()
-        try:
-            nxt, self.cache.k, self.cache.v = \
-                self._get_mixed_fn(T, w)(*args)
-        except Exception:
-            # same contract as the decode/spec fallbacks: degrade only on
-            # a first-run lowering failure of the multi-token kernel
-            # (donation happens at execution, args still valid); a
-            # failure on a proven shape re-raises
-            if not self._use_ragged or key_ in self._ran_ok:
-                raise
-            logger.warning("mixed multi-token kernel failed to lower; "
-                           "falling back to XLA multi decode",
-                           exc_info=True)
-            self._invalidate_compiled()
-            nxt, self.cache.k, self.cache.v = \
-                self._get_mixed_fn(T, w)(*args)
+        with self._an.seg("dispatch"):
+            try:
+                nxt, self.cache.k, self.cache.v = \
+                    self._get_mixed_fn(T, w)(*args)
+            except Exception:
+                # same contract as the decode/spec fallbacks: degrade only
+                # on a first-run lowering failure of the multi-token
+                # kernel (donation happens at execution, args still
+                # valid); a failure on a proven shape re-raises
+                if not self._use_ragged or key_ in self._ran_ok:
+                    raise
+                logger.warning("mixed multi-token kernel failed to lower; "
+                               "falling back to XLA multi decode",
+                               exc_info=True)
+                self._invalidate_compiled()
+                nxt, self.cache.k, self.cache.v = \
+                    self._get_mixed_fn(T, w)(*args)
         self._note_ran_ok(key_)
-        nxt = np.asarray(self._timed_get(nxt))
+        with self._an.seg("fetch"):
+            nxt = np.asarray(self._timed_get(nxt))
         t_done = time.time()
 
         # exact-split attribution: the fused step's per-row token counts
         # are known, so no decode-share estimate is involved (note_block's
         # EMA decomposition stays for the sequenced-prefill block path)
-        extra_flops, cold_pf = self._consume_prefill_attr()
-        nb = self._perf.note_mixed_step(
-            t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
-            warm=warm and not cold_pf)
-        self._attr_last_gb = round(nb / 1e9, 3)
-        if self._cost.enabled:
-            # fused-step ledger note: every decode row advanced exactly
-            # one token; the piggybacked slice joins the pending prefill
-            # rows (the ISSUE's exact per-row split, no estimates)
-            dcost, pcost = self._roofline_phase_costs(
-                nb, flops + extra_flops)
-            self._cost.note_step(
-                max(0.0, t_done - t_disp),
-                decode_rows=[(slots[b].req, 1, len(slots[b].seq.pages))
-                             for b in rows],
-                prefill_rows=(self._consume_prefill_cost()
-                              + [(st_pf.req, c, flops)]),
-                decode_cost_s=dcost, prefill_cost_s=pcost)
+        with self._an.seg("finish"):
+            extra_flops, cold_pf = self._consume_prefill_attr()
+            nb = self._perf.note_mixed_step(
+                t_disp, t_done, len(rows), live_tokens,
+                flops + extra_flops, warm=warm and not cold_pf)
+            self._attr_last_gb = round(nb / 1e9, 3)
+            if self._cost.enabled:
+                # fused-step ledger note: every decode row advanced
+                # exactly one token; the piggybacked slice joins the
+                # pending prefill rows (the ISSUE's exact per-row split,
+                # no estimates)
+                dcost, pcost = self._roofline_phase_costs(
+                    nb, flops + extra_flops)
+                self._cost.note_step(
+                    max(0.0, t_done - t_disp),
+                    decode_rows=[(slots[b].req, 1,
+                                  len(slots[b].seq.pages))
+                                 for b in rows],
+                    prefill_rows=(self._consume_prefill_cost()
+                                  + [(st_pf.req, c, flops)]),
+                    decode_cost_s=dcost, prefill_cost_s=pcost)
 
-        for b in rows:
-            st = slots[b]
-            tok = int(nxt[b])
-            st.generated.append(tok)
-            st.kv_len += 1
-            kv_lens[b] = st.kv_len
-            last_tok[b] = tok
-            self._c_decode_tokens.inc(1)
+            for b in rows:
+                st = slots[b]
+                tok = int(nxt[b])
+                st.generated.append(tok)
+                st.kv_len += 1
+                kv_lens[b] = st.kv_len
+                last_tok[b] = tok
+                self._c_decode_tokens.inc(1)
+                if self._tr:
+                    self._tr.instant("decode_block", ts=now,
+                                     tid=self._tid(st.req),
+                                     args={"tokens": 1})
+                self._maybe_finish(b, slots, results, active, fresh,
+                                   kv_lens, last_tok)
+                if self.spec_k:
+                    self._spec_stale.add(b)
+            if is_final:
+                # the slice completed the prompt: enter decode with the
+                # first token this very step sampled (index C-1 = the
+                # last prompt token's row — the fresh-prefill sampling
+                # contract)
+                st = st_pf
+                st.phase = "decode"
+                st.t_decode_start = time.time()
+                if self._tr:
+                    self._tr.complete("prefill", st.t_admit,
+                                      st.t_decode_start,
+                                      tid=self._tid(st.req),
+                                      args={"prompt_tokens":
+                                            len(st.prompt_ids)})
+                st.kv_len = len(st.prompt_ids)
+                kv_lens[pf] = st.kv_len
+                active[pf] = True
+                self._cache_insert(st)
+                tok0 = int(nxt[pf])
+                st.generated.append(tok0)
+                self._note_first_token(st, t_enq)
+                last_tok[pf] = tok0
+                if self.spec_k:
+                    self._spec_stale.add(pf)
+                self._maybe_finish(pf, slots, results, active, fresh,
+                                   kv_lens, last_tok)
             if self._tr:
-                self._tr.instant("decode_block", ts=now,
-                                 tid=self._tid(st.req),
-                                 args={"tokens": 1})
-            self._maybe_finish(b, slots, results, active, fresh,
-                               kv_lens, last_tok)
-            if self.spec_k:
-                self._spec_stale.add(b)
-        if is_final:
-            # the slice completed the prompt: enter decode with the first
-            # token this very step sampled (index C-1 = the last prompt
-            # token's row — the fresh-prefill sampling contract)
-            st = st_pf
-            st.phase = "decode"
-            st.t_decode_start = time.time()
-            if self._tr:
-                self._tr.complete("prefill", st.t_admit,
-                                  st.t_decode_start, tid=self._tid(st.req),
-                                  args={"prompt_tokens":
-                                        len(st.prompt_ids)})
-            st.kv_len = len(st.prompt_ids)
-            kv_lens[pf] = st.kv_len
-            active[pf] = True
-            self._cache_insert(st)
-            tok0 = int(nxt[pf])
-            st.generated.append(tok0)
-            self._note_first_token(st, t_enq)
-            last_tok[pf] = tok0
-            if self.spec_k:
-                self._spec_stale.add(pf)
-            self._maybe_finish(pf, slots, results, active, fresh,
-                               kv_lens, last_tok)
-        if self._tr:
-            self._tr.complete("decode_block", now, time.time(),
-                              args={"active": len(rows),
-                                    "tokens": len(rows),
-                                    "hbm_gb": self._attr_last_gb,
-                                    "mixed": True,
-                                    "prefill_tokens": c})
-        rearm(stalled)
+                self._tr.complete("decode_block", now, time.time(),
+                                  args={"active": len(rows),
+                                        "tokens": len(rows),
+                                        "hbm_gb": self._attr_last_gb,
+                                        "mixed": True,
+                                        "prefill_tokens": c})
+            rearm(stalled)
         return True, last_block_t
 
     def _get_mixed_fn(self, t: int, w: int):
@@ -3274,16 +3366,19 @@ class ContinuousScheduler:
             rearm(stalled)
             return False, last_block_t
         if spec:
-            if self._spec_buf is None:
-                self._spec_buf = jnp.zeros((self.B, self.max_len),
-                                           jnp.int32)
-            if self._spec_stale:
-                # same lazy re-seed as _spec_decode_block: rows advanced
-                # outside the device-appended paths since the last verify
-                for b in sorted(self._spec_stale):
-                    if slots[b] is not None and slots[b].phase == "decode":
-                        self.seed_history(b, slots[b])
-                self._spec_stale.clear()
+            with self._an.seg("draft"):
+                if self._spec_buf is None:
+                    self._spec_buf = jnp.zeros((self.B, self.max_len),
+                                               jnp.int32)
+                if self._spec_stale:
+                    # same lazy re-seed as _spec_decode_block: rows
+                    # advanced outside the device-appended paths since
+                    # the last verify
+                    for b in sorted(self._spec_stale):
+                        if (slots[b] is not None
+                                and slots[b].phase == "decode"):
+                            self.seed_history(b, slots[b])
+                    self._spec_stale.clear()
 
         st_pf = slots[pf]
         pos = st_pf.prefill_pos
@@ -3336,6 +3431,9 @@ class ContinuousScheduler:
             gidx = last_of
 
         real = adv * len(rows) + c
+        # bucket economics (obs/anatomy.py): this dispatch pays for a
+        # tpb-token bucket but carries ``real`` span tokens
+        self._an.note_bucket(tpb, w, real)
         self._h_occupancy.observe(len(rows) / self.B)
         self._c_decode_dispatches.inc()
         self._h_mixed_fill.observe(real / self.mixed_token_budget)
@@ -3383,106 +3481,118 @@ class ContinuousScheduler:
                 jnp.asarray(table[:, :w]), sub, jnp.asarray(temps),
                 jnp.asarray(top_k), jnp.asarray(top_p))
 
-        try:
-            out = dispatch()
-        except Exception:
-            # the shared first-run-lowering contract: degrade only before
-            # this shape has ever run (donation happens at execution, so
-            # the args are still valid); proven shapes re-raise
-            if not self._use_ragged or key_ in self._ran_ok:
-                raise
-            logger.warning("ragged span kernel failed to lower; "
-                           "falling back to the XLA span path",
-                           exc_info=True)
-            self._invalidate_compiled()
-            out = dispatch()
+        with self._an.seg("dispatch"):
+            try:
+                out = dispatch()
+            except Exception:
+                # the shared first-run-lowering contract: degrade only
+                # before this shape has ever run (donation happens at
+                # execution, so the args are still valid); proven shapes
+                # re-raise
+                if not self._use_ragged or key_ in self._ran_ok:
+                    raise
+                logger.warning("ragged span kernel failed to lower; "
+                               "falling back to the XLA span path",
+                               exc_info=True)
+                self._invalidate_compiled()
+                out = dispatch()
+        if not warm:
+            # cold key: the dispatch call just blocked on the XLA compile
+            # — bill it to this bucket's compile economics
+            self._an.note_compile(tpb, w, time.time() - t_disp)
         self._note_ran_ok(key_)
-        if spec:
-            (emit, count, self._spec_buf, self.cache.k, self.cache.v,
-             ks, vs) = out
-            emit, count = self._timed_get((emit, count))
-            emit, count = np.asarray(emit), np.asarray(count)
-        else:
-            nxt, self.cache.k, self.cache.v, ks, vs = out
-            nxt = np.asarray(self._timed_get(nxt))
+        with self._an.seg("fetch"):
+            if spec:
+                (emit, count, self._spec_buf, self.cache.k, self.cache.v,
+                 ks, vs) = out
+                emit, count = self._timed_get((emit, count))
+                emit, count = np.asarray(emit), np.asarray(count)
+            else:
+                nxt, self.cache.k, self.cache.v, ks, vs = out
+                nxt = np.asarray(self._timed_get(nxt))
         if self._kv_quant:
             self.kscale, self.vscale = ks, vs
         t_done = time.time()
 
-        # exact-split attribution with SPAN-LEVEL token counts: the
-        # decode side of a span step is adv tokens per live row, not one
-        extra_flops, cold_pf = self._consume_prefill_attr()
-        nb = self._perf.note_mixed_step(
-            t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
-            warm=warm and not cold_pf, span_tokens=adv * len(rows))
-        self._attr_last_gb = round(nb / 1e9, 3)
-        if self._cost.enabled:
-            dcost, pcost = self._roofline_phase_costs(
-                nb, flops + extra_flops)
-            self._cost.note_step(
-                max(0.0, t_done - t_disp),
-                decode_rows=[(slots[b].req,
-                              int(count[b]) if spec else 1,
-                              len(slots[b].seq.pages)) for b in rows],
-                prefill_rows=(self._consume_prefill_cost()
-                              + [(st_pf.req, c, flops)]),
-                decode_cost_s=dcost, prefill_cost_s=pcost)
+        with self._an.seg("finish"):
+            # exact-split attribution with SPAN-LEVEL token counts: the
+            # decode side of a span step is adv tokens per live row, not
+            # one
+            extra_flops, cold_pf = self._consume_prefill_attr()
+            nb = self._perf.note_mixed_step(
+                t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
+                warm=warm and not cold_pf, span_tokens=adv * len(rows))
+            self._attr_last_gb = round(nb / 1e9, 3)
+            if self._cost.enabled:
+                dcost, pcost = self._roofline_phase_costs(
+                    nb, flops + extra_flops)
+                self._cost.note_step(
+                    max(0.0, t_done - t_disp),
+                    decode_rows=[(slots[b].req,
+                                  int(count[b]) if spec else 1,
+                                  len(slots[b].seq.pages)) for b in rows],
+                    prefill_rows=(self._consume_prefill_cost()
+                                  + [(st_pf.req, c, flops)]),
+                    decode_cost_s=dcost, prefill_cost_s=pcost)
 
-        for b in rows:
-            st = slots[b]
-            if spec:
-                cnt = int(count[b])
-                new = [int(t) for t in emit[b, :cnt]]
-                self._c_spec_accepted.inc(max(0, cnt - 1))
-                if cnt > 1:
-                    self._cost.note_saved(st.req, spec_tokens=cnt - 1)
-            else:
-                new = [int(nxt[b])]
-            st.generated.extend(new)
-            st.kv_len += len(new)
-            kv_lens[b] = st.kv_len
-            last_tok[b] = st.generated[-1] if st.generated else 0
-            self._c_decode_tokens.inc(len(new))
+            for b in rows:
+                st = slots[b]
+                if spec:
+                    cnt = int(count[b])
+                    new = [int(t) for t in emit[b, :cnt]]
+                    self._c_spec_accepted.inc(max(0, cnt - 1))
+                    if cnt > 1:
+                        self._cost.note_saved(st.req, spec_tokens=cnt - 1)
+                else:
+                    new = [int(nxt[b])]
+                st.generated.extend(new)
+                st.kv_len += len(new)
+                kv_lens[b] = st.kv_len
+                last_tok[b] = st.generated[-1] if st.generated else 0
+                self._c_decode_tokens.inc(len(new))
+                if self._tr:
+                    self._tr.instant("decode_block", ts=now,
+                                     tid=self._tid(st.req),
+                                     args={"tokens": len(new)})
+                self._maybe_finish(b, slots, results, active, fresh,
+                                   kv_lens, last_tok)
+            if is_final:
+                # the slice completed the prompt: enter decode with the
+                # first token this very step sampled at its last span
+                # position
+                st = st_pf
+                st.phase = "decode"
+                st.t_decode_start = time.time()
+                if self._tr:
+                    self._tr.complete("prefill", st.t_admit,
+                                      st.t_decode_start,
+                                      tid=self._tid(st.req),
+                                      args={"prompt_tokens":
+                                            len(st.prompt_ids)})
+                st.kv_len = len(st.prompt_ids)
+                kv_lens[pf] = st.kv_len
+                active[pf] = True
+                self._cache_insert(st)
+                tok0 = int(emit[pf, 0]) if spec else int(nxt[pf])
+                st.generated.append(tok0)
+                self._note_first_token(st, t_enq)
+                last_tok[pf] = tok0
+                if spec:
+                    # the verify graph cannot have appended pf's history
+                    # (its span was a prompt slice): seed once at the
+                    # prefill -> decode transition, like any admission
+                    with self._an.seg("draft"):
+                        self.seed_history(pf, st)
+                self._maybe_finish(pf, slots, results, active, fresh,
+                                   kv_lens, last_tok)
             if self._tr:
-                self._tr.instant("decode_block", ts=now,
-                                 tid=self._tid(st.req),
-                                 args={"tokens": len(new)})
-            self._maybe_finish(b, slots, results, active, fresh,
-                               kv_lens, last_tok)
-        if is_final:
-            # the slice completed the prompt: enter decode with the first
-            # token this very step sampled at its last span position
-            st = st_pf
-            st.phase = "decode"
-            st.t_decode_start = time.time()
-            if self._tr:
-                self._tr.complete("prefill", st.t_admit,
-                                  st.t_decode_start, tid=self._tid(st.req),
-                                  args={"prompt_tokens":
-                                        len(st.prompt_ids)})
-            st.kv_len = len(st.prompt_ids)
-            kv_lens[pf] = st.kv_len
-            active[pf] = True
-            self._cache_insert(st)
-            tok0 = int(emit[pf, 0]) if spec else int(nxt[pf])
-            st.generated.append(tok0)
-            self._note_first_token(st, t_enq)
-            last_tok[pf] = tok0
-            if spec:
-                # the verify graph cannot have appended pf's history (its
-                # span was a prompt slice): seed once at the
-                # prefill -> decode transition, like any admission
-                self.seed_history(pf, st)
-            self._maybe_finish(pf, slots, results, active, fresh,
-                               kv_lens, last_tok)
-        if self._tr:
-            self._tr.complete("decode_block", now, time.time(),
-                              args={"active": len(rows),
-                                    "tokens": adv * len(rows),
-                                    "hbm_gb": self._attr_last_gb,
-                                    "mixed": True, "rpa": True,
-                                    "prefill_tokens": c})
-        rearm(stalled)
+                self._tr.complete("decode_block", now, time.time(),
+                                  args={"active": len(rows),
+                                        "tokens": adv * len(rows),
+                                        "hbm_gb": self._attr_last_gb,
+                                        "mixed": True, "rpa": True,
+                                        "prefill_tokens": c})
+            rearm(stalled)
         return True, last_block_t
 
     # ------------------------------------------------------------- prefill
@@ -3627,28 +3737,33 @@ class ContinuousScheduler:
             if key_ not in self._ran_ok:
                 self._attr_prefill_cold = True  # compiling: no MFU sample
                 self._wd_grace_cold()
-            try:
-                fn = (self._get_prefill_fn(s_bucket, use_ring=ring) if fresh
-                      else self._get_prefill_window_fn(s_bucket, w))
-                tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
-                    fn(*args)
-            except Exception:
-                # compile-time lowering failure of the flash prefill kernel:
-                # rebuild without it and retry (cache buffers were not yet
-                # donated — donation happens at execution).  Anything after a
-                # successful run of this shape is a real error: re-raise.
-                if not self._use_flash or key_ in self._ran_ok:
-                    raise
-                logger.warning("flash prefill kernel failed to lower; "
-                               "falling back to XLA attention", exc_info=True)
-                self._use_flash = False
-                self._prefill_fns.clear()
-                self._prefill_window_fns.clear()
-                self._packed_prefill_fns.clear()
-                fn = (self._get_prefill_fn(s_bucket, use_ring=ring) if fresh
-                      else self._get_prefill_window_fn(s_bucket, w))
-                tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
-                    fn(*args)
+            with self._an.seg("dispatch"):
+                try:
+                    fn = (self._get_prefill_fn(s_bucket, use_ring=ring)
+                          if fresh
+                          else self._get_prefill_window_fn(s_bucket, w))
+                    tok0, self.cache.k, self.cache.v, \
+                        self.kscale, self.vscale = fn(*args)
+                except Exception:
+                    # compile-time lowering failure of the flash prefill
+                    # kernel: rebuild without it and retry (cache buffers
+                    # were not yet donated — donation happens at
+                    # execution).  Anything after a successful run of this
+                    # shape is a real error: re-raise.
+                    if not self._use_flash or key_ in self._ran_ok:
+                        raise
+                    logger.warning("flash prefill kernel failed to lower; "
+                                   "falling back to XLA attention",
+                                   exc_info=True)
+                    self._use_flash = False
+                    self._prefill_fns.clear()
+                    self._prefill_window_fns.clear()
+                    self._packed_prefill_fns.clear()
+                    fn = (self._get_prefill_fn(s_bucket, use_ring=ring)
+                          if fresh
+                          else self._get_prefill_window_fn(s_bucket, w))
+                    tok0, self.cache.k, self.cache.v, \
+                        self.kscale, self.vscale = fn(*args)
             self._note_ran_ok(key_)
             rows = [(b, row) for row, (b, _, _, _, is_final) in enumerate(items)
                     if is_final]
@@ -3726,21 +3841,32 @@ class ContinuousScheduler:
                 jnp.asarray(table[:, :w]), sub, jnp.asarray(temps),
                 jnp.asarray(tks), jnp.asarray(tps))
         key_ = ("rpa", tpb, w)
-        if key_ not in self._ran_ok:
+        warm = key_ in self._ran_ok
+        if not warm:
             self._attr_prefill_cold = True  # compiling: no MFU sample
             self._wd_grace_cold()
-        try:
-            tok0, self.cache.k, self.cache.v, ks, vs = \
-                self._get_rpa_fn(tpb, w)(*args)
-        except Exception:
-            if not self._use_ragged or key_ in self._ran_ok:
-                raise
-            logger.warning("ragged span kernel failed to lower; "
-                           "falling back to the XLA span path",
-                           exc_info=True)
-            self._invalidate_compiled()
-            tok0, self.cache.k, self.cache.v, ks, vs = \
-                self._get_rpa_fn(tpb, w)(*args)
+        # bucket economics: chunked-prefill spans ride the same ragged
+        # (token bucket, page window) family as the mixed step — real
+        # tokens vs the tpb pad tail is the padding-waste trade PR 16 made
+        self._an.note_bucket(tpb, w, batch_tokens)
+        t_disp = time.time()
+        with self._an.seg("dispatch"):
+            try:
+                tok0, self.cache.k, self.cache.v, ks, vs = \
+                    self._get_rpa_fn(tpb, w)(*args)
+            except Exception:
+                if not self._use_ragged or key_ in self._ran_ok:
+                    raise
+                logger.warning("ragged span kernel failed to lower; "
+                               "falling back to the XLA span path",
+                               exc_info=True)
+                self._invalidate_compiled()
+                tok0, self.cache.k, self.cache.v, ks, vs = \
+                    self._get_rpa_fn(tpb, w)(*args)
+        if not warm:
+            # cold-key dispatch wall ~= compile time (tracing + lowering
+            # block the call; execution is async)
+            self._an.note_compile(tpb, w, time.time() - t_disp)
         self._note_ran_ok(key_)
         if self._kv_quant:
             self.kscale, self.vscale = ks, vs
@@ -3832,24 +3958,28 @@ class ContinuousScheduler:
         if key_ not in self._ran_ok:
             self._attr_prefill_cold = True  # compiling: no MFU sample
             self._wd_grace_cold()
-        try:
-            tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
-                self._get_packed_prefill_fn(s_bucket)(*args)
-        except Exception:
-            # same contract as the fresh-prefill fallback: only degrade on a
-            # first-run lowering failure of the flash kernel (the packed XLA
-            # attention then serves); a failure on a proven shape re-raises
-            if not self._use_flash or key_ in self._ran_ok:
-                raise
-            logger.warning("packed flash prefill failed to lower; "
-                           "falling back to XLA packed attention",
-                           exc_info=True)
-            self._use_flash = False
-            self._prefill_fns.clear()
-            self._prefill_window_fns.clear()
-            self._packed_prefill_fns.clear()
-            tok0, self.cache.k, self.cache.v, self.kscale, self.vscale = \
-                self._get_packed_prefill_fn(s_bucket)(*args)
+        with self._an.seg("dispatch"):
+            try:
+                tok0, self.cache.k, self.cache.v, \
+                    self.kscale, self.vscale = \
+                    self._get_packed_prefill_fn(s_bucket)(*args)
+            except Exception:
+                # same contract as the fresh-prefill fallback: only
+                # degrade on a first-run lowering failure of the flash
+                # kernel (the packed XLA attention then serves); a failure
+                # on a proven shape re-raises
+                if not self._use_flash or key_ in self._ran_ok:
+                    raise
+                logger.warning("packed flash prefill failed to lower; "
+                               "falling back to XLA packed attention",
+                               exc_info=True)
+                self._use_flash = False
+                self._prefill_fns.clear()
+                self._prefill_window_fns.clear()
+                self._packed_prefill_fns.clear()
+                tok0, self.cache.k, self.cache.v, \
+                    self.kscale, self.vscale = \
+                    self._get_packed_prefill_fn(s_bucket)(*args)
         self._note_ran_ok(key_)
         return tok0, [(b, si) for si, (b, _, _) in enumerate(items)]
 
@@ -4090,41 +4220,46 @@ class ContinuousScheduler:
         if not decode_warm:
             self._wd_grace_cold()
         t_disp = time.time()
-        try:
-            out = self._get_decode_fn(w)(*args)
-        except Exception:
-            # Only degrade on a compile-time lowering failure of the ragged
-            # kernel (first call of this window shape — donation happens at
-            # execution, so args are still valid).  A failure after a shape
-            # has run successfully is a real runtime error: re-raise rather
-            # than retrying against possibly-donated buffers.
-            if not self._use_ragged or ("decode", bc, w) in self._ran_ok:
-                raise
-            logger.warning("ragged decode kernel failed to lower; "
-                           "falling back to XLA paged decode", exc_info=True)
-            self._invalidate_compiled()
-            out = self._get_decode_fn(w)(*args)
+        with self._an.seg("dispatch"):
+            try:
+                out = self._get_decode_fn(w)(*args)
+            except Exception:
+                # Only degrade on a compile-time lowering failure of the
+                # ragged kernel (first call of this window shape — donation
+                # happens at execution, so args are still valid).  A failure
+                # after a shape has run successfully is a real runtime
+                # error: re-raise rather than retrying against possibly-
+                # donated buffers.
+                if not self._use_ragged or ("decode", bc, w) in self._ran_ok:
+                    raise
+                logger.warning("ragged decode kernel failed to lower; "
+                               "falling back to XLA paged decode",
+                               exc_info=True)
+                self._invalidate_compiled()
+                out = self._get_decode_fn(w)(*args)
         self._note_ran_ok(("decode", bc, w))
         toks, n_valid, self.cache.k, self.cache.v = out
-        toks, n_valid, *tok0s = self._timed_get(  # one transfer
-            (toks, n_valid, *[t for t, _ in pending]))
+        with self._an.seg("fetch"):
+            toks, n_valid, *tok0s = self._timed_get(  # one transfer
+                (toks, n_valid, *[t for t, _ in pending]))
         toks, n_valid = np.asarray(toks), np.asarray(n_valid)
         t_done = time.time()
-        # live roofline attribution: the fetch above waited out this
-        # block's device work (plus any same-iteration prefill sequenced
-        # before it — its FLOPs are pending and charged here)
-        flops, cold_pf = self._consume_prefill_attr()
-        nb = self._perf.note_block(
-            t_disp, t_done, self.decode_block, attr_live_rows,
-            attr_live_tokens, flops,
-            warm=decode_warm and not cold_pf)
-        self._attr_last_gb = round(nb / 1e9, 3)
-        if self._cost.enabled:
-            dcost, pcost = self._roofline_phase_costs(nb, flops)
-            self._cost_step = (max(0.0, t_done - t_disp), dcost, pcost,
-                               self._consume_prefill_cost())
-        self._maybe_profile_slow_step(t_done - t_disp,
-                                      decode_warm and not cold_pf)
+        with self._an.seg("finish"):
+            # live roofline attribution: the fetch above waited out this
+            # block's device work (plus any same-iteration prefill
+            # sequenced before it — its FLOPs are pending and charged here)
+            flops, cold_pf = self._consume_prefill_attr()
+            nb = self._perf.note_block(
+                t_disp, t_done, self.decode_block, attr_live_rows,
+                attr_live_tokens, flops,
+                warm=decode_warm and not cold_pf)
+            self._attr_last_gb = round(nb / 1e9, 3)
+            if self._cost.enabled:
+                dcost, pcost = self._roofline_phase_costs(nb, flops)
+                self._cost_step = (max(0.0, t_done - t_disp), dcost, pcost,
+                                   self._consume_prefill_cost())
+            self._maybe_profile_slow_step(t_done - t_disp,
+                                          decode_warm and not cold_pf)
         if bc < B or perm is not None:
             # scatter compact and/or group-permuted results back to
             # full-width slot arrays (srows maps dispatch row -> slot;
@@ -4212,14 +4347,15 @@ class ContinuousScheduler:
         token lists.  The token-history buffer lives on device (seeded per
         row at decode admission, appended by the device inside the block) —
         no per-dispatch O(B*max_len) upload."""
-        if self._spec_stale:
-            # rows advanced by mixed steps since the last spec block:
-            # their history rows missed the in-scan appends — re-seed
-            # once per row here, at spec resumption, not per mixed step
-            for b in sorted(self._spec_stale):
-                if slots[b] is not None and slots[b].phase == "decode":
-                    self.seed_history(b, slots[b])
-            self._spec_stale.clear()
+        with self._an.seg("draft"):
+            if self._spec_stale:
+                # rows advanced by mixed steps since the last spec block:
+                # their history rows missed the in-scan appends — re-seed
+                # once per row here, at spec resumption, not per mixed step
+                for b in sorted(self._spec_stale):
+                    if slots[b] is not None and slots[b].phase == "decode":
+                        self.seed_history(b, slots[b])
+                self._spec_stale.clear()
         w, table = self._decode_window(slots,
                                        self.decode_block + self.spec_k)
         # the verify kernel passes the grouping but not the balanced
@@ -4245,36 +4381,41 @@ class ContinuousScheduler:
         if ("specfn", w) not in self._ran_ok:
             self._wd_grace_cold()
         t_disp = time.time()
-        try:
-            out = self._get_spec_decode_fn(w)(*args)
-        except Exception:
-            # same contract as the plain decode fallback: degrade only on a
-            # first-run lowering failure of the multi-verify kernel (args
-            # not yet donated); a failure on a proven shape re-raises
-            if not self._use_ragged or ("specfn", w) in self._ran_ok:
-                raise
-            logger.warning("multi-verify kernel failed to lower; "
-                           "falling back to XLA multi decode", exc_info=True)
-            self._invalidate_compiled()
-            out = self._get_spec_decode_fn(w)(*args)
+        with self._an.seg("dispatch"):
+            try:
+                out = self._get_spec_decode_fn(w)(*args)
+            except Exception:
+                # same contract as the plain decode fallback: degrade only
+                # on a first-run lowering failure of the multi-verify
+                # kernel (args not yet donated); a failure on a proven
+                # shape re-raises
+                if not self._use_ragged or ("specfn", w) in self._ran_ok:
+                    raise
+                logger.warning("multi-verify kernel failed to lower; "
+                               "falling back to XLA multi decode",
+                               exc_info=True)
+                self._invalidate_compiled()
+                out = self._get_spec_decode_fn(w)(*args)
         self._note_ran_ok(("specfn", w))
         toks, counts, self._spec_buf, self.cache.k, self.cache.v = out
-        toks, counts = self._timed_get((toks, counts))  # one transfer
+        with self._an.seg("fetch"):
+            toks, counts = self._timed_get((toks, counts))  # one transfer
         t_done = time.time()
-        # spec blocks contribute step gaps but no byte/FLOP samples (the
-        # verify-step byte model differs); pending prefill FLOPs are
-        # consumed — still counted, never sampled — so they cannot
-        # mis-attribute to a later plain block
-        self._perf.note_gap(t_disp, t_done)
-        flops, _ = self._consume_prefill_attr()
-        if flops > 0:
-            self._perf.c_flops.inc(flops)
-        self._attr_last_gb = 0.0
-        if self._cost.enabled:
-            # no byte model for the verify step: phase costs 0 degrade
-            # the ledger split to per-row token counts (documented)
-            self._cost_step = (max(0.0, t_done - t_disp), 0.0, 0.0,
-                               self._consume_prefill_cost())
+        with self._an.seg("finish"):
+            # spec blocks contribute step gaps but no byte/FLOP samples
+            # (the verify-step byte model differs); pending prefill FLOPs
+            # are consumed — still counted, never sampled — so they cannot
+            # mis-attribute to a later plain block
+            self._perf.note_gap(t_disp, t_done)
+            flops, _ = self._consume_prefill_attr()
+            if flops > 0:
+                self._perf.c_flops.inc(flops)
+            self._attr_last_gb = 0.0
+            if self._cost.enabled:
+                # no byte model for the verify step: phase costs 0 degrade
+                # the ledger split to per-row token counts (documented)
+                self._cost_step = (max(0.0, t_done - t_disp), 0.0, 0.0,
+                                   self._consume_prefill_cost())
         emitted: list[list[int]] = []
         for b in range(self.B):
             row: list[int] = []

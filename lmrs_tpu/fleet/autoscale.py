@@ -40,6 +40,7 @@ import logging
 import threading
 import time
 
+from lmrs_tpu.obs.trace import get_tracer
 from lmrs_tpu.utils.env import env_bool, env_float, env_int
 
 logger = logging.getLogger("lmrs.fleet.autoscale")
@@ -145,6 +146,7 @@ class Autoscaler:
         did (the test/observability surface)."""
         now = self.clock()
         actions: list[str] = []
+        tr = get_tracer()
         # 1. advance in-progress drains first: an idle victim completes
         #    its exit, a wedged one is force-removed at the timeout —
         #    either way the slot frees before any new decision
@@ -161,6 +163,12 @@ class Autoscaler:
                     self._c_down.inc()
                 actions.append(f"removed:{netloc}"
                                + ("" if idle else ":forced"))
+                if tr:
+                    # fleet-drift contract (trace.py): every autoscaler
+                    # resize is an auditable instant on the trace
+                    tr.instant("autoscale_action",
+                               args={"action": "removed", "host": netloc,
+                                     "forced": not idle})
         rps = self._forecast(now)
         hosts = [h for h in self.router.hosts if not h.draining]
         healthy = [h for h in hosts if h.healthy]
@@ -199,6 +207,10 @@ class Autoscaler:
                     if self._c_up is not None:
                         self._c_up.inc()
                     actions.append(f"spawned:{h.netloc}")
+                    if tr:
+                        tr.instant("autoscale_action",
+                                   args={"action": "spawned",
+                                         "host": h.netloc})
                     logger.info("autoscale UP -> %s (burning %d/%d, "
                                 "inflight %.1f/host, forecast %.2f rps)",
                                 h.netloc, burning, len(healthy),
@@ -214,6 +226,10 @@ class Autoscaler:
                     if self._c_drain is not None:
                         self._c_drain.inc()
                     actions.append(f"draining:{victim.netloc}")
+                    if tr:
+                        tr.instant("autoscale_action",
+                                   args={"action": "draining",
+                                         "host": victim.netloc})
                     logger.info("autoscale DOWN: draining %s "
                                 "(forecast %.2f rps)", victim.netloc, rps)
         return {"enabled": True, "pool": size, "healthy": len(healthy),

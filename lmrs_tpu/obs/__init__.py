@@ -6,6 +6,18 @@ metric catalog, the stitching/skew-alignment method, and how to open an
 exported trace in Perfetto.
 """
 
+from lmrs_tpu.obs.anatomy import (
+    CLASSES,
+    NULL_ANATOMY,
+    SEGMENTS,
+    NullAnatomy,
+    StepAnatomy,
+    anatomy_enabled,
+    maybe_anatomy,
+    merge_anatomy,
+    rtt_resample_s,
+    slow_step_ms,
+)
 from lmrs_tpu.obs.flight import (
     POSTMORTEM_SCHEMA,
     dump_postmortem,
@@ -59,6 +71,9 @@ from lmrs_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CLASSES", "NULL_ANATOMY", "SEGMENTS", "NullAnatomy", "StepAnatomy",
+    "anatomy_enabled", "maybe_anatomy", "merge_anatomy", "rtt_resample_s",
+    "slow_step_ms",
     "DEFAULT_LATENCY_BUCKETS_S", "MS_LATENCY_BUCKETS", "POW2_TOKEN_BUCKETS",
     "RATIO_BUCKETS",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",

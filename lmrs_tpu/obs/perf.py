@@ -63,6 +63,8 @@ class DispatchAttribution:
         self._quantized = bool(getattr(engine_cfg, "quantize", None))
         self._kv_quantized = bool(getattr(engine_cfg, "kv_quantize", None))
         self._rtt: float | None = None
+        self._rtt_t: float | None = None  # clock time of the last probe
+        self._clock = time.time  # injectable (stale-RTT regression test)
         self._hbm_util_est: float | None = None  # running clean-sample EMA
         self._last_block_end: float | None = None
         h, g, c = registry.histogram, registry.gauge, registry.counter
@@ -105,30 +107,55 @@ class DispatchAttribution:
         return chip_spec()
 
     def ensure_rtt(self) -> float:
-        """Median trivial dependent-fetch round trip, measured ONCE lazily
-        (first warm decode block; ~3 fetches).  Subtracted from every
+        """Median trivial dependent-fetch round trip, measured lazily and
+        RE-SAMPLED on a slow cadence (``LMRS_RTT_RESAMPLE_S``, default
+        300 s): a long-lived process can see its host link degrade (VPN
+        reroute, tunnel congestion) and a once-per-process sample would
+        then skew every dispatch wall it is subtracted from.  A re-probe
+        FAILURE keeps the previous sample (but refreshes the timestamp so
+        a flaky link is not hammered every call).  Subtracted from every
         dispatch wall — on a tunneled chip the RTT is ~97% of a small
         dispatch's wall and attribution without the subtraction measures
         the link, not the chip (docs/PERF.md round 5)."""
-        if self._rtt is None:
-            try:
-                import jax
-                import jax.numpy as jnp
-                import numpy as np
+        from lmrs_tpu.obs.anatomy import rtt_resample_s
 
-                x = jnp.zeros((8,), jnp.float32)
-                np.asarray(jax.device_get(x + 1))  # warm the tiny program
-                rtts = []
-                for _ in range(3):
-                    t0 = time.time()
-                    np.asarray(jax.device_get(x + 1))
-                    rtts.append(time.time() - t0)
-                self._rtt = sorted(rtts)[1]
-            except Exception:  # noqa: BLE001 - attribution must never kill
+        now = self._clock()
+        if (self._rtt is not None and self._rtt_t is not None
+                and now - self._rtt_t < rtt_resample_s()):
+            return self._rtt
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            x = jnp.zeros((8,), jnp.float32)
+            np.asarray(jax.device_get(x + 1))  # warm the tiny program
+            rtts = []
+            for _ in range(3):
+                t0 = time.time()
+                np.asarray(jax.device_get(x + 1))
+                rtts.append(time.time() - t0)
+            self._rtt = sorted(rtts)[1]
+        except Exception:  # noqa: BLE001 - attribution must never kill
+            if self._rtt is None:
                 logger.warning("RTT probe failed; attribution walls will "
                                "include the host link RTT", exc_info=True)
                 self._rtt = 0.0
+            else:
+                logger.warning("RTT re-probe failed; keeping the previous "
+                               "sample", exc_info=True)
+        self._rtt_t = now
         return self._rtt
+
+    def rtt_sample(self) -> tuple[float | None, float | None]:
+        """``(rtt_s, age_s)`` of the current sample WITHOUT probing —
+        the anatomy report's stale-RTT guard reads this so a report can
+        never trigger a device round trip, and a sample older than its
+        staleness horizon is flagged instead of silently skewing the
+        dispatch/fetch split."""
+        if self._rtt is None or self._rtt_t is None:
+            return None, None
+        return self._rtt, max(self._clock() - self._rtt_t, 0.0)
 
     def prefill_flops(self, chunk_tokens: int, kv_start: int = 0) -> float:
         """Model FLOPs of one prefill row: a fresh causal chunk

@@ -258,6 +258,9 @@ _INSTANT_REQUIRED_ARGS: dict[str, tuple[str, ...]] = {
     "job_recover": ("job",),
     "job_resume": ("job", "resumed_chunks"),
     "job_done": ("job", "status"),
+    "qos_reorder": ("picked",),
+    "qos_preempt": ("slot",),
+    "autoscale_action": ("action",),
 }
 
 # Perf-attribution (and counting) args: whenever present they must be

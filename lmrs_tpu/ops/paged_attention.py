@@ -939,6 +939,12 @@ def paged_decode_multi_xla(
 SPAN_QT = 8
 
 
+# canonical bucket edges of the ragged-span compile-key family — defined
+# jax-free in utils/perf_model so the mock engine can share them without
+# importing the kernel stack; re-exported here for kernel-side callers
+from lmrs_tpu.utils.perf_model import pow2_bucket  # noqa: E402,F401
+
+
 def pack_spans(q_lens, floor: int = 16):
     """Host-side span packer for the ragged span kernel: given per-row real
     query lengths (0 = inactive row), return ``(q_starts, total)`` where

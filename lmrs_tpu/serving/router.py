@@ -786,6 +786,52 @@ class RouterEngine:
                           "tenants": qt}
         return doc
 
+    def anatomy_report(self) -> dict:
+        """Fleet-wide ``GET /v1/anatomy``: every backend's step-anatomy
+        document pulled concurrently (same control-plane discipline as
+        ``usage_report``) and merged through ``obs.merge_anatomy`` —
+        additive totals sum exactly; per-class percentiles are
+        iteration-weighted estimates, so each host's raw document rides
+        along in ``per_host``.  Hosts that are down or anatomy-less
+        (LMRS_ANATOMY=0 there) stay visible in ``unreachable``."""
+        from lmrs_tpu.obs.anatomy import merge_anatomy
+
+        def fetch(h: _Host):
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(h.netloc, timeout=5.0)
+                conn.request("GET", "/v1/anatomy")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read())
+            except Exception as e:  # noqa: BLE001 - best-effort per host
+                logger.debug("anatomy fetch failed for %s: %s: %s",
+                             h.netloc, type(e).__name__, e)
+                return None
+            finally:
+                if conn is not None:
+                    conn.close()
+
+        futures = [(h, self._pool.submit(fetch, h)) for h in self.hosts]
+        docs: list[dict] = []
+        per_host: list[dict] = []
+        unreachable: list[str] = []
+        for h, fut in futures:
+            try:
+                doc = fut.result(timeout=10.0)
+            except Exception:  # noqa: BLE001 - pool saturation/timeout
+                doc = None
+            if not isinstance(doc, dict):
+                unreachable.append(h.netloc)
+                continue
+            docs.append(doc)
+            per_host.append({"host": h.netloc, **doc})
+        merged = merge_anatomy(docs)
+        merged.update({"fleet": True, "per_host": per_host,
+                       "unreachable": unreachable})
+        return merged
+
     # ---------------------------------------------------- fleet elasticity
 
     def add_host(self, url: str, role: str = "both") -> "_Host":

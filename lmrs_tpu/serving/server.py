@@ -713,6 +713,8 @@ class EngineHTTPServer:
                     self._send(200, payload)
                 elif self.path == "/v1/usage":
                     self._get_usage()
+                elif self.path == "/v1/anatomy":
+                    self._get_anatomy()
                 elif self.path == "/v1/trace":
                     self._get_trace()
                 elif self.path.startswith("/v1/handoff/"):
@@ -901,6 +903,34 @@ class EngineHTTPServer:
                         "message": f"usage report failed: "
                                    f"{type(e).__name__}: {e}",
                         "type": "usage_error"}})
+
+            def _get_anatomy(self) -> None:
+                """``GET /v1/anatomy``: this host's step-anatomy document
+                (or, when the engine is a router, the fleet merge —
+                RouterEngine.anatomy_report pulls every backend's page).
+                501 when the backend carries no anatomy (static
+                scheduler, or LMRS_ANATOMY=0 on this host)."""
+                hook = getattr(outer.engine, "anatomy_report", None)
+                if hook is None:
+                    self._send(501, {"error": {
+                        "message": "this engine backend has no step "
+                                   "anatomy", "type": "anatomy_error"}})
+                    return
+                try:
+                    doc = hook()
+                    if not doc.get("enabled"):
+                        self._send(501, {"error": {
+                            "message": "step anatomy is disabled "
+                                       "(LMRS_ANATOMY=0)",
+                            "type": "anatomy_error"}})
+                        return
+                    self._send(200, doc)
+                except Exception as e:  # noqa: BLE001 - marked error
+                    logger.exception("anatomy report failed")
+                    self._send(502, {"error": {
+                        "message": f"anatomy report failed: "
+                                   f"{type(e).__name__}: {e}",
+                        "type": "anatomy_error"}})
 
             # --------------------------------------- trace export / profile
 

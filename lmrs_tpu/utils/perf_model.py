@@ -26,6 +26,20 @@ _CHIP_PEAKS = {
 }
 
 
+def pow2_bucket(n: int, lo: int) -> int:
+    """Smallest power of two >= max(n, lo) — THE compile-key bucketing of
+    the ragged-span family (query-token buckets, page windows).  One
+    shared definition: the scheduler (via ops/paged_attention), the mock
+    engine, and the bucket-economics accounting (obs/anatomy.py) must
+    agree on bucket edges or the per-bucket padding-waste numbers
+    attribute to the wrong key.  Lives here (jax-free) so the mock's
+    import closure stays deviceless."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
 @dataclass
 class ChipSpec:
     kind: str

@@ -13,8 +13,14 @@ through scheduler._get_rpa_fn — instead of the legacy decode-block fn:
 the ISSUE-16 A/B is this script run with both settings; note the span
 arm dispatches one step per call where the legacy arm scans
 decode_block steps in-graph, so the intercept carries the per-dispatch
-host cost the decode-block scan amortizes).
+host cost the decode-block scan amortizes),
+LMRS_SPLIT_ANATOMY=1 (ISSUE 18: instead of the raw-dispatch sweep, run
+REAL scheduler-loop traffic through three step-class arms — plain
+decode / mixed / spec-verify — and print each class's host-segment
+p50/p95 split from the step-anatomy profiler, i.e. the 3x spec-step
+mystery as named segments; runs on CPU with a tiny model).
 """
+import json
 import time
 
 
@@ -23,11 +29,53 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from lmrs_tpu.config import EngineConfig, model_preset
+from lmrs_tpu.config import EngineConfig, ModelConfig, model_preset
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.utils.logging import setup_logging
 from lmrs_tpu.utils.perf_model import decode_step_bytes, weight_bytes
 from lmrs_tpu.utils.env import env_bool, env_int, env_str
+
+
+def anatomy_main():
+    """The LMRS_SPLIT_ANATOMY arm: host-segment p50/p95 per step class
+    through the live scheduler loop (obs/anatomy.py)."""
+    from lmrs_tpu.engine.api import GenerationRequest
+
+    setup_logging(quiet=True)
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                     dtype="float32")
+    out = {}
+    for arm, kw in (("plain", dict(mixed_batch=False)),
+                    ("mixed", dict(mixed_batch=True)),
+                    ("spec", dict(mixed_batch=False, speculate_k=4))):
+        eng = JaxEngine(EngineConfig(
+            backend="jax", scheduler="continuous", max_tokens=24,
+            max_batch_slots=4, seed=0, decode_block=4, prefill_chunk=64,
+            retry_delay=0.0, **kw), mc)
+        sched = eng._scheduler
+        reqs = [GenerationRequest(
+            prompt="anatomy probe " * (3 + 4 * (i % 3)), request_id=i,
+            temperature=0.0, max_new_tokens=12 + 4 * (i % 3))
+            for i in range(8)]
+        eng.generate_batch(reqs)  # warmup: compiles every shape
+        an0 = sched.anatomy_snapshot()
+        eng.generate_batch([mk_r for mk_r in (
+            GenerationRequest(prompt="anatomy probe " * (3 + 4 * (i % 3)),
+                              request_id=100 + i, temperature=0.0,
+                              max_new_tokens=12 + 4 * (i % 3))
+            for i in range(8))])
+        rep = sched.anatomy_report(an0)
+        assert sched.audit() == [], "anatomy conservation violated"
+        out[arm] = {
+            "host_overhead_us_step": rep.get("host_overhead_us_step"),
+            "segments_ms": rep.get("segments_ms"),
+            "classes": rep.get("classes"),
+            "buckets": rep.get("buckets"),
+            "rpa_pad_waste_ratio": rep.get("rpa_pad_waste_ratio"),
+        }
+        eng.shutdown()
+    print(json.dumps(out, indent=1))
 
 
 def main():
@@ -144,4 +192,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if env_bool("LMRS_SPLIT_ANATOMY", False):
+        anatomy_main()
+    else:
+        main()

@@ -55,6 +55,11 @@ TRACKED = {
     # the quiet tenant's protected TTFT and the QoS-on/off separation
     "quiet_ttft_p95_ms_qos_on": "down",
     "fairness_gain": "up",
+    # step-anatomy metrics (ISSUE 18, obs/anatomy.py): per-iteration host
+    # overhead between dispatches, and the ragged-span family's padding
+    # waste — both live under the bench detail's windowed "anatomy" block
+    "anatomy.host_overhead_us_step": "down",
+    "anatomy.rpa_pad_waste_ratio": "down",
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")

@@ -78,6 +78,7 @@ def run_arm(mixed: bool) -> dict:
     sched.reset_latency_stats()
     m0 = dict(sched.metrics)
     cost0 = sched._cost.report()
+    an0 = sched.anatomy_snapshot()
     t0 = time.time()
     out = eng.generate_batch([mk(1000 + i, PROMPT_WORDS)
                               for i in range(N_MEAS)])
@@ -107,6 +108,12 @@ def run_arm(mixed: bool) -> dict:
         # for each arm's latency, not just the percentiles
         "cost": sched._cost.report(cost0),
         "slo": {"state": sched.slo_report().get("state", "ok")},
+        # windowed step anatomy (ISSUE 18): host-segment split of the
+        # measured wave + per-class p50/p95 — which microseconds between
+        # dispatches each arm spends, not just how many.  Omitted (not
+        # enabled:false) under LMRS_ANATOMY=0, wire-parity rule.
+        **({"anatomy": sched.anatomy_report(an0)}
+           if sched._an.enabled else {}),
         "failed": sum(r.error is not None for r in out),
     }
     eng.shutdown()

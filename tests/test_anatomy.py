@@ -1,0 +1,552 @@
+"""Step-anatomy profiler + bucket economics (ISSUE 18, obs/anatomy.py).
+
+Four layers of coverage:
+
+* unit — ``StepAnatomy`` with an injected clock: pause semantics,
+  conservation identity, abort/discard accounting, bucket arithmetic
+  against hand-computed span lists, stale-RTT report gating, merge rules;
+* engine — real CPU JaxEngines through the live scheduler loop: plain /
+  mixed / spec / fault-armed chaos arms all end with
+  ``scheduler.audit()`` clean (the conservation identity holds through
+  dispatch faults by construction, not luck);
+* parity — the ``LMRS_ANATOMY=0`` kill switch is byte-identical (greedy
+  output, metrics_report keys) and the mock's deterministic anatomy
+  matches the scheduler's report schema exactly;
+* wire — ``GET /v1/anatomy`` serves the document, 501s when the switch
+  is off or the backend has no hook, and the router's fleet merge rides
+  the same endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.engine.mock import MockEngine
+from lmrs_tpu.obs.anatomy import (CLASSES, SEGMENTS, StepAnatomy,
+                                  merge_anatomy)
+from lmrs_tpu.obs.metrics import MetricsRegistry
+
+
+def tiny_model() -> ModelConfig:
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+def _cfg(**kw) -> EngineConfig:
+    base = dict(backend="jax", scheduler="continuous", max_tokens=32,
+                max_batch_slots=2, seed=0, decode_block=4, page_size=16,
+                num_pages=24, retry_delay=0.0)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _reqs(n: int = 3, start: int = 0, budget: int = 8):
+    return [GenerationRequest(prompt=f"anatomy probe {start + i} alpha "
+                                     "bravo charlie",
+                              request_id=start + i, temperature=0.0,
+                              max_new_tokens=budget) for i in range(n)]
+
+
+# ------------------------------------------------------------------ unit
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture
+def an():
+    clock = FakeClock()
+    a = StepAnatomy(MetricsRegistry(), clock=clock)
+    a.clock = clock  # test-side handle
+    return a
+
+
+def test_seg_pause_semantics_and_conservation(an):
+    """Entering an inner segment pauses the outer one: elapsed time lands
+    in exactly one segment, the explicit residual covers the rest, and
+    wall == segments + residual EXACTLY on the fake clock."""
+    c = an.clock
+    an.iter_begin()
+    c.tick(0.010)                      # residual (outside any segment)
+    with an.seg("plan"):
+        c.tick(0.020)                  # plan
+        with an.seg("dispatch"):
+            c.tick(0.030)              # dispatch — plan is paused
+        c.tick(0.005)                  # plan resumes
+    an.iter_end("plain")
+    assert an.audit() == []
+    rep = an.report()
+    assert rep["iterations"] == 1
+    assert rep["segments_ms"]["plan"] == pytest.approx(25.0)
+    assert rep["segments_ms"]["dispatch"] == pytest.approx(30.0)
+    assert rep["residual_ms"] == pytest.approx(10.0)
+    assert rep["wall_ms"] == pytest.approx(65.0)
+    # host overhead excludes dispatch+fetch: 65 - 30 = 35 ms = 35000 µs
+    assert rep["host_overhead_us_step"] == pytest.approx(35000.0)
+    p50 = rep["classes"]["plain"]["p50_us"]
+    assert p50["plan"] == pytest.approx(25000.0)
+    assert p50["wall"] == pytest.approx(65000.0)
+
+
+def test_abort_discards_and_discard_counts_nothing(an):
+    c = an.clock
+    an.iter_begin()
+    with an.seg("dispatch"):
+        c.tick(0.5)
+    an.iter_abort()                    # fault unwind: contributes nothing
+    an.iter_begin()
+    c.tick(0.1)
+    an.iter_discard()                  # run-exit pass: not even "aborted"
+    rep = an.report()
+    assert rep["iterations"] == 0
+    assert rep["aborted_iterations"] == 1
+    assert rep["wall_ms"] == 0.0
+    assert rep["segments_ms"]["dispatch"] == 0.0
+    assert an.audit() == []
+
+
+def test_unknown_segment_rejected(an):
+    with pytest.raises(ValueError):
+        an.seg("warp")
+
+
+def test_audit_detects_broken_conservation(an):
+    """The auditor must be PROVEN able to fail (same discipline as the
+    page auditor's negative cases): corrupting a segment total breaks the
+    wall == segments + residual identity."""
+    c = an.clock
+    an.iter_begin()
+    with an.seg("fetch"):
+        c.tick(0.010)
+    an.iter_end("plain")
+    assert an.audit() == []
+    an._segs["fetch"] += 1.0
+    assert any("conservation" in v for v in an.audit())
+    an._segs["fetch"] -= 1.0
+    assert an.audit() == []
+
+
+def test_bucket_economics_hand_computed(an):
+    """Bucket counters vs a hand-computed span list: three dispatches on
+    bucket (32, 4) carrying 20/32/7 real tokens -> 59 real, 37 padded,
+    real + padded == dispatches * 32, pad_waste 37/96."""
+    for real in (20, 32, 7):
+        an.note_bucket(32, 4, real)
+    an.note_compile(32, 4, 0.25)
+    an.note_bucket(64, 8, 50)
+    assert an.audit() == []
+    rep = an.report()
+    b = rep["buckets"]["32x4"]
+    assert b["dispatches"] == 3
+    assert b["real_tokens"] == 59
+    assert b["padded_tokens"] == 37
+    assert b["pad_waste"] == pytest.approx(37 / 96, abs=1e-4)
+    assert b["compile_ms"] == pytest.approx(250.0)
+    assert rep["buckets"]["64x8"]["padded_tokens"] == 14
+    # overall ratio spans both buckets: (37+14) / (96+64)
+    assert rep["rpa_pad_waste_ratio"] == pytest.approx(51 / 160, abs=1e-4)
+    # negative case: a corrupted count is a conservation violation
+    an._buckets[(32, 4)]["real"] += 1
+    assert any("bucket 32x4" in v for v in an.audit())
+    an._buckets[(32, 4)]["real"] -= 1
+    assert an.audit() == []
+
+
+def test_report_stale_rtt_guard(an):
+    """Satellite 3: a fresh RTT sample yields the device-wait split; a
+    STALE one (older than 2x the resample cadence) is flagged and the
+    split is withheld rather than skewed."""
+    c = an.clock
+    an.iter_begin()
+    with an.seg("fetch"):
+        c.tick(0.010)
+    an.iter_end("plain")
+    fresh = an.report(rtt=(0.002, 1.0))
+    assert fresh["rtt_ms"] == pytest.approx(2.0)
+    assert fresh["rtt_stale"] is False
+    # fetch 10 ms minus one 2 ms RTT -> 8 ms of true device wait
+    assert fresh["device_wait_us_step"] == pytest.approx(8000.0)
+    stale = an.report(rtt=(0.002, 100000.0))
+    assert stale["rtt_stale"] is True
+    assert "device_wait_us_step" not in stale
+    none = an.report(rtt=(None, None))
+    assert "rtt_ms" not in none and "device_wait_us_step" not in none
+
+
+def test_ensure_rtt_resamples_on_slow_cadence(monkeypatch):
+    """Satellite 3 regression (injected clock): within the cadence the
+    cached sample is returned untouched; past it the probe re-runs and
+    refreshes the timestamp, so a long-lived process tracks link drift."""
+    from lmrs_tpu.obs.perf import DispatchAttribution
+
+    da = DispatchAttribution(tiny_model(), EngineConfig(backend="jax"),
+                             MetricsRegistry())
+    clock = FakeClock()
+    da._clock = clock
+    monkeypatch.setenv("LMRS_RTT_RESAMPLE_S", "100")
+    da._rtt, da._rtt_t = 0.5, clock.t  # implausible cached sample
+    clock.tick(99.0)
+    assert da.ensure_rtt() == 0.5      # inside the cadence: no probe
+    assert da._rtt_t == pytest.approx(1000.0)
+    clock.tick(2.0)                    # past the cadence: re-probe
+    rtt = da.ensure_rtt()
+    assert rtt != 0.5                  # a real CPU probe is far below 0.5 s
+    assert da._rtt_t == pytest.approx(clock.t)
+    sample, age = da.rtt_sample()
+    assert sample == rtt and age == 0.0
+
+
+def test_merge_anatomy_sums_and_disabled_shape():
+    a = {"object": "anatomy", "enabled": True, "iterations": 4,
+         "aborted_iterations": 1, "wall_ms": 10.0, "residual_ms": 1.0,
+         "segments_ms": {s: 1.0 for s in SEGMENTS},
+         "host_overhead_us_step": 100.0,
+         "classes": {"plain": {"iterations": 4,
+                               "p50_us": {"wall": 100.0},
+                               "p95_us": {"wall": 200.0}}},
+         "buckets": {"32x4": {"dispatches": 2, "real_tokens": 40,
+                              "padded_tokens": 24, "pad_waste": 0.375,
+                              "compile_ms": 5.0}},
+         "rpa_pad_waste_ratio": 0.375}
+    b = dict(a, iterations=12, host_overhead_us_step=200.0,
+             classes={"plain": {"iterations": 12,
+                                "p50_us": {"wall": 300.0},
+                                "p95_us": {"wall": 400.0}}})
+    merged = merge_anatomy([a, b, {"object": "anatomy", "enabled": False}])
+    assert merged["enabled"] is True
+    assert merged["iterations"] == 16
+    assert merged["aborted_iterations"] == 2
+    assert merged["wall_ms"] == pytest.approx(20.0)
+    assert merged["segments_ms"]["dispatch"] == pytest.approx(2.0)
+    # iteration-weighted means: (100*4 + 200*12) / 16 = 175
+    assert merged["host_overhead_us_step"] == pytest.approx(175.0)
+    assert merged["classes"]["plain"]["p50_us"]["wall"] == pytest.approx(
+        (100.0 * 4 + 300.0 * 12) / 16)
+    mb = merged["buckets"]["32x4"]
+    assert mb["dispatches"] == 4 and mb["padded_tokens"] == 48
+    assert mb["pad_waste"] == pytest.approx(0.375)
+    assert merge_anatomy([]) == {"object": "anatomy", "enabled": False}
+    assert merge_anatomy([{"enabled": False}])["enabled"] is False
+
+
+# ------------------------------------------------------ engine (CPU jax)
+
+
+@pytest.fixture(scope="module")
+def mixed_engine():
+    eng = JaxEngine(_cfg(mixed_batch=True), tiny_model())
+    yield eng
+    eng.shutdown()
+
+
+def test_jax_plain_and_mixed_arms_conserve(mixed_engine):
+    """Real scheduler-loop traffic: the conservation identity holds, the
+    report carries per-class percentiles, and every ragged-span bucket's
+    token counts reconcile against its dispatch count."""
+    sched = mixed_engine._scheduler
+    an0 = sched.anatomy_snapshot()
+    out = mixed_engine.generate_batch(_reqs(3))
+    assert all(r.error is None for r in out)
+    assert sched.audit() == []
+    rep = sched.anatomy_report(an0)
+    assert rep["enabled"] and rep["iterations"] > 0
+    assert rep["wall_ms"] > 0.0
+    assert set(rep["segments_ms"]) == set(SEGMENTS)
+    assert set(rep["classes"]) <= set(CLASSES)
+    assert rep["host_overhead_us_step"] > 0.0
+    for cls_rep in rep["classes"].values():
+        assert cls_rep["p95_us"]["wall"] >= cls_rep["p50_us"]["wall"]
+    for key, b in rep["buckets"].items():
+        tpb = int(key.split("x")[0])
+        assert (b["real_tokens"] + b["padded_tokens"]
+                == b["dispatches"] * tpb), key
+        assert 0.0 <= b["pad_waste"] < 1.0
+    # the anatomy block rides metrics_report under the same key
+    assert sched.metrics_report()["anatomy"]["enabled"] is True
+
+
+def test_jax_spec_arm_reports_nonzero_draft():
+    """The spec-verify arm: draft plumbing (seed_history, reseeds) is a
+    named segment and must be nonzero — the 3x spec-step mystery's
+    attribution target (acceptance criterion)."""
+    eng = JaxEngine(_cfg(speculate_k=4), tiny_model())
+    try:
+        sched = eng._scheduler
+        out = eng.generate_batch(_reqs(2, budget=8))
+        assert all(r.error is None for r in out)
+        assert sched.audit() == []
+        rep = sched.anatomy_report()
+        assert "spec" in rep["classes"]
+        assert rep["segments_ms"]["draft"] > 0.0
+    finally:
+        eng.shutdown()
+
+
+def test_jax_fault_armed_chaos_arm_conserves(mixed_engine):
+    """A dispatch fault kills an iteration mid-segment: the open record is
+    DISCARDED (iter_abort), so wall == segments + residual still
+    reconciles in scheduler.audit() and the abort shows up as an aborted
+    iteration, never as skew."""
+    from lmrs_tpu.engine.executor import MapExecutor
+    from lmrs_tpu.testing import faults
+    from lmrs_tpu.testing.faults import FaultPlan
+
+    sched = mixed_engine._scheduler
+    an0 = sched.anatomy_snapshot()
+    ex = MapExecutor(mixed_engine, EngineConfig(retry_attempts=3,
+                                                retry_delay=0.01))
+    with faults.injected(FaultPlan(seed=13, faults=[
+            {"site": "scheduler.step", "at": [3], "max_fires": 1}])):
+        out = ex.run_requests(_reqs(3, start=50))
+    assert all(r.finish_reason is not None for r in out)
+    assert sched.audit() == []
+    rep = sched.anatomy_report(an0)
+    assert rep["aborted_iterations"] >= 1
+    assert rep["iterations"] > 0
+
+
+def test_slow_step_postmortem_schema(mixed_engine, monkeypatch, tmp_path):
+    """LMRS_ANATOMY_SLOW_MS armed at a hair-trigger threshold: every
+    iteration files a schema-valid slow_step postmortem whose extra block
+    carries the full segment split of the offending step."""
+    from lmrs_tpu.obs import validate_postmortem_file
+
+    monkeypatch.setenv("LMRS_POSTMORTEM_DIR", str(tmp_path))
+    monkeypatch.setenv("LMRS_POSTMORTEM_MIN_S", "0")
+    monkeypatch.setenv("LMRS_ANATOMY_SLOW_MS", "0.0001")
+    mixed_engine.generate_batch(_reqs(1, start=70))
+    dumps = sorted(tmp_path.glob("postmortem-slow_step-*.json"))
+    assert dumps, "hair-trigger threshold produced no slow_step postmortem"
+    doc = validate_postmortem_file(dumps[0])
+    assert doc["reason"] == "slow_step"
+    an = doc["extra"]["anatomy"]
+    assert an["class"] in CLASSES
+    assert an["wall_ms"] > an["threshold_ms"] == 0.0001
+    assert set(an["segments_ms"]) == set(SEGMENTS)
+    assert "residual_ms" in an
+    # wall reconciles against the dumped split too (rounded to µs)
+    assert an["wall_ms"] == pytest.approx(
+        sum(an["segments_ms"].values()) + an["residual_ms"], abs=0.05)
+
+
+def test_slow_step_disabled_by_default(mixed_engine, monkeypatch,
+                                       tmp_path):
+    monkeypatch.setenv("LMRS_POSTMORTEM_DIR", str(tmp_path))
+    monkeypatch.delenv("LMRS_ANATOMY_SLOW_MS", raising=False)
+    mixed_engine.generate_batch(_reqs(1, start=80))
+    assert not list(tmp_path.glob("postmortem-slow_step-*.json"))
+
+
+def test_scheduler_report_flags_stale_rtt(mixed_engine):
+    """The scheduler's report wires the perf RTT sample through the stale
+    guard: an aged sample is flagged, never subtracted."""
+    sched = mixed_engine._scheduler
+    clock = FakeClock()
+    perf = sched._perf
+    old = (perf._rtt, perf._rtt_t, perf._clock)
+    try:
+        perf._clock = clock
+        perf._rtt, perf._rtt_t = 0.001, clock.t
+        rep = sched.anatomy_report()
+        assert rep["rtt_stale"] is False
+        clock.tick(10_000.0)           # far past 2x the 300 s cadence
+        rep = sched.anatomy_report()
+        assert rep["rtt_stale"] is True
+        assert "device_wait_us_step" not in rep
+    finally:
+        perf._rtt, perf._rtt_t, perf._clock = old
+
+
+# -------------------------------------------------- kill-switch parity
+
+
+def test_kill_switch_byte_parity(monkeypatch):
+    """LMRS_ANATOMY=0 must be byte-identical: same greedy text, and
+    metrics_report's key set is EXACTLY the on-report's minus "anatomy"
+    (the pre-anatomy shape restored, nothing else disturbed)."""
+    def run(off: bool):
+        if off:
+            monkeypatch.setenv("LMRS_ANATOMY", "0")
+        else:
+            monkeypatch.delenv("LMRS_ANATOMY", raising=False)
+        eng = JaxEngine(_cfg(mixed_batch=True), tiny_model())
+        try:
+            out = eng.generate_batch(_reqs(2))
+            rep = eng._scheduler.metrics_report()
+            assert eng._scheduler.audit() == []
+            return [(r.text, r.finish_reason) for r in out], rep
+        finally:
+            eng.shutdown()
+
+    on_out, on_rep = run(off=False)
+    off_out, off_rep = run(off=True)
+    assert off_out == on_out
+    assert "anatomy" not in off_rep
+    assert set(off_rep) == set(on_rep) - {"anatomy"}
+
+
+def test_mock_kill_switch_parity(monkeypatch):
+    """The mock reads the switch live: identical results either way, no
+    anatomy key in engine_metrics when off."""
+    def run():
+        eng = MockEngine(seed=0, mixed_batch=True)
+        out = eng.generate_batch(_reqs(4, budget=12))
+        return ([(r.text, r.completion_tokens, r.finish_reason)
+                 for r in out], eng.engine_metrics())
+
+    monkeypatch.delenv("LMRS_ANATOMY", raising=False)
+    on_out, on_metrics = run()
+    assert on_metrics["anatomy"]["enabled"] is True
+    monkeypatch.setenv("LMRS_ANATOMY", "0")
+    off_out, off_metrics = run()
+    assert off_out == on_out
+    assert "anatomy" not in off_metrics
+    assert set(off_metrics) == set(on_metrics) - {"anatomy"}
+
+
+# ----------------------------------------------------------- mock parity
+
+
+def test_mock_anatomy_is_deterministic_and_schema_matched():
+    """Two mock runs over identical traffic produce byte-identical
+    anatomy documents (token-count-derived, never wall clocks), with the
+    scheduler report's exact top-level schema and residual 0."""
+    def doc():
+        eng = MockEngine(seed=0, mixed_batch=True)
+        eng.generate_batch(_reqs(4, budget=12))
+        return eng.anatomy_report()
+
+    a, b = doc(), doc()
+    assert a == b
+    assert a["residual_ms"] == 0.0
+    assert a["iterations"] > 0
+    # schema parity with the scheduler's report (the rtt keys are
+    # optional extras the scheduler adds when a sample exists)
+    want = {"object", "enabled", "iterations", "aborted_iterations",
+            "wall_ms", "residual_ms", "segments_ms",
+            "host_overhead_us_step", "classes", "buckets",
+            "rpa_pad_waste_ratio"}
+    assert set(a) == want
+    # residual-0 construction: wall is exactly the segment sum
+    assert a["wall_ms"] == pytest.approx(sum(a["segments_ms"].values()),
+                                         abs=1e-6)
+    for cls_rep in a["classes"].values():
+        assert set(cls_rep) == {"iterations", "p50_us", "p95_us"}
+
+
+def test_mock_bucket_math_hand_computed():
+    """The emulated bucket note against hand arithmetic: 20 real tokens
+    in a 32-token bucket -> 1 page -> window 4; padded 12; first sight
+    charges the deterministic emulated compile (32 tokens * 1 µs)."""
+    eng = MockEngine(seed=0, mixed_batch=True)
+    eng._note_rpa_bucket(32, 20)
+    eng._note_rpa_bucket(32, 30)
+    rep = eng.anatomy_report()
+    b = rep["buckets"]["32x4"]
+    assert b["dispatches"] == 2
+    assert b["real_tokens"] == 50
+    assert b["padded_tokens"] == 14
+    assert b["real_tokens"] + b["padded_tokens"] == 2 * 32
+    assert b["pad_waste"] == pytest.approx(14 / 64, abs=1e-4)
+    # first sight charged 32 µs of emulated compile exactly once (the
+    # report's ms column rounds that to 0.0 at its 0.1 ms precision)
+    assert eng._an_buckets[(32, 4)]["compile_s"] == pytest.approx(32e-6)
+    assert b["compile_ms"] == 0.0
+    assert rep["rpa_pad_waste_ratio"] == pytest.approx(14 / 64, abs=1e-4)
+
+
+# ------------------------------------------------------------------ wire
+
+
+def _get_json(host: str, port: int, path: str):
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_v1_anatomy_endpoint_and_501s(monkeypatch):
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    eng = MockEngine(seed=0, mixed_batch=True)
+    srv = EngineHTTPServer(eng, port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        eng.generate_batch(_reqs(2, budget=8))
+        status, doc = _get_json(srv.host, srv.port, "/v1/anatomy")
+        assert status == 200
+        assert doc["object"] == "anatomy" and doc["enabled"] is True
+        assert doc["iterations"] > 0
+        # switch off live: the endpoint refuses rather than serving an
+        # empty shell (explicit 501, typed error)
+        monkeypatch.setenv("LMRS_ANATOMY", "0")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/v1/anatomy", timeout=10)
+        assert ei.value.code == 501
+        err = json.loads(ei.value.read())
+        assert err["error"]["type"] == "anatomy_error"
+    finally:
+        srv.shutdown()
+
+
+def test_v1_anatomy_501_without_hook():
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    class Bare:
+        def generate_batch(self, requests, on_tokens=None):
+            return []
+
+        def shutdown(self):
+            pass
+
+    srv = EngineHTTPServer(Bare(), port=0, batch_window_s=0.01)
+    srv.start_background()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/v1/anatomy", timeout=10)
+        assert ei.value.code == 501
+    finally:
+        srv.shutdown()
+
+
+def test_router_fleet_anatomy_merge():
+    """The router pulls every backend's /v1/anatomy page and serves the
+    merged view with per-host raw documents alongside."""
+    from lmrs_tpu.serving.router import RouterEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    eng = MockEngine(seed=0, mixed_batch=True)
+    srv = EngineHTTPServer(eng, port=0, batch_window_s=0.01)
+    srv.start_background()
+    router = RouterEngine([f"127.0.0.1:{srv.port}"])
+    try:
+        router.generate_batch(_reqs(2, budget=8))
+        doc = router.anatomy_report()
+        assert doc["enabled"] is True and doc["fleet"] is True
+        assert doc["iterations"] > 0
+        assert len(doc["per_host"]) == 1
+        assert doc["per_host"][0]["host"] == f"127.0.0.1:{srv.port}"
+        assert doc["unreachable"] == []
+        # the merged totals equal the single host's (one-backend fleet)
+        assert doc["iterations"] == doc["per_host"][0]["iterations"]
+    finally:
+        router.shutdown()
+        srv.shutdown()
