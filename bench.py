@@ -432,6 +432,11 @@ def _scheduler_window(sched, before: dict) -> dict:
         # distinct program shapes compiled over the window — the roofline
         # column perf_sentry tracks for the one-bucket-family collapse
         "rpa": sched._rpa_report(before),
+        # tree speculation over the timed window (ISSUE 19): dispatches,
+        # drafted nodes, and accepted tokens per dispatched row — the
+        # acceptance trajectory perf_sentry tracks (spec_tree.accept_
+        # per_step); zeros when speculate_k=0 or LMRS_SPEC_TREE=0
+        "spec_tree": sched._spec_tree_report(before),
         # disaggregated handoff over the timed window: export/import
         # counts and orphaned pages are zero on a colocated bench by
         # construction — the block exists so MULTICHIP_* rounds that run

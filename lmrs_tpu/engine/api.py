@@ -94,6 +94,17 @@ class GenerationRequest:
     # that predates the label.  Stamping only happens while LMRS_QOS is
     # armed, so the kill switch keeps the wire byte-identical.
     qos_class: str | None = None
+    # Cross-refresh draft hint (ops/speculative.py tree drafting): text
+    # whose tokens are LIKELY to recur in this request's completion — a
+    # live session passes the previous refresh's summary, which is a
+    # near-perfect draft source for the next refresh's continuation.
+    # Engines with tree speculation armed seed it AHEAD of the token
+    # history in the device draft buffer (scheduler.seed_history), so
+    # the n-gram lookup proposes continuations out of it from the first
+    # decode step.  Purely advisory: it never affects outputs (the
+    # exact-distribution verify guarantees that), only acceptance rate,
+    # and engines without speculation ignore it.
+    draft_hint: str | None = None
 
 
 def preamble_text(system_prompt: str | None, prompt: str,
@@ -289,9 +300,15 @@ class TenantStampEngine:
 
     def __init__(self, engine: "Engine", tenant: str | None,
                  publish=None, seed: dict | None = None,
-                 qos_class: str | None = None):
+                 qos_class: str | None = None,
+                 draft_hint: str | None = None):
         self._engine = engine
         self.tenant = tenant
+        # cross-refresh draft hint (tree speculation): stamped onto every
+        # request that carries none — how a live session threads its
+        # previous summary to the drafting buffer without touching the
+        # pipeline
+        self.draft_hint = draft_hint or None
         # priority-class stamp (fleet/qos.py): jobs pass "batch", live
         # sessions "interactive"; only applied while LMRS_QOS is armed
         # (the kill switch must keep the wire byte-identical), and never
@@ -348,6 +365,8 @@ class TenantStampEngine:
                 req.tenant = self.tenant
             if self.qos_class and req.qos_class is None:
                 req.qos_class = self.qos_class
+            if self.draft_hint and req.draft_hint is None:
+                req.draft_hint = self.draft_hint
 
     def __getattr__(self, name: str):
         return getattr(self._engine, name)
@@ -396,7 +415,8 @@ def make_engine(
                           mixed_token_budget=engine_cfg.mixed_token_budget,
                           prefix_cache=engine_cfg.prefix_cache,
                           host_kv=engine_cfg.host_kv,
-                          host_kv_gb=engine_cfg.host_kv_gb)
+                          host_kv_gb=engine_cfg.host_kv_gb,
+                          speculate_k=engine_cfg.speculate_k)
     if engine_cfg.backend == "jax":
         from lmrs_tpu.config import ModelConfig, model_preset
 

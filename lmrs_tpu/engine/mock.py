@@ -74,8 +74,9 @@ class MockEngine:
                  cost_ledger: bool | None = None,
                  slo: bool | None = None,
                  slots: int = 0,
-                 qos: bool | None = None):
-        from lmrs_tpu.utils.env import env_bool
+                 qos: bool | None = None,
+                 speculate_k: int = 0):
+        from lmrs_tpu.utils.env import env_bool, env_int
 
         self.seed = seed
         self.latency_s = latency_s
@@ -135,6 +136,34 @@ class MockEngine:
         self._rpa_span_tokens = 0      # guarded-by: _mixed_lock
         self._rpa_dispatches = 0       # guarded-by: _mixed_lock
         self._rpa_shapes: set = set()  # guarded-by: _mixed_lock
+        # Tree-speculation parity (the scheduler's spec-tree surface on
+        # the no-device arm): same gate composition (speculate_k arms,
+        # LMRS_SPEC_TREE=0 disarms, width clamped so the ancestor
+        # bitmask capacity 1 + k*(W+1) fits in 32 bits) and the same
+        # report block keys, deterministically emulated — a request
+        # carrying a draft hint "accepts" full depth (the cross-refresh
+        # hint restating itself), one without accepts half, so deviceless
+        # CI can assert both the knob surface and the hint plumbing
+        # end-to-end.  Text is untouched (advisory by contract).
+        self.spec_k = max(0, int(speculate_k))
+        self.spec_width = env_int("LMRS_SPEC_TREE_WIDTH", 2, lo=1, hi=8)
+        while (self.spec_width > 1
+               and 1 + self.spec_k * (self.spec_width + 1) > 32):
+            self.spec_width -= 1
+        self.spec_tree = (self.spec_k > 0 and self.rpa
+                          and 1 + self.spec_k * (self.spec_width + 1) <= 32
+                          and env_bool("LMRS_SPEC_TREE", True))
+        self.spec_adaptive = (self.spec_tree
+                              and env_bool("LMRS_SPEC_ADAPTIVE", True))
+        self._spec_dispatches = 0     # guarded-by: _mixed_lock
+        self._spec_rows = 0           # guarded-by: _mixed_lock
+        self._spec_nodes_sum = 0      # guarded-by: _mixed_lock
+        self._spec_depth_sum = 0      # guarded-by: _mixed_lock
+        self._spec_accepted = 0       # guarded-by: _mixed_lock
+        # draft hints seen by generated requests, in generation order —
+        # the test hook for cross-refresh drafting (tests assert the live
+        # layer's previous-summary hint actually reached the engine)
+        self.draft_hints: list[str] = []
         # Step-anatomy parity (obs/anatomy.py): the same report shape the
         # scheduler's profiler exposes, deterministically emulated — every
         # segment derives from token counts at EMU_SECONDS_PER_TOKEN,
@@ -269,13 +298,27 @@ class MockEngine:
         def _one_admitted(req: GenerationRequest) -> GenerationResult:
             tr = get_tracer()
             t0 = time.time()
+            if req.draft_hint is not None:
+                # recorded regardless of the spec arm: the hint is
+                # advisory plumbing, and tests assert it arrived even on
+                # engines that ignore it
+                self.draft_hints.append(req.draft_hint)
             res = self._one(req)
             self._bill(req, res)
-            # one emulated "plain" scheduler iteration per request:
-            # dispatch carries the prompt, fetch the completion
-            self._note_anatomy("plain",
-                               dispatch_tokens=res.prompt_tokens,
-                               fetch_tokens=res.completion_tokens)
+            if self.spec_tree and res.completion_tokens:
+                # tree-spec arm: the plain iteration carries the prompt
+                # only; emulated spec steps carry the decoded tokens (no
+                # double-counted fetch)
+                self._note_anatomy("plain",
+                                   dispatch_tokens=res.prompt_tokens,
+                                   fetch_tokens=0)
+                self._note_spec(req, res.completion_tokens)
+            else:
+                # one emulated "plain" scheduler iteration per request:
+                # dispatch carries the prompt, fetch the completion
+                self._note_anatomy("plain",
+                                   dispatch_tokens=res.prompt_tokens,
+                                   fetch_tokens=res.completion_tokens)
             self.slo.observe_ttft(time.time() - t0)
             self.slo.note_result(res.finish_reason, res.completion_tokens,
                                  res.error)
@@ -341,6 +384,35 @@ class MockEngine:
                                        dispatch_tokens=n_decode + c,
                                        fetch_tokens=n_decode)
                     remaining -= c
+
+    def _note_spec(self, req: GenerationRequest,
+                   completion_tokens: int) -> None:
+        """Deterministic tree-speculation accounting for one generated
+        request (no output effect; see __init__).  The emulated verify
+        accepts full chain depth when the request carries a draft hint
+        (cross-refresh: the previous summary restating itself) and half
+        depth otherwise, so each step emits ``1 + acc`` tokens; step
+        count, node count (1 + W*k drafted per row) and accepted depth
+        all derive from token counts only — byte-reproducible across
+        arms and hosts."""
+        k, width = self.spec_k, self.spec_width
+        acc = k if req.draft_hint else max(1, k // 2)
+        steps = -(-completion_tokens // (1 + acc))
+        with self._mixed_lock:
+            self._spec_dispatches += steps
+            self._spec_rows += steps
+            self._spec_nodes_sum += steps * (1 + width * k)
+            self._spec_depth_sum += steps * acc
+            self._spec_accepted += steps * acc
+        # each emulated spec step is one "spec" iteration: dispatch
+        # carries the full tree span, fetch the emitted tokens; drafting
+        # is fused on-device, so the draft segment stays dispatch-only
+        # (zero host time) — exactly the anatomy shift the real tree
+        # path exists to produce
+        for _ in range(steps):
+            self._note_anatomy("spec",
+                               dispatch_tokens=1 + width * k,
+                               fetch_tokens=1 + acc)
 
     def _note_anatomy(self, cls: str, *, dispatch_tokens: int,
                       fetch_tokens: int) -> None:
@@ -619,6 +691,23 @@ class MockEngine:
                 "dispatches": rd,
                 "span_tokens": rt,
                 "compile_shapes": rs,
+            }
+        with self._mixed_lock:
+            sd, sr, sn, sdep, sacc = (
+                self._spec_dispatches, self._spec_rows,
+                self._spec_nodes_sum, self._spec_depth_sum,
+                self._spec_accepted)
+        if sd:
+            # same keys as the scheduler's _spec_tree_report block
+            out["spec_accepted_tokens"] = sacc
+            out["spec_tree"] = {
+                "enabled": self.spec_tree,
+                "width": self.spec_width,
+                "adaptive": self.spec_adaptive,
+                "dispatches": sd,
+                "mean_nodes": round(sn / sr, 3) if sr else 0.0,
+                "mean_accept_depth": round(sdep / sr, 3) if sr else 0.0,
+                "accept_per_step": round(sacc / sr, 3) if sr else 0.0,
             }
         with self._prefix_lock:
             if self._prefix_queries:

@@ -91,6 +91,19 @@ class _SlotState:
     # accounting and ``prior`` the tokens generated before the preemption.
     n_prompt: int = 0
     prior: list[int] = field(default_factory=list)
+    # tree speculation (ISSUE 19): accepted tokens of a NON-FIRST chain
+    # sit at that chain's span-offset KV columns, so the row's next span
+    # re-sends them as leading "healing" query tokens (base = kv_len -
+    # len(spec_heal)) to rewrite K/V at their true columns; ``spec_ema``
+    # is the windowed acceptance rate feeding the adaptive depth ramp,
+    # ``spec_hoff`` the history-buffer offset of a cross-refresh draft
+    # hint seeded ahead of the prompt, ``spec_hint`` its token ids.
+    spec_heal: list[int] = field(default_factory=list)
+    spec_ema: float = 0.5
+    spec_depth: int = 0
+    spec_probe: int = 0  # steps spent at depth 0 (periodic re-probe timer)
+    spec_hoff: int = 0
+    spec_hint: list[int] = field(default_factory=list)
 
 
 class ContinuousScheduler:
@@ -279,6 +292,34 @@ class ContinuousScheduler:
                        and not self._use_ring)
         self.mixed_token_budget = max(32, engine_cfg.mixed_token_budget)
         self._mixed_fns: dict[tuple[int, int], object] = {}
+        # Tree speculation on the span family (ISSUE 19): the linear draft
+        # becomes LMRS_SPEC_TREE_WIDTH root-branching chains drafted
+        # in-graph from the device history buffer and verified in ONE
+        # ("rpa_spec", tpb, w) span dispatch whose causal mask follows
+        # parent pointers (ancestor bitmasks, ragged_spans_xla).  Requires
+        # the span dispatch + mixed routing (a token tree IS a span);
+        # LMRS_SPEC_TREE=0 restores the linear spec path byte-for-byte
+        # and speculate_k=0 keeps everything inert.
+        self._spec_width = env_int("LMRS_SPEC_TREE_WIDTH", 2, lo=1, hi=8)
+        # ancestor bitmasks are int32 over span-local offsets: the span is
+        # [heal (<= depth), cur, width x depth], so clamp width until
+        # 1 + depth*(width+1) fits in 32 bits; a depth that cannot fit
+        # even one chain falls back to linear speculation
+        while (self._spec_width > 1
+               and 1 + self.spec_k * (self._spec_width + 1) > 32):
+            self._spec_width -= 1
+        self._spec_tree = (bool(self.spec_k) and self._rpa and self._mixed
+                           and 1 + self.spec_k * (self._spec_width + 1) <= 32
+                           and env_bool("LMRS_SPEC_TREE", True))
+        # adaptive per-request depth: a windowed acceptance EMA per slot
+        # ramps chain depth up on accept streaks and down to off on
+        # acceptance collapse or page pressure (LMRS_SPEC_ADAPTIVE=0
+        # pins every row at full depth)
+        self._spec_adaptive = (self._spec_tree
+                               and env_bool("LMRS_SPEC_ADAPTIVE", True))
+        # per-heal-length (pos_off, ancestor-bitmask) span templates —
+        # host-side operand build is a dict lookup + two copies per row
+        self._spec_tmpl: dict[int, tuple[np.ndarray, np.ndarray]] = {}
         # prefix cache constructed AFTER the metrics registry below (the
         # host-RAM spill tier feeds registry instruments); _pc_on carries
         # the gate decision down
@@ -455,6 +496,18 @@ class ContinuousScheduler:
         self._c_rpa_shapes = c("lmrs_rpa_compile_shapes_total",
                                "distinct ragged-span program shapes "
                                "compiled", "shapes")
+        # tree speculation (ISSUE 19): drafted tree size per row, accepted
+        # root-to-leaf depth per row, and the tree-span dispatch count —
+        # present even when tree spec is off, so bench windowing can
+        # always delta them (the prefix-counter convention)
+        self._h_spec_nodes = h("lmrs_spec_tree_nodes",
+                               help="drafted tree nodes per decode row "
+                                    "per tree-spec dispatch", unit="nodes")
+        self._h_spec_depth = h("lmrs_spec_accept_depth",
+                               help="accepted draft tokens per decode row "
+                                    "per tree-spec dispatch", unit="tokens")
+        self._c_spec_tree_disp = c("lmrs_spec_tree_dispatches_total",
+                                   "tree-speculative span dispatches")
         self._g_peak_pages = g("lmrs_peak_pages_in_use",
                                "max KV pages simultaneously allocated",
                                "pages")
@@ -652,6 +705,10 @@ class ContinuousScheduler:
             "rpa_dispatches": int(self._h_rpa_span.count),
             "rpa_span_tokens": self._h_rpa_span.sum,
             "rpa_compile_shapes": int(self._c_rpa_shapes.value),
+            "spec_tree_dispatches": int(self._c_spec_tree_disp.value),
+            "spec_tree_nodes_sum": self._h_spec_nodes.sum,
+            "spec_tree_rows": int(self._h_spec_nodes.count),
+            "spec_accept_depth_sum": self._h_spec_depth.sum,
             "watchdog_fires": int(self._c_watchdog_fires.value),
             "wedged_requests": int(self._c_wedged.value),
         }
@@ -859,6 +916,8 @@ class ContinuousScheduler:
                if self._an.enabled else {}),
             **({"spec_accepted_tokens": m["spec_accepted_tokens"]}
                if self.spec_k else {}),
+            **({"spec_tree": self._spec_tree_report()}
+               if self.spec_k else {}),
             **({"prefix_cache": self._prefix_cache_report()}
                if self._prefix_cache is not None else {}),
         }
@@ -903,6 +962,32 @@ class ContinuousScheduler:
             "span_tokens": int(m["rpa_span_tokens"]
                                - b.get("rpa_span_tokens", 0.0)),
             "compile_shapes": m["rpa_compile_shapes"],
+        }
+
+    def _spec_tree_report(self, before: dict | None = None) -> dict:
+        """Tree-speculation block of metrics_report() / bench detail /
+        the decode_split tree arm: whether the tree path is armed, how
+        many tree-span dispatches ran, mean drafted nodes and accepted
+        depth per row, and accepted tokens per dispatched row (the
+        perf_sentry ``spec_tree.accept_per_step`` trajectory metric).
+        Same windowed-``before`` convention as ``_mixed_report``."""
+        m = self.metrics
+        b = before or {}
+        disp = m["spec_tree_dispatches"] - b.get("spec_tree_dispatches", 0)
+        rows = m["spec_tree_rows"] - b.get("spec_tree_rows", 0)
+        nodes = m["spec_tree_nodes_sum"] - b.get("spec_tree_nodes_sum", 0.0)
+        depth = (m["spec_accept_depth_sum"]
+                 - b.get("spec_accept_depth_sum", 0.0))
+        acc = (m["spec_accepted_tokens"]
+               - b.get("spec_accepted_tokens", 0))
+        return {
+            "enabled": self._spec_tree,
+            "width": self._spec_width,
+            "adaptive": self._spec_adaptive,
+            "dispatches": disp,
+            "mean_nodes": round(nodes / rows, 3) if rows else 0.0,
+            "mean_accept_depth": round(depth / rows, 3) if rows else 0.0,
+            "accept_per_step": round(acc / rows, 3) if rows else 0.0,
         }
 
     def _prefix_cache_report(self) -> dict:
@@ -1257,6 +1342,18 @@ class ContinuousScheduler:
                                 t_start=t0 if t0 is not None else now,
                                 n_prompt=n_prompt, prior=list(prior))
                 st.t_admit = now
+                if self._spec_tree:
+                    # tree speculation starts at full depth (the adaptive
+                    # ramp takes over per accepted step); a cross-refresh
+                    # draft hint tokenizes ONCE here, clipped so hint +
+                    # prompt + budget still fit the history buffer
+                    st.spec_depth = self.spec_k
+                    if req.draft_hint:
+                        room = (self.max_len - len(ids) - max_new - 1
+                                - self._spec_width * self.spec_k)
+                        if room > 0:
+                            st.spec_hint = self.tokenizer.encode(
+                                req.draft_hint)[:room]
                 rid = req.request_id
                 # queue wait = enqueue -> FIRST admission.  Continuation
                 # detection is ``t0`` (the carried original t_start), NOT
@@ -1487,6 +1584,23 @@ class ContinuousScheduler:
                         if slots[b] is not None:
                             active[b] = True
                     self._an.iter_end("prefill")
+                    continue
+                if self.spec_k and self._spec_tree:
+                    # tree speculation (ISSUE 19): pure-decode spec steps
+                    # route through the ragged-span family too — the
+                    # legacy spec block must never see a row whose heal
+                    # prefix or hint-offset history columns only the tree
+                    # path understands.  The span handler owns its own
+                    # occupancy/gap/dispatch metrics.  A False return
+                    # means every row stalled under page pressure: loop
+                    # (preemption guarantees progress, same as the legacy
+                    # stall spin).
+                    with self._an.seg("plan"):
+                        did, last_block_t = self._rpa_mixed_iteration(
+                            None, slots, queue, results, fresh, kv_lens,
+                            last_tok, active, temps, top_k, top_p, t_enq,
+                            last_block_t)
+                    self._an.iter_end("spec")
                     continue
                 n_live = int(np.sum(active))
                 self._h_occupancy.observe(n_live / self.B)
@@ -3271,6 +3385,93 @@ class ContinuousScheduler:
         ngram = max(2, self.cfg.speculate_ngram)
         eos_id = self.tokenizer.eos_id
 
+        if self._spec_tree:
+            # Tree-spec variant (ISSUE 19 tentpole): decode rows carry a
+            # (heal + 1 + W*k)-token span — leading "healing" re-sends of a
+            # previously accepted non-first chain, the current token, then
+            # W root-branching depth-k chains drafted IN-GRAPH by top-W
+            # n-gram lookup.  Branch visibility follows parent pointers via
+            # the host-built ancestor bitmasks (``anc``), rope positions are
+            # depth-based via the host-built ``pos_off`` (write columns stay
+            # span-offset — the caller's heal protocol fixes non-first-chain
+            # columns on the next dispatch), and acceptance is the exact
+            # sequential multi-candidate rule (ops/speculative.verify_tree),
+            # so greedy outputs stay token-identical to every other path.
+            # Same ("rpa_spec", tpb, w) bucket family — no new compile axis.
+            W = self._spec_width
+            from lmrs_tpu.ops.sampling import filtered_probs
+            from lmrs_tpu.ops.speculative import (draft_tree_lookup,
+                                                  verify_tree)
+
+            @partial(jax.jit,
+                     donate_argnums=(1, 2, 3, 4, 5) if kv_q else (1, 2, 3))
+            def rpa_tree_step(params, k_pages, v_pages, buf, kscale, vscale,
+                              srows, tokens, q_starts, q_lens, row_flat,
+                              base, is_dec, cur_tok, hl, hoff, depth,
+                              pos_off, anc, gather_idx, table, key, temps,
+                              tk, tp):
+                nb = base.shape[0]
+                b_rows = jnp.arange(nb)[:, None]
+                kvl = base + hl  # true kv_len (base excludes the heal span)
+                # current token enters the history at its kv position plus
+                # the row's cross-refresh hint offset (decode rows only)
+                col0 = jnp.where(is_dec,
+                                 jnp.minimum(kvl + hoff, max_len - 1),
+                                 max_len)
+                buf = buf.at[jnp.arange(nb), col0].set(cur_tok, mode="drop")
+                chains, n_valid = draft_tree_lookup(
+                    buf, kvl + hoff + 1, k, W, pad_id=eos_id, n=ngram,
+                    depth=depth)
+                n_valid = jnp.where(is_dec[:, None], n_valid, 0)
+                # scatter [cur, chains] after each decode span's heal
+                # prefix (heal tokens were host-built into ``tokens``)
+                offs_t = jnp.arange(1 + W * k)[None, :]
+                span_idx = jnp.where(is_dec[:, None],
+                                     q_starts[:, None] + hl[:, None]
+                                     + offs_t, tpb)
+                tokens = tokens.at[0, span_idx].set(
+                    jnp.concatenate(
+                        [cur_tok[:, None], chains.reshape(nb, W * k)], 1),
+                    mode="drop")
+                rf = jnp.clip(row_flat, 0, nb - 1)
+                positions = jnp.clip(base[rf] + pos_off, 0,
+                                     max_len - 1)[None]
+                out = forward_paged(
+                    params, cfg, tokens, positions, k_pages, v_pages,
+                    table, base, rope_max, use_ragged_kernel=use_ragged,
+                    interpret=interp, packed_last_idx=gather_idx,
+                    kv_scales=(kscale, vscale) if kv_q else None,
+                    scale_rows=srows if kv_q else None,
+                    spans=(q_starts, q_lens, row_flat), span_anc=anc,
+                )
+                logits, k_pages, v_pages = out[:3]
+                if kv_q:
+                    kscale, vscale = out[3]
+                probs = jax.vmap(filtered_probs,
+                                 in_axes=(1, None, None, None),
+                                 out_axes=1)(
+                    logits[0].reshape(nb, 1 + W * k, -1), temps, tk, tp)
+                key, sub = jax.random.split(key)
+                emit, count, chain, adepth = verify_tree(
+                    probs, chains, n_valid, sub)
+                # accepted tokens extend the history at hint-offset columns
+                offs = jnp.arange(k + 1)[None, :]
+                cols = jnp.minimum(kvl[:, None] + hoff[:, None] + 1 + offs,
+                                   max_len - 1)
+                cols = jnp.where((offs < count[:, None]) & is_dec[:, None],
+                                 cols, max_len)
+                buf = buf.at[b_rows, cols].set(emit, mode="drop")
+                return (emit, count, chain, adepth, buf, k_pages, v_pages,
+                        kscale, vscale)
+
+            logger.info("compiling ragged span tree-spec step: B=%d "
+                        "token_bucket=%d window=%d pages k=%d width=%d "
+                        "(ragged_kernel=%s)", self.B, tpb, w, k, W,
+                        use_ragged)
+            self._c_rpa_shapes.inc()
+            self._rpa_fns[key_] = rpa_tree_step
+            return rpa_tree_step
+
         from lmrs_tpu.ops.sampling import filtered_probs
         from lmrs_tpu.ops.speculative import draft_lookup, verify_tokens
 
@@ -3335,6 +3536,57 @@ class ContinuousScheduler:
         self._rpa_fns[key_] = rpa_spec_step
         return rpa_spec_step
 
+    def _tree_span_template(self, hl: int):
+        """(pos_off, ancestor-bitmask) template for a tree-spec decode
+        span with ``hl`` leading heal tokens: span-local layout is
+        [heal_0..heal_{hl-1}, cur, chain_0 (k), ..., chain_{W-1} (k)].
+        Heal tokens and cur keep the anc == 0 sentinel (plain causal
+        rule); chain c's node j sees the heal+cur prefix plus its own
+        chain up to itself.  Rope positions are DEPTH-based — chain c
+        node j sits at kv offset hl+1+j regardless of c — while K/V
+        writes land at span-offset columns (the heal protocol's whole
+        reason to exist).  Bit 31 is reachable (hl=k, the capacity
+        bound), so masks build in uint32 and reinterpret as int32."""
+        tmpl = self._spec_tmpl.get(hl)
+        if tmpl is None:
+            W, k = self._spec_width, self.spec_k
+            n = hl + 1 + W * k
+            pos = np.zeros((n,), np.int32)
+            anc = np.zeros((n,), np.uint32)
+            pos[: hl + 1] = np.arange(hl + 1)
+            prefix = (1 << (hl + 1)) - 1
+            for c in range(W):
+                bits = prefix
+                for j in range(k):
+                    o = hl + 1 + c * k + j
+                    pos[o] = hl + 1 + j
+                    bits |= 1 << o
+                    anc[o] = bits
+            self._spec_tmpl[hl] = tmpl = (pos, anc.view(np.int32))
+        return tmpl
+
+    def _spec_ramp(self, st: _SlotState, depth_used: int) -> int:
+        """Next-step draft depth for one row off its acceptance EMA
+        (LMRS_SPEC_ADAPTIVE): accept streaks deepen the chains toward
+        spec_k, collapse ramps down to OFF, and an off row re-probes at
+        half depth every 8 steps so a workload shift can re-arm it."""
+        k = self.spec_k
+        if depth_used == 0:
+            st.spec_probe += 1
+            if st.spec_probe >= 8:
+                st.spec_probe = 0
+                st.spec_ema = 0.5
+                return max(1, k // 2)
+            return 0
+        st.spec_probe = 0
+        if st.spec_ema >= 0.6:
+            return min(depth_used + 1, k)
+        if st.spec_ema < 0.2:
+            return 0
+        if st.spec_ema < 0.35:
+            return max(depth_used - 1, 1)
+        return depth_used
+
     def _rpa_mixed_iteration(self, pf, slots, queue, results, fresh,
                              kv_lens, last_tok, active, temps, top_k,
                              top_p, t_enq, last_block_t):
@@ -3348,24 +3600,56 @@ class ContinuousScheduler:
         blocks no longer yield during prefill windows.  Same
         (handled, last_block_t) contract as _mixed_iteration."""
         spec = bool(self.spec_k)
-        adv = 1 + self.spec_k if spec else 1
+        tree = spec and self._spec_tree
+        k = self.spec_k
+        W = self._spec_width
 
         def rearm(stalled):
             for b in stalled:  # stalled rows rejoin the next dispatch
                 if slots[b] is not None:
                     active[b] = True
 
+        adv = (1 + W * k) if tree else (1 + k if spec else 1)
         stalled = self._ensure_decode_capacity(slots, queue, kv_lens,
                                                last_tok, active,
                                                extra_tokens=adv)
         rows = [b for b in range(self.B)
                 if slots[b] is not None and active[b]
                 and slots[b].phase == "decode"]
-        budget_left = self.mixed_token_budget - adv * len(rows)
-        if not rows or budget_left < 16:
+        depth_of: dict[int, int] = {}
+        hl_of: dict[int, int] = {}
+        pressure = False
+        if tree:
+            # page pressure collapses draft depth to 0 for THIS dispatch
+            # (the span family still runs when a heal is pending);
+            # acceptance collapse ramps per-row depth to 0 via _spec_ramp.
+            # When every row sits at depth 0 with no heal pending, the
+            # step routes through the PLAIN span program (adv=1) and the
+            # rows are marked spec-stale (the history buffer misses the
+            # append).
+            pressure = (self._spec_adaptive
+                        and self.cache.allocator.free_count < self.B)
+            for b in rows:
+                st = slots[b]
+                hl_of[b] = len(st.spec_heal)
+                depth_of[b] = 0 if pressure else min(st.spec_depth, k)
+            spec_live = any(depth_of[b] > 0 or hl_of[b] > 0 for b in rows)
+        else:
+            spec_live = spec
+        use_spec = spec and spec_live
+        tree_live = tree and use_spec
+        if not use_spec:
+            adv = 1
+
+        def q_of(b):
+            return hl_of[b] + adv if tree_live else adv
+
+        dec_tokens = sum(q_of(b) for b in rows)
+        budget_left = self.mixed_token_budget - dec_tokens
+        if not rows or (pf is not None and budget_left < 16):
             rearm(stalled)
             return False, last_block_t
-        if spec:
+        if use_spec:
             with self._an.seg("draft"):
                 if self._spec_buf is None:
                     self._spec_buf = jnp.zeros((self.B, self.max_len),
@@ -3380,31 +3664,49 @@ class ContinuousScheduler:
                             self.seed_history(b, slots[b])
                     self._spec_stale.clear()
 
-        st_pf = slots[pf]
-        pos = st_pf.prefill_pos
-        c = min(len(st_pf.prompt_ids) - pos, budget_left,
-                self.prefill_chunk)
-        is_final = pos + c >= len(st_pf.prompt_ids)
+        if pf is not None:
+            st_pf = slots[pf]
+            pos = st_pf.prefill_pos
+            c = min(len(st_pf.prompt_ids) - pos, budget_left,
+                    self.prefill_chunk)
+            is_final = pos + c >= len(st_pf.prompt_ids)
+        else:
+            # pure-decode tree-spec step: the alternating path routes
+            # here under LMRS_SPEC_TREE so heal/hint column state never
+            # meets the legacy spec block
+            st_pf, pos, c, is_final = None, 0, 0, False
 
         q_lens_np = np.zeros((self.B,), np.int32)
         base_np = np.zeros((self.B,), np.int32)
         is_dec_np = np.zeros((self.B,), bool)
+        hl_np = np.zeros((self.B,), np.int32)
+        hoff_np = np.zeros((self.B,), np.int32)
+        depth_np = np.zeros((self.B,), np.int32)
         table_rows = [None] * self.B
         max_pages = 1
         live_tokens = 0
         for b in rows:
             st = slots[b]
-            q_lens_np[b] = adv
-            base_np[b] = st.kv_len
+            q_lens_np[b] = q_of(b)
+            # a heal span re-sends a non-first accepted chain's tokens as
+            # leading queries with base = kv_len - heal: their K/V rewrite
+            # at the true columns (rope intact) before any read this
+            # dispatch — write-before-read in the XLA span path
+            base_np[b] = st.kv_len - (hl_of[b] if tree_live else 0)
             is_dec_np[b] = True
+            if tree_live:
+                hl_np[b] = hl_of[b]
+                hoff_np[b] = st.spec_hoff
+                depth_np[b] = depth_of[b]
             table_rows[b] = st.seq
             live_tokens += st.kv_len
             max_pages = max(max_pages,
                             self.cache.pages_needed(st.kv_len + adv))
-        q_lens_np[pf] = c
-        base_np[pf] = pos
-        table_rows[pf] = st_pf.seq
-        max_pages = max(max_pages, self.cache.pages_needed(pos + c))
+        if pf is not None:
+            q_lens_np[pf] = c
+            base_np[pf] = pos
+            table_rows[pf] = st_pf.seq
+            max_pages = max(max_pages, self.cache.pages_needed(pos + c))
         w = min(_pow2_bucket(max_pages, 4), self.cache.max_pages_per_slot)
         table = self.cache.page_table_array(table_rows)
 
@@ -3414,15 +3716,41 @@ class ContinuousScheduler:
         tpb = _pow2_bucket(total, 16)
         tokens_np = np.zeros((1, tpb), np.int32)
         row_flat_np = np.full((tpb,), self.B, np.int32)
+        pos_off_np = anc_np = None
+        if tree_live:
+            pos_off_np = np.zeros((tpb,), np.int32)
+            anc_np = np.zeros((tpb,), np.int32)
         for b in rows:
-            tokens_np[0, q_starts_np[b]] = last_tok[b]
-            row_flat_np[q_starts_np[b]: q_starts_np[b] + adv] = b
-        tokens_np[0, q_starts_np[pf]: q_starts_np[pf] + c] = \
-            st_pf.prompt_ids[pos: pos + c]
-        row_flat_np[q_starts_np[pf]: q_starts_np[pf] + c] = pf
+            s = q_starts_np[b]
+            tokens_np[0, s] = last_tok[b]
+            row_flat_np[s: s + q_lens_np[b]] = b
+            if tree_live:
+                # heal tokens ride host-side (cur + chains scatter
+                # in-graph after them); positions and ancestor bitmasks
+                # come from the per-heal-length span template
+                hl_b = hl_of[b]
+                tokens_np[0, s: s + hl_b] = slots[b].spec_heal
+                t_pos, t_anc = self._tree_span_template(hl_b)
+                pos_off_np[s: s + len(t_pos)] = t_pos
+                anc_np[s: s + len(t_anc)] = t_anc
+        if pf is not None:
+            tokens_np[0, q_starts_np[pf]: q_starts_np[pf] + c] = \
+                st_pf.prompt_ids[pos: pos + c]
+            row_flat_np[q_starts_np[pf]: q_starts_np[pf] + c] = pf
+            if tree_live:
+                # the prefill slice keeps linear positions and the
+                # anc == 0 sentinel (plain causal rule — slices can be
+                # longer than the 32-offset bitmask)
+                pos_off_np[q_starts_np[pf]: q_starts_np[pf] + c] = \
+                    np.arange(c, dtype=np.int32)
         last_of = (q_starts_np + np.maximum(q_lens_np, 1) - 1).astype(
             np.int32)
-        if spec:
+        if tree_live:
+            offs = np.arange(1 + W * k)[None, :]
+            gidx = np.where(is_dec_np[:, None],
+                            q_starts_np[:, None] + hl_np[:, None] + offs,
+                            last_of[:, None]).reshape(-1).astype(np.int32)
+        elif use_spec:
             offs = np.arange(self.spec_k + 1)[None, :]
             gidx = np.where(is_dec_np[:, None],
                             q_starts_np[:, None] + offs,
@@ -3430,7 +3758,7 @@ class ContinuousScheduler:
         else:
             gidx = last_of
 
-        real = adv * len(rows) + c
+        real = dec_tokens + c
         # bucket economics (obs/anatomy.py): this dispatch pays for a
         # tpb-token bucket but carries ``real`` span tokens
         self._an.note_bucket(tpb, w, real)
@@ -3438,35 +3766,51 @@ class ContinuousScheduler:
         self._c_decode_dispatches.inc()
         self._h_mixed_fill.observe(real / self.mixed_token_budget)
         self._h_rpa_span.observe(real)
-        self._c_piggybacked.inc(c)
-        self._c_prefill_tokens.inc(c)
-        self._h_prefill_batch.observe(c)
         now = time.time()
         if last_block_t is not None:
             self._h_block_gap.observe(now - last_block_t)
             self._slo.observe_gap(now - last_block_t)
         last_block_t = now
-        flops = self._perf.prefill_flops(c, kv_start=pos)
-        if self._tr:
-            self._tr.instant("prefill_dispatch",
-                             args={"rows": 1, "tokens": c, "bucket": tpb,
-                                   "mixed": True, "rpa": True,
-                                   "flops_g": round(flops / 1e9, 3)})
-        st_pf.prefill_pos = pos + c
+        flops = 0.0
+        if pf is not None:
+            self._c_piggybacked.inc(c)
+            self._c_prefill_tokens.inc(c)
+            self._h_prefill_batch.observe(c)
+            flops = self._perf.prefill_flops(c, kv_start=pos)
+            if self._tr:
+                self._tr.instant("prefill_dispatch",
+                                 args={"rows": 1, "tokens": c,
+                                       "bucket": tpb, "mixed": True,
+                                       "rpa": True,
+                                       "flops_g": round(flops / 1e9, 3)})
+            st_pf.prefill_pos = pos + c
+        if tree_live:
+            self._c_spec_tree_disp.inc()
 
         self._key, sub = jax.random.split(self._key)
         srows = jnp.arange(self.B, dtype=jnp.int32)
         common = (jnp.asarray(tokens_np), jnp.asarray(q_starts_np),
                   jnp.asarray(q_lens_np), jnp.asarray(row_flat_np),
                   jnp.asarray(base_np))
-        key_ = ("rpa_spec", tpb, w) if spec else ("rpa", tpb, w)
+        key_ = ("rpa_spec", tpb, w) if use_spec else ("rpa", tpb, w)
         warm = key_ in self._ran_ok
         if not warm:
             self._wd_grace_cold()
         t_disp = time.time()
 
         def dispatch():
-            if spec:
+            if tree_live:
+                return self._get_rpa_spec_fn(tpb, w)(
+                    self.params, self.cache.k, self.cache.v,
+                    self._spec_buf, self.kscale, self.vscale, srows,
+                    *common, jnp.asarray(is_dec_np),
+                    jnp.asarray(last_tok), jnp.asarray(hl_np),
+                    jnp.asarray(hoff_np), jnp.asarray(depth_np),
+                    jnp.asarray(pos_off_np), jnp.asarray(anc_np),
+                    jnp.asarray(gidx), jnp.asarray(table[:, :w]), sub,
+                    jnp.asarray(temps), jnp.asarray(top_k),
+                    jnp.asarray(top_p))
+            if use_spec:
                 return self._get_rpa_spec_fn(tpb, w)(
                     self.params, self.cache.k, self.cache.v,
                     self._spec_buf, self.kscale, self.vscale, srows,
@@ -3502,7 +3846,14 @@ class ContinuousScheduler:
             self._an.note_compile(tpb, w, time.time() - t_disp)
         self._note_ran_ok(key_)
         with self._an.seg("fetch"):
-            if spec:
+            if tree_live:
+                (emit, count, chain, adepth, self._spec_buf, self.cache.k,
+                 self.cache.v, ks, vs) = out
+                emit, count, chain, adepth = self._timed_get(
+                    (emit, count, chain, adepth))
+                emit, count = np.asarray(emit), np.asarray(count)
+                chain, adepth = np.asarray(chain), np.asarray(adepth)
+            elif use_spec:
                 (emit, count, self._spec_buf, self.cache.k, self.cache.v,
                  ks, vs) = out
                 emit, count = self._timed_get((emit, count))
@@ -3521,7 +3872,7 @@ class ContinuousScheduler:
             extra_flops, cold_pf = self._consume_prefill_attr()
             nb = self._perf.note_mixed_step(
                 t_disp, t_done, len(rows), live_tokens, flops + extra_flops,
-                warm=warm and not cold_pf, span_tokens=adv * len(rows))
+                warm=warm and not cold_pf, span_tokens=dec_tokens)
             self._attr_last_gb = round(nb / 1e9, 3)
             if self._cost.enabled:
                 dcost, pcost = self._roofline_phase_costs(
@@ -3529,22 +3880,48 @@ class ContinuousScheduler:
                 self._cost.note_step(
                     max(0.0, t_done - t_disp),
                     decode_rows=[(slots[b].req,
-                                  int(count[b]) if spec else 1,
+                                  int(count[b]) if use_spec else 1,
                                   len(slots[b].seq.pages)) for b in rows],
                     prefill_rows=(self._consume_prefill_cost()
-                                  + [(st_pf.req, c, flops)]),
+                                  + ([(st_pf.req, c, flops)]
+                                     if pf is not None else [])),
                     decode_cost_s=dcost, prefill_cost_s=pcost)
 
             for b in rows:
                 st = slots[b]
-                if spec:
+                if use_spec:
                     cnt = int(count[b])
                     new = [int(t) for t in emit[b, :cnt]]
                     self._c_spec_accepted.inc(max(0, cnt - 1))
                     if cnt > 1:
                         self._cost.note_saved(st.req, spec_tokens=cnt - 1)
+                    if tree_live:
+                        cs, ad = int(chain[b]), int(adepth[b])
+                        # a non-first accepted chain's drafts sit at THAT
+                        # chain's span-offset KV columns: re-send them as
+                        # the next span's heal prefix so they rewrite at
+                        # the true columns
+                        st.spec_heal = (new[:ad] if cs > 0 and ad > 0
+                                        else [])
+                        d_used = depth_of[b]
+                        self._h_spec_nodes.observe(1 + W * d_used)
+                        self._h_spec_depth.observe(ad)
+                        if not pressure:
+                            if d_used > 0:
+                                st.spec_ema = (0.8 * st.spec_ema
+                                               + 0.2 * ad / d_used)
+                            if self._spec_adaptive:
+                                st.spec_depth = self._spec_ramp(st, d_used)
                 else:
                     new = [int(nxt[b])]
+                    if tree:
+                        # plain-routed idle tree step: the history buffer
+                        # missed this append — re-seed before the next
+                        # spec-live dispatch; the depth-0 probe timer
+                        # keeps ticking so speculation can re-arm
+                        self._spec_stale.add(b)
+                        if self._spec_adaptive and not pressure:
+                            st.spec_depth = self._spec_ramp(st, 0)
                 st.generated.extend(new)
                 st.kv_len += len(new)
                 kv_lens[b] = st.kv_len
@@ -3573,7 +3950,7 @@ class ContinuousScheduler:
                 kv_lens[pf] = st.kv_len
                 active[pf] = True
                 self._cache_insert(st)
-                tok0 = int(emit[pf, 0]) if spec else int(nxt[pf])
+                tok0 = int(emit[pf, 0]) if use_spec else int(nxt[pf])
                 st.generated.append(tok0)
                 self._note_first_token(st, t_enq)
                 last_tok[pf] = tok0
@@ -3588,9 +3965,11 @@ class ContinuousScheduler:
             if self._tr:
                 self._tr.complete("decode_block", now, time.time(),
                                   args={"active": len(rows),
-                                        "tokens": adv * len(rows),
+                                        "tokens": dec_tokens,
                                         "hbm_gb": self._attr_last_gb,
-                                        "mixed": True, "rpa": True,
+                                        "mixed": pf is not None,
+                                        "rpa": True,
+                                        "spec_tree": tree_live,
                                         "prefill_tokens": c})
             rearm(stalled)
         return True, last_block_t
@@ -4331,14 +4710,24 @@ class ContinuousScheduler:
 
     def seed_history(self, b: int, st: _SlotState) -> None:
         """Load slot b's token history into the device-resident buffer (one
-        row upload at decode admission; the device appends from then on)."""
+        row upload at decode admission; the device appends from then on).
+        Under tree speculation a cross-refresh draft hint (the previous
+        refresh's summary, live/session.py) seeds AHEAD of the real
+        history: the buffer column of the token at kv position p becomes
+        p + spec_hoff, and the n-gram lookup window covers the hint — a
+        near-perfect draft source for the next refresh's continuation."""
         if not self.spec_k:
             return
         if self._spec_buf is None:
             self._spec_buf = jnp.zeros((self.B, self.max_len), jnp.int32)
         row = np.zeros((self.max_len,), np.int32)
-        hist = (st.prompt_ids + st.generated)[-self.max_len:]
-        row[: len(hist)] = hist
+        hint = st.spec_hint if self._spec_tree else []
+        hist = st.prompt_ids + st.generated
+        hoff = min(len(hint), max(0, self.max_len - len(hist)))
+        row[:hoff] = hint[:hoff]
+        hist = hist[-(self.max_len - hoff):] if hoff < self.max_len else []
+        row[hoff: hoff + len(hist)] = hist
+        st.spec_hoff = hoff
         self._spec_buf = self._spec_buf.at[b].set(jnp.asarray(row))
 
     def _spec_decode_block(self, slots, last_tok, kv_lens, active, temps,

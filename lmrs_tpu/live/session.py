@@ -824,12 +824,19 @@ class SessionManager:
         # QoS class rides the refresh's deadline class: an interactive
         # refresh outranks batch job fan-out by policy (fleet/qos.py);
         # a bulk refresh competes as batch like any other bulk work
+        # cross-refresh drafting (tree speculation): the PREVIOUS refresh's
+        # summary seeds the device draft buffer for every request of this
+        # refresh — a rolling summary mostly restates itself, so the prior
+        # text is a near-perfect n-gram draft source.  Advisory only
+        # (exact-distribution verify): outputs are unchanged either way.
+        prior = session.summary or {}
         stamp = TenantStampEngine(self.engine, session.tenant,
                                   publish=_publish_usage,
                                   seed=session.usage,
                                   qos_class=("interactive"
                                              if klass == "interactive"
-                                             else "batch"))
+                                             else "batch"),
+                                  draft_hint=prior.get("summary"))
         executor = MapExecutor(stamp, engine_cfg)
         with session.ctl:
             session._executor = executor

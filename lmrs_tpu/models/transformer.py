@@ -336,6 +336,13 @@ def forward_paged(
                                  # attention runs through the unified span
                                  # kernel (ops ragged_spans_*).  Use
                                  # packed_last_idx to gather sampled rows.
+    span_anc: jnp.ndarray | None = None,  # [Tp] int32 ancestor bitmasks for
+                                 # tree-speculative spans (ISSUE 19): tokens
+                                 # with a nonzero mask attend context + their
+                                 # ancestor offsets only; 0 keeps the linear
+                                 # causal rule.  Routes to the XLA span twin
+                                 # (the Pallas ancestor variant is chip debt,
+                                 # docs/PERF.md).
 ) -> tuple:
     """Forward pass against a paged KV cache (engine/kv_cache.PagedKVCache).
 
@@ -491,7 +498,7 @@ def forward_paged(
                 ksc = ksc.at[li, rows_i].set(s_k)
                 vsc = vsc.at[li, rows_i].set(s_v)
                 ss = (s_k, s_v)
-            if use_ragged_kernel:
+            if use_ragged_kernel and span_anc is None:
                 attn, kp_all, vp_all = ragged_spans_pallas(
                     q[0], k[0], v[0], kp_all, vp_all, g_tables, kv_lens,
                     span_starts, span_lens, interpret=interpret,
@@ -502,7 +509,7 @@ def forward_paged(
                 attn, kp_all, vp_all = ragged_spans_xla(
                     q[0], k[0], v[0], kp_all, vp_all, g_tables, kv_lens,
                     span_starts, span_lens, row_flat,
-                    max_pos=rope_max, kv_scales=ss)
+                    max_pos=rope_max, kv_scales=ss, anc_masks=span_anc)
             return _finish_layer(lp, x, attn[None], kp_all, vp_all,
                                  ksc, vsc)
 

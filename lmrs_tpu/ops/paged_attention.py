@@ -1164,13 +1164,26 @@ def ragged_spans_xla(
     row_flat: jnp.ndarray,     # [Tp] owning row per flat token (>= B: none)
     max_pos: int | None = None,
     kv_scales=None,            # (k_scale, v_scale) [B, K, hd] for int8 pools
+    anc_masks: jnp.ndarray | None = None,  # [Tp] int32 ancestor bitmasks
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Scatter + gather reference for the ragged span kernel: same contract
     on any platform (correctness baseline, the sp>1 path, and the CPU /
     first-run-lowering fallback).  ``row_flat`` is the host-built inverse
     of the span list — the kernel derives it from (q_starts, q_lens); XLA
     wants it materialized.  Out-of-span tokens park their writes on the
-    reserved null page (id 0) and produce zero output rows."""
+    reserved null page (id 0) and produce zero output rows.
+
+    ``anc_masks`` generalizes the causal mask to token TREES (ISSUE 19
+    tree speculation): flat token t with a nonzero mask attends the real
+    context (cols strictly below its row's ``kv_lens``) plus exactly the
+    span-local offsets whose bit is set — its root-to-self ancestor path,
+    host-built, capacity 32 offsets per span.  Tokens with mask 0 (prefill
+    chunks, plain rows, padding — any span-local layout that IS linear)
+    keep the linear ``col <= pos`` rule bit-for-bit, so one dispatch mixes
+    tree spans with arbitrarily long linear spans.  K/V writes are
+    unchanged (span-offset columns): a tree node's K/V lands at a column
+    only its own descendants can see this dispatch, and the scheduler
+    heals accepted non-first-chain columns on the row's next span."""
     tp, h, hd = q.shape
     _, kh, ps, _ = k_pages.shape
     b, w = page_tables.shape
@@ -1213,6 +1226,12 @@ def ragged_spans_xla(
     logits = jnp.einsum("thd,tkhd->thk", q, kt).astype(jnp.float32) * hd**-0.5
     col = jnp.arange(w * ps)[None, None, :]
     mask = in_span[:, None, None] & (col <= pos[:, None, None])
+    if anc_masks is not None:
+        col_off = col - kv_lens[rf][:, None, None]
+        bit = (anc_masks[:, None, None] >> jnp.clip(col_off, 0, 31)) & 1
+        tree_ok = (col_off < 0) | ((col_off < 32) & (bit == 1))
+        mask = in_span[:, None, None] & jnp.where(
+            anc_masks[:, None, None] == 0, col <= pos[:, None, None], tree_ok)
     if max_pos is not None:
         mask &= col < max_pos
     logits = jnp.where(mask, logits, NEG_INF)

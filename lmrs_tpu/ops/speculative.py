@@ -68,21 +68,166 @@ def draft_lookup(
     # exclude the query n-gram itself and anything whose draft window would
     # start at/after the history end
     match &= idx + n < hist_len[:, None]
-    # a match so close to the buffer end that its k-token continuation
-    # window would run past L can't be drafted from (the clip below would
-    # silently slide the window onto unrelated tokens) — require room
-    match &= idx + n <= L - k
     has = jnp.any(match, axis=1) & (hist_len >= n)
-    # most recent match: argmax over idx * match
+    # most recent match: argmax over idx * match.  A match near the buffer
+    # end (the LIVE context — exactly the occurrence we want) used to be
+    # excluded because its k-token window ran past L and the slice clip
+    # would slide onto unrelated tokens; pad the buffer by k instead so the
+    # window always has room and n_valid clips to the real history.
     pos = jnp.max(jnp.where(match, idx, -1), axis=1)  # [B], -1 if none
 
-    start = jnp.clip(pos + n, 0, L - k)  # draft source window
+    start = pos + n  # draft source window; < hist_len whenever has
+    bufp = jnp.pad(buf, ((0, 0), (0, k)), constant_values=pad_id)
     draft = jax.vmap(
         lambda row, s: jax.lax.dynamic_slice_in_dim(row, s, k)
-    )(buf, start)
-    n_valid = jnp.where(has, jnp.minimum(k, hist_len - start), 0)
+    )(bufp, jnp.maximum(start, 0))
+    n_valid = jnp.where(has, jnp.clip(hist_len - start, 0, k), 0)
     draft = jnp.where(jnp.arange(k)[None, :] < n_valid[:, None], draft, pad_id)
     return draft, n_valid.astype(jnp.int32)
+
+
+def draft_tree_lookup(
+    buf: jnp.ndarray,       # [B, L] int32 token history (prompt + generated)
+    hist_len: jnp.ndarray,  # [B] valid tokens in buf
+    k: int,                 # chain depth (tokens per chain)
+    width: int,             # chains per row (tree branching at the root)
+    pad_id: int = 0,
+    n: int = 2,
+    depth: jnp.ndarray | None = None,  # [B] per-row depth clamp (adaptive)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Propose a token TREE per row: the ``width`` most recent n-gram
+    matches each contribute a depth-``k`` continuation chain branching at
+    the root (EAGLE-Pangu / SpecInfer shape, flattened as root-branching
+    chains — parent of chain token 0 is the current token, parent of chain
+    token j is chain token j-1).
+
+    A linear draft wastes the whole chain on the first miss; when the last
+    n-gram recurs at several earlier positions the continuations DIVERGE,
+    and verifying the top-``width`` of them in one pass keeps the step
+    alive on whichever branch the model actually takes.
+
+    Returns (chains [B, width, k], n_valid [B, width]) ordered most recent
+    match first; chains whose FIRST token duplicates a more recent chain's
+    are dropped (n_valid 0) — under sequential multi-candidate rejection a
+    duplicate root candidate has zero residual mass, so it could never be
+    accepted anyway.
+    """
+    b, L = buf.shape
+    w = L - (n - 1)
+    idx = jnp.arange(w)[None, :]
+    match = jnp.ones((b, w), bool)
+    for j in range(n):
+        cj = jnp.take_along_axis(
+            buf, jnp.maximum(hist_len - n + j, 0)[:, None], 1)
+        match &= buf[:, j: j + w] == cj
+    match &= idx + n < hist_len[:, None]
+    # top-`width` most recent match positions, descending (non-matches -1)
+    pos, _ = jax.lax.top_k(jnp.where(match, idx, -1), width)  # [B, W]
+    has = (pos >= 0) & (hist_len[:, None] >= n)
+
+    start = pos + n
+    bufp = jnp.pad(buf, ((0, 0), (0, k)), constant_values=pad_id)
+    chains = jax.vmap(jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice_in_dim(row, s, k),
+        in_axes=(None, 0),
+    ))(bufp, jnp.maximum(start, 0))                 # [B, W, k]
+    n_valid = jnp.where(has, jnp.clip(hist_len[:, None] - start, 0, k), 0)
+    if depth is not None:
+        n_valid = jnp.minimum(n_valid, depth[:, None])
+    # dedup identical root candidates (keep the most recent occurrence)
+    for c2 in range(1, width):
+        dup = jnp.zeros((b,), bool)
+        for c1 in range(c2):
+            dup |= (chains[:, c2, 0] == chains[:, c1, 0]) & (n_valid[:, c1] > 0)
+        n_valid = n_valid.at[:, c2].set(jnp.where(dup, 0, n_valid[:, c2]))
+    chains = jnp.where(
+        jnp.arange(k)[None, None, :] < n_valid[:, :, None], chains, pad_id)
+    return chains, n_valid.astype(jnp.int32)
+
+
+def verify_tree(
+    probs: jnp.ndarray,    # [B, 1+W*k, V] filtered model dist per tree node
+    chains: jnp.ndarray,   # [B, W, k] proposed chains (draft_tree_lookup)
+    n_valid: jnp.ndarray,  # [B, W] usable depth per chain
+    key: jax.Array,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Exact-distribution tree acceptance (deterministic proposals).
+
+    Node layout matches the span the scheduler dispatches: slot 0 is the
+    current token's output (the root distribution), slot ``1 + c*k + j``
+    is the output AFTER chain c's token j.  Acceptance is two-stage:
+
+    * **root**: sequential multi-candidate rejection over the chains'
+      first tokens — accept candidate c with probability residual(x_c)
+      under the running residual, else zero x_c and renormalize (the
+      SpecInfer rule; with deterministic proposals this preserves the
+      root distribution exactly, and greedy rows degenerate to "pick the
+      chain whose first token is the argmax");
+    * **within the winning chain**: the linear verify_tokens rule — accept
+      token j with probability p_{node j-1}(token j), first rejection
+      samples the residual there, full acceptance samples the bonus.
+
+    Returns (emit [B, k+1], count [B], chain [B], depth [B]): row b's new
+    tokens are emit[b, :count[b]]; ``chain`` is the winning chain index
+    (-1 when every root candidate rejected) and ``depth`` the accepted
+    draft-token count (count - 1) — the scheduler needs both to heal the
+    KV columns of a non-first chain and to feed the acceptance EMA.
+    """
+    b, n_nodes, v = probs.shape
+    _, W, k = chains.shape
+    key_root, key_chain, key_final = jax.random.split(key, 3)
+    u_root = jax.random.uniform(key_root, (b, W))
+    u_chain = jax.random.uniform(key_chain, (b, k))
+    rows = jnp.arange(b)
+
+    # root: sequential rejection over candidate first-tokens
+    residual = probs[:, 0]
+    chosen = jnp.full((b,), -1, jnp.int32)
+    for c in range(W):
+        x = chains[:, c, 0]
+        px = jnp.take_along_axis(residual, x[:, None], 1)[:, 0]
+        live = (n_valid[:, c] > 0) & (chosen < 0)
+        acc = live & (u_root[:, c] < px)
+        chosen = jnp.where(acc, c, chosen)
+        rej = live & ~acc
+        zeroed = residual.at[rows, x].set(0.0)
+        zsum = jnp.maximum(zeroed.sum(-1, keepdims=True), 1e-20)
+        residual = jnp.where(rej[:, None], zeroed / zsum, residual)
+
+    # winning chain's tokens / validity / node distributions
+    cs = jnp.maximum(chosen, 0)
+    ctoks = jnp.take_along_axis(chains, cs[:, None, None], 1)[:, 0]   # [B, k]
+    cvalid = jnp.take_along_axis(n_valid, cs[:, None], 1)[:, 0]       # [B]
+    off = 1 + cs[:, None] * k + jnp.arange(k)[None, :]                # [B, k]
+    cprobs = jnp.take_along_axis(probs, off[:, :, None], 1)           # [B,k,V]
+
+    # within-chain acceptance: token j (j >= 1) vs the node j-1 dist
+    p_next = jnp.take_along_axis(
+        cprobs[:, : k - 1], ctoks[:, 1:, None], 2)[:, :, 0]           # [B,k-1]
+    ok = (u_chain[:, : k - 1] < p_next) \
+        & (jnp.arange(1, k)[None, :] < cvalid[:, None])
+    a = 1 + jnp.sum(jnp.cumprod(ok.astype(jnp.int32), 1), 1)
+    a = jnp.minimum(a, jnp.maximum(cvalid, 1))  # accepted tokens, in [1,cv]
+
+    # final token: residual at the rejection node, bonus on full accept
+    p_fin = jnp.take_along_axis(cprobs, (a - 1)[:, None, None], 1)[:, 0]
+    rejected = a < cvalid
+    tok_a = jnp.take_along_axis(ctoks, jnp.minimum(a, k - 1)[:, None], 1)[:, 0]
+    resid2 = p_fin.at[rows, tok_a].set(0.0)
+    resid2 = resid2 / jnp.maximum(resid2.sum(-1, keepdims=True), 1e-20)
+    dist = jnp.where(rejected[:, None], resid2, p_fin)
+    none = chosen < 0  # no root candidate survived: sample the root residual
+    dist = jnp.where(none[:, None], residual, dist)
+    final = jax.random.categorical(
+        key_final, jnp.log(jnp.maximum(dist, 1e-20)), -1)
+
+    acc_n = jnp.where(none, 0, a)
+    slots = jnp.arange(k + 1)[None, :]
+    emit = jnp.where(slots < acc_n[:, None],
+                     jnp.pad(ctoks, ((0, 0), (0, 1))), 0)
+    emit = jnp.where(slots == acc_n[:, None], final[:, None], emit)
+    return (emit.astype(jnp.int32), (acc_n + 1).astype(jnp.int32),
+            chosen.astype(jnp.int32), acc_n.astype(jnp.int32))
 
 
 def verify_tokens(

@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import math
 import os
+from contextlib import contextmanager
 
 logger = logging.getLogger("lmrs.env")
 
@@ -127,6 +128,23 @@ def env_list(name: str, default: tuple[str, ...] = ()) -> tuple[str, ...]:
     if raw is None:
         return tuple(default)
     return tuple(item.strip() for item in raw.split(",") if item.strip())
+
+
+@contextmanager
+def env_override(name: str, value: str):
+    """Scoped ``LMRS_*`` override for harness scripts that build several
+    engine arms in one process (gates are read once at construction).
+    Lives HERE so the lint's single-env-path rule keeps holding: writes,
+    like reads, have exactly one sanctioned site."""
+    prev = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
 
 
 def _clamp(name: str, val, lo, hi):

@@ -4,6 +4,9 @@ real checkpoint and is demonstrated separately on the trained tiny model).
 
 Three engines: speculate_k in {0, 4, 8}; interleaved A B C C B A waves.
 Run: python scripts/ab_spec.py
+The spec arm takes the tree path (ISSUE 19) when LMRS_SPEC_TREE is
+unset/1 and reports its accept/dispatch block; LMRS_SPEC_TREE=0 is the
+linear-speculation A/B control for the same command line.
 """
 import _pathfix  # noqa: F401  (repo-root import shim)
 import time
@@ -52,8 +55,15 @@ def main():
             print(f"[{label}] round {r}: {line}", flush=True)
         speedup = np.mean(sums[0]) / np.mean(sums[spec_k])
         for k, v in sums.items():
-            acc = engines[k]._scheduler.metrics.get("spec_accepted_tokens", 0)
-            print(f"[{label}] k={k}: mean {np.mean(v):.2f}s  accepted={acc}")
+            sch = engines[k]._scheduler
+            acc = sch.metrics.get("spec_accepted_tokens", 0)
+            st = sch._spec_tree_report()
+            tree = (f"  tree: accept/step={st['accept_per_step']}"
+                    f" mean_depth={st['mean_accept_depth']}"
+                    f" dispatches={st['dispatches']}"
+                    if st["enabled"] else "")
+            print(f"[{label}] k={k}: mean {np.mean(v):.2f}s  "
+                  f"accepted={acc}{tree}")
         print(f"[{label}] speculation speedup: {speedup:.2f}x "
               f"({'WIN' if speedup >= 1.2 else 'keep OFF'})", flush=True)
 

@@ -18,7 +18,13 @@ LMRS_SPLIT_ANATOMY=1 (ISSUE 18: instead of the raw-dispatch sweep, run
 REAL scheduler-loop traffic through three step-class arms — plain
 decode / mixed / spec-verify — and print each class's host-segment
 p50/p95 split from the step-anatomy profiler, i.e. the 3x spec-step
-mystery as named segments; runs on CPU with a tiny model).
+mystery as named segments; runs on CPU with a tiny model),
+LMRS_SPLIT_SPEC_TREE=1 (ISSUE 19: real scheduler-loop traffic on a
+repetitive workload through three speculation arms — off / linear
+(LMRS_SPEC_TREE=0) / tree — reporting accepted tokens per dispatched
+row, the draft segment's host time (tree drafting is fused on-device,
+so its draft segment must collapse vs linear's host n-gram scan) and
+tok/s; runs on CPU with a tiny model).
 """
 import json
 import time
@@ -76,6 +82,71 @@ def anatomy_main():
         }
         eng.shutdown()
     print(json.dumps(out, indent=1))
+
+
+def spec_tree_main():
+    """The LMRS_SPLIT_SPEC_TREE arm (ISSUE 19): speculation A/B/C through
+    the live scheduler loop — accepted tokens/step, draft host time,
+    tok/s.  The workload repeats itself so the n-gram draft has signal;
+    the tree arm must match or beat linear acceptance while its draft
+    segment collapses to dispatch-only."""
+    from lmrs_tpu.engine.api import GenerationRequest
+    from lmrs_tpu.utils.env import env_override
+
+    setup_logging(quiet=True)
+    mc = ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, hidden_dim=128, max_seq_len=512,
+                     dtype="float32")
+    out = {}
+    for arm, k, tree in (("off", 0, "0"), ("linear", 4, "0"),
+                         ("tree", 4, "1")):
+        # the gate is read once at scheduler construction, so flipping
+        # the env per engine gives all three arms in one process
+        with env_override("LMRS_SPEC_TREE", tree):
+            eng = JaxEngine(EngineConfig(
+                backend="jax", scheduler="continuous", max_tokens=64,
+                max_batch_slots=4, seed=0, decode_block=4,
+                prefill_chunk=64, retry_delay=0.0, speculate_k=k), mc)
+        sched = eng._scheduler
+
+        def reqs(base):
+            # repetitive prompt: the acceptance-rich case (summaries
+            # quoting their source) — greedy, so arms are comparable
+            return [GenerationRequest(
+                prompt="the quick brown fox jumps over the lazy dog. " * 6,
+                request_id=base + i, temperature=0.0, max_new_tokens=48)
+                for i in range(8)]
+
+        eng.generate_batch(reqs(0))  # warmup: compiles every shape
+        an0 = sched.anatomy_snapshot()
+        m0 = sched.metrics
+        t0 = time.time()
+        res = eng.generate_batch(reqs(100))
+        wall = time.time() - t0
+        rep = sched.anatomy_report(an0)
+        st = sched._spec_tree_report(m0)
+        assert sched.audit() == [], "span/page accounting violated"
+        spec_cls = (rep.get("classes") or {}).get("spec") or {}
+        toks = sum(r.completion_tokens for r in res)
+        out[arm] = {
+            "tok_s": round(toks / wall, 1),
+            "accepted_tokens": (sched.metrics["spec_accepted_tokens"]
+                                - m0["spec_accepted_tokens"]),
+            "accept_per_step": st["accept_per_step"],
+            "mean_accept_depth": st["mean_accept_depth"],
+            "tree_dispatches": st["dispatches"],
+            "draft_ms_total": (rep.get("segments_ms") or {}).get("draft"),
+            "draft_p50_us_spec_step": (spec_cls.get("p50_us")
+                                       or {}).get("draft"),
+        }
+        eng.shutdown()
+    print(json.dumps(out, indent=1))
+    lin_d = out["linear"]["draft_ms_total"] or 0.0
+    tree_d = out["tree"]["draft_ms_total"] or 0.0
+    print(f"draft host-ms: linear={lin_d} tree={tree_d} "
+          f"({'COLLAPSED' if tree_d <= lin_d else 'REGRESSION'}); "
+          f"accept/step: linear={out['linear']['accept_per_step']} "
+          f"tree={out['tree']['accept_per_step']}", flush=True)
 
 
 def main():
@@ -192,7 +263,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if env_bool("LMRS_SPLIT_ANATOMY", False):
+    if env_bool("LMRS_SPLIT_SPEC_TREE", False):
+        spec_tree_main()
+    elif env_bool("LMRS_SPLIT_ANATOMY", False):
         anatomy_main()
     else:
         main()

@@ -60,6 +60,12 @@ TRACKED = {
     # waste — both live under the bench detail's windowed "anatomy" block
     "anatomy.host_overhead_us_step": "down",
     "anatomy.rpa_pad_waste_ratio": "down",
+    # tree speculation (ISSUE 19): accepted draft tokens per dispatched
+    # row must trend up, and the host draft segment must stay collapsed
+    # (drafting is fused on-device — a draft-segment climb means host
+    # n-gram scans crept back into the loop)
+    "spec_tree.accept_per_step": "up",
+    "anatomy.segments_ms.draft": "down",
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")

@@ -308,6 +308,24 @@ def test_session_fingerprint_gate_keeps_transcript(tmp_path):
         m3.append("cold", segs, refresh=True)["refresh"]["summary"]
 
 
+def test_cross_refresh_draft_hint_reaches_engine(tmp_path):
+    """Tree-speculation cross-refresh drafting (ISSUE 19): the SECOND
+    refresh's engine requests must carry the FIRST refresh's summary as
+    their draft hint (the previous summary is a near-perfect n-gram
+    draft source for a rolling summary restating itself).  The hint is
+    advisory — summary equality with a hint-free cold session is already
+    pinned by test_session_incremental_refresh_equals_cold."""
+    segs = make_segments(60, seed=9)
+    eng = MockEngine(seed=0)
+    m = SessionManager(eng, tmp_path, config=_live_cfg())
+    m.create(session_id="s")
+    r1 = m.append("s", segs[:30], refresh=True)["refresh"]
+    assert eng.draft_hints == []  # nothing to draft from on refresh 1
+    m.append("s", segs[30:], refresh=True)
+    assert eng.draft_hints, "second refresh carried no draft hint"
+    assert set(eng.draft_hints) == {r1["summary"]}
+
+
 def test_session_auto_refresh_threshold(tmp_path):
     """LMRS_LIVE_REFRESH_TOKENS semantics: appends auto-trigger a refresh
     once the appended-but-unsummarized token estimate crosses the

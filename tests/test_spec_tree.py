@@ -1,0 +1,443 @@
+"""Tree speculative decoding (ISSUE 19): on-device tree drafting,
+ancestor-mask verify on the ragged-span family, adaptive depth.
+
+Three layers of contract:
+
+* ops-level — ``draft_tree_lookup`` proposes the ``width`` most recent
+  n-gram continuations (root-deduped, depth-clamped); ``verify_tree``
+  preserves the root marginal exactly under sequential multi-candidate
+  rejection and degenerates to the longest argmax path on greedy rows;
+  the ancestor-bitmask generalization of ``ragged_spans_xla`` scores
+  every branch identically to per-branch LINEAR dispatches of the same
+  tokens (the mask is the only thing that changes).
+
+* scheduler-level — greedy outputs token-identical across no-spec /
+  linear (``LMRS_SPEC_TREE=0``) / tree over the prefix-cache x int8-KV
+  matrix; the kill switch keeps every tree counter at zero; the adaptive
+  ramp deepens on accept streaks, collapses to off on rejection streaks,
+  and re-probes periodically; draft hints are advisory (outputs
+  byte-identical with and without).
+
+* surface — the windowed ``spec_tree`` report block on the jax
+  scheduler, and the mock's deterministic emulation of the same block
+  (including the draft-hint acceptance bump deviceless CI asserts on).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lmrs_tpu.config import EngineConfig, ModelConfig
+from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.jax_engine import JaxEngine
+from lmrs_tpu.ops.paged_attention import pack_spans, ragged_spans_xla
+from lmrs_tpu.ops.speculative import draft_tree_lookup, verify_tree
+
+# --------------------------------------------------------------- ops level
+
+
+def test_draft_tree_lookup_most_recent_first():
+    # query bigram (5,6) recurs at 0 (-> 7), 4 (-> 8), 8 (-> 9); the
+    # query occurrence itself (pos 12) is excluded.  width=2 keeps the
+    # two most recent, most recent first.
+    hist = [5, 6, 7, 1, 5, 6, 8, 2, 5, 6, 9, 3, 5, 6]
+    buf = jnp.asarray([hist + [0] * 2])
+    chains, nv = draft_tree_lookup(buf, jnp.asarray([len(hist)]), k=2,
+                                   width=2)
+    assert nv[0].tolist() == [2, 2]
+    assert chains[0, 0].tolist() == [9, 3]   # pos 8, most recent
+    assert chains[0, 1].tolist() == [8, 2]   # pos 4
+
+
+def test_draft_tree_lookup_dedups_duplicate_roots():
+    # both earlier (5,6) occurrences continue with 7 — a duplicate root
+    # candidate has zero residual mass under sequential rejection, so
+    # the older chain is dropped (n_valid 0)
+    hist = [5, 6, 7, 5, 6, 7, 5, 6]
+    buf = jnp.asarray([hist + [0] * 2])
+    chains, nv = draft_tree_lookup(buf, jnp.asarray([len(hist)]), k=2,
+                                   width=2)
+    assert int(nv[0, 0]) > 0
+    assert int(chains[0, 0, 0]) == 7
+    assert int(nv[0, 1]) == 0
+
+
+def test_draft_tree_lookup_depth_clamp():
+    hist = [5, 6, 7, 1, 5, 6, 8, 2, 5, 6]
+    buf = jnp.asarray([hist + [0] * 3])
+    _, nv_full = draft_tree_lookup(buf, jnp.asarray([len(hist)]), k=3,
+                                   width=2)
+    _, nv_one = draft_tree_lookup(buf, jnp.asarray([len(hist)]), k=3,
+                                  width=2, depth=jnp.asarray([1]))
+    assert int(nv_full.max()) > 1
+    assert int(nv_one.max()) == 1
+    _, nv_off = draft_tree_lookup(buf, jnp.asarray([len(hist)]), k=3,
+                                  width=2, depth=jnp.asarray([0]))
+    assert int(nv_off.max()) == 0
+
+
+def test_verify_tree_greedy_picks_matching_chain():
+    """One-hot (greedy) node distributions: the chain whose first token
+    is the root argmax wins, its matching prefix is accepted, and the
+    bonus comes from the last accepted node."""
+    v, W, k = 8, 2, 2
+    probs = np.zeros((1, 1 + W * k, v), np.float32)
+    probs[0, 0, 4] = 1.0          # root wants 4
+    probs[0, 3, 5] = 1.0          # after chain-1 token 0 (slot 1+k): 5
+    probs[0, 4, 6] = 1.0          # after chain-1 token 1: bonus 6
+    probs[0, 1, 7] = 1.0          # chain-0 nodes (never reached)
+    probs[0, 2, 7] = 1.0
+    chains = jnp.asarray([[[3, 9 % v], [4, 5]]], jnp.int32)
+    nv = jnp.asarray([[2, 2]], jnp.int32)
+    emit, count, chain, depth = verify_tree(
+        jnp.asarray(probs), chains, nv, jax.random.PRNGKey(0))
+    assert int(chain[0]) == 1
+    assert int(depth[0]) == 2
+    assert int(count[0]) == 3
+    assert emit[0, :3].tolist() == [4, 5, 6]
+
+
+def test_verify_tree_greedy_rejects_all_when_no_chain_matches():
+    v, W, k = 8, 2, 2
+    probs = np.zeros((1, 1 + W * k, v), np.float32)
+    probs[0, :, 2] = 1.0          # root argmax 2, no candidate proposes it
+    chains = jnp.asarray([[[3, 3], [4, 4]]], jnp.int32)
+    nv = jnp.asarray([[2, 2]], jnp.int32)
+    emit, count, chain, depth = verify_tree(
+        jnp.asarray(probs), chains, nv, jax.random.PRNGKey(1))
+    assert int(chain[0]) == -1
+    assert int(depth[0]) == 0
+    assert int(count[0]) == 1
+    assert int(emit[0, 0]) == 2   # the root argmax still comes out
+
+
+def test_verify_tree_preserves_root_marginal():
+    """The first emitted token's marginal must equal the root
+    distribution exactly — the SpecInfer sequential-rejection guarantee,
+    candidate-set-independent."""
+    v, W, k = 4, 2, 1
+    rng = np.random.default_rng(0)
+    node = rng.dirichlet(np.ones(v), size=1 + W * k).astype(np.float32)
+    probs = jnp.asarray(node[None])           # [1, 3, V]
+    chains = jnp.asarray([[[2], [3]]], jnp.int32)
+    nv = jnp.asarray([[1, 1]], jnp.int32)
+
+    n = 4000
+    emit, _, _, _ = jax.vmap(
+        lambda key: verify_tree(probs, chains, nv, key)
+    )(jax.random.split(jax.random.PRNGKey(7), n))
+    first = np.asarray(emit[:, 0, 0])
+    freq = np.bincount(first, minlength=v) / n
+    np.testing.assert_allclose(freq, node[0], atol=0.03)
+
+
+def test_verify_tree_count_bounds():
+    v, W, k = 8, 3, 3
+    rng = np.random.default_rng(2)
+    probs = jnp.asarray(
+        rng.dirichlet(np.ones(v), size=(2, 1 + W * k)).astype(np.float32))
+    chains = jnp.asarray(rng.integers(0, v, (2, W, k)), jnp.int32)
+    nv = jnp.asarray([[3, 2, 0], [0, 0, 0]], jnp.int32)
+    emit, count, chain, depth = verify_tree(probs, chains, nv,
+                                            jax.random.PRNGKey(4))
+    c = np.asarray(count)
+    d = np.asarray(depth)
+    assert ((1 <= c) & (c <= k + 1)).all()
+    assert (d == c - 1).all()
+    assert int(chain[1]) == -1 and int(count[1]) == 1  # all-invalid row
+
+
+def _anc_fixture(seed, q_lens, h=4, kh=2, hd=16, ps=16, n_pages=16,
+                 width=2):
+    b = len(q_lens)
+    qs, total = pack_spans(np.asarray(q_lens, np.int32))
+    rng = jax.random.split(jax.random.PRNGKey(seed), 5)
+    qf = jax.random.normal(rng[0], (total, h, hd), jnp.float32)
+    knf = jax.random.normal(rng[1], (total, kh, hd), jnp.float32)
+    vnf = jax.random.normal(rng[2], (total, kh, hd), jnp.float32)
+    kp = jax.random.normal(rng[3], (n_pages, kh, ps, hd), jnp.float32)
+    vp = jax.random.normal(rng[4], (n_pages, kh, ps, hd), jnp.float32)
+    tables = jnp.asarray(
+        np.random.default_rng(seed).permutation(n_pages - 1)[: b * width]
+        .reshape(b, width) + 1, jnp.int32)
+    row_flat = np.full((total,), b, np.int32)
+    for i, (s, l) in enumerate(zip(qs, q_lens)):
+        row_flat[s:s + l] = i
+    return qs, total, qf, knf, vnf, kp, vp, tables, row_flat
+
+
+def test_ancestor_mask_matches_per_branch_linear_dispatch():
+    """The tree span [cur, chain0 (k), chain1 (k)] under ancestor
+    bitmasks must produce, for every node, EXACTLY the attention output
+    a linear span [cur, chain_c] produces for that node on fresh pools —
+    column layout differs (chain-1 lands at healed columns) but the
+    visible key/value SET is identical, and that is all attention sees."""
+    k, W = 2, 2
+    base = 21
+    q_lens = [1 + W * k]
+    qs, total, qf, knf, vnf, kp, vp, tables, row_flat = _anc_fixture(
+        5, q_lens)
+    s0 = qs[0]
+
+    # host-built ancestor masks: cur keeps the linear sentinel (0);
+    # chain c node j sees {cur} + its own chain prefix through itself
+    anc = np.zeros((total,), np.uint32)
+    for c in range(W):
+        bits = 1  # bit 0 = cur
+        for j in range(k):
+            o = 1 + c * k + j
+            bits |= np.uint32(1) << np.uint32(o)
+            anc[s0 + o] = bits
+    got, _, _ = ragged_spans_xla(
+        qf, knf, vnf, kp, vp, tables, jnp.asarray([base], jnp.int32),
+        jnp.asarray(qs), jnp.asarray(q_lens, jnp.int32),
+        jnp.asarray(row_flat), anc_masks=jnp.asarray(anc.view(np.int32)))
+
+    for c in range(W):
+        # linear reference: [cur, chain_c] as a plain causal span over
+        # fresh pools; flat tokens re-packed into the reference layout
+        lin_lens = [1 + k]
+        lqs, ltotal = pack_spans(np.asarray(lin_lens, np.int32))
+        sel = [s0] + [s0 + 1 + c * k + j for j in range(k)]
+        pad = ltotal - len(sel)
+
+        def lay(x):
+            picked = jnp.stack([x[i] for i in sel])
+            return jnp.concatenate(
+                [picked, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
+
+        lrow = np.full((ltotal,), 1, np.int32)
+        lrow[lqs[0]:lqs[0] + lin_lens[0]] = 0
+        want, _, _ = ragged_spans_xla(
+            lay(qf), lay(knf), lay(vnf), kp, vp, tables,
+            jnp.asarray([base], jnp.int32), jnp.asarray(lqs),
+            jnp.asarray(lin_lens, jnp.int32), jnp.asarray(lrow))
+        # cur's output must agree (it sees only committed context + self
+        # in both layouts), and every chain-c node must agree
+        np.testing.assert_allclose(np.asarray(got[s0]),
+                                   np.asarray(want[lqs[0]]),
+                                   rtol=2e-5, atol=2e-5)
+        for j in range(k):
+            np.testing.assert_allclose(
+                np.asarray(got[s0 + 1 + c * k + j]),
+                np.asarray(want[lqs[0] + 1 + j]),
+                rtol=2e-5, atol=2e-5)
+
+
+def test_ancestor_mask_zero_rows_keep_linear_rule():
+    """A dispatch mixing an all-zero-mask span with a tree span must
+    score the zero-mask span exactly as the no-mask call does (the
+    sentinel keeps linear spans byte-identical)."""
+    q_lens = [3, 5]
+    qs, total, qf, knf, vnf, kp, vp, tables, row_flat = _anc_fixture(
+        6, q_lens)
+    bases = jnp.asarray([10, 4], jnp.int32)
+    anc = np.zeros((total,), np.uint32)
+    s1 = qs[1]  # row 1 becomes a [cur, chain0(2), chain1(2)] tree span
+    for c in range(2):
+        bits = 1
+        for j in range(2):
+            o = 1 + c * 2 + j
+            bits |= np.uint32(1) << np.uint32(o)
+            anc[s1 + o] = bits
+    got, _, _ = ragged_spans_xla(
+        qf, knf, vnf, kp, vp, tables, bases, jnp.asarray(qs),
+        jnp.asarray(q_lens, jnp.int32), jnp.asarray(row_flat),
+        anc_masks=jnp.asarray(anc.view(np.int32)))
+    want, _, _ = ragged_spans_xla(
+        qf, knf, vnf, kp, vp, tables, bases, jnp.asarray(qs),
+        jnp.asarray(q_lens, jnp.int32), jnp.asarray(row_flat))
+    s0 = qs[0]
+    np.testing.assert_allclose(np.asarray(got[s0:s0 + 3]),
+                               np.asarray(want[s0:s0 + 3]),
+                               rtol=2e-6, atol=2e-6)
+
+
+# --------------------------------------------------------- scheduler level
+
+
+def tiny_model():
+    return ModelConfig(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                       n_kv_heads=2, hidden_dim=128, max_seq_len=256,
+                       dtype="float32")
+
+
+def _cfg(**kw) -> EngineConfig:
+    base = dict(backend="jax", scheduler="continuous", max_tokens=20,
+                max_batch_slots=2, seed=0, decode_block=3,
+                prefill_chunk=64, mixed_batch=True)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _requests(n: int = 4) -> list[GenerationRequest]:
+    # repetitive bodies: the n-gram draft actually fires
+    reqs = []
+    for i in range(n):
+        body = f"request {i} " + "the cat sat on the mat " * (2 + i % 2)
+        reqs.append(GenerationRequest(prompt=body, request_id=i,
+                                      temperature=0.0,
+                                      max_new_tokens=12 + i))
+    return reqs
+
+
+def _run(cfg: EngineConfig, mc, reqs):
+    eng = JaxEngine(cfg, mc)
+    out = eng.generate_batch(reqs)
+    sched = eng._scheduler
+    assert sched.audit() == []
+    assert all(r.error is None for r in out)
+    texts = [(r.text, r.finish_reason, r.completion_tokens) for r in out]
+    m = dict(sched.metrics)
+    rep = sched._spec_tree_report()
+    eng.shutdown()
+    return texts, m, rep
+
+
+@pytest.mark.parametrize("prefix_cache,kv_q", [(True, None),
+                                               (False, "int8")])
+def test_spec_tree_greedy_identity_matrix(monkeypatch, prefix_cache, kv_q):
+    """The ISSUE 19 acceptance bar: greedy outputs token-identical
+    across no-spec / linear spec (LMRS_SPEC_TREE=0) / tree spec, with
+    mixed batches armed, over prefix-cache and int8-KV compositions —
+    and the tree arm must actually dispatch tree spans while the linear
+    arm keeps every tree counter at zero (the kill-switch contract)."""
+    mc = tiny_model()
+    reqs = _requests()
+    kw = dict(prefix_cache=prefix_cache)
+    if kv_q:
+        kw.update(page_size=32, kv_quantize=kv_q)
+    want, _, _ = _run(_cfg(speculate_k=0, **kw), mc, reqs)
+    monkeypatch.setenv("LMRS_SPEC_TREE", "0")
+    lin, m_lin, rep_lin = _run(_cfg(speculate_k=3, **kw), mc, reqs)
+    assert m_lin["spec_tree_dispatches"] == 0
+    assert rep_lin["enabled"] is False
+    monkeypatch.setenv("LMRS_SPEC_TREE", "1")
+    tree, m_tree, rep_tree = _run(_cfg(speculate_k=3, **kw), mc, reqs)
+    assert m_tree["spec_tree_dispatches"] > 0, "tree path not exercised"
+    assert rep_tree["enabled"] is True
+    assert lin == want
+    assert tree == want
+
+
+def test_spec_tree_fuzzed_admission_audit_clean(monkeypatch):
+    """Varied lengths / budgets / temperatures through the tree path on
+    small slot counts (admission churn, preemption pressure): every
+    invariant audit stays clean and every request terminates in budget."""
+    monkeypatch.setenv("LMRS_SPEC_TREE", "1")
+    rng = np.random.default_rng(11)
+    words = ["alpha", "beta", "gamma", "delta", "the", "cat", "sat"]
+    reqs = []
+    for i in range(7):
+        body = " ".join(rng.choice(words, 8 + 10 * (i % 3)).tolist())
+        reqs.append(GenerationRequest(
+            prompt=(body + " ") * (1 + i % 2), request_id=i,
+            temperature=float(rng.choice([0.0, 0.8])),
+            top_k=int(rng.choice([0, 40])),
+            max_new_tokens=int(rng.integers(4, 18))))
+    eng = JaxEngine(_cfg(speculate_k=3, max_batch_slots=3), tiny_model())
+    out = eng.generate_batch(reqs)
+    sched = eng._scheduler
+    assert sched.audit() == []
+    assert sched.metrics["spec_tree_dispatches"] > 0
+    eng.shutdown()
+    for i, r in enumerate(out):
+        assert r.error is None
+        assert 0 < r.completion_tokens <= reqs[i].max_new_tokens
+
+
+def test_spec_ramp_adaptive_up_down_and_probe():
+    eng = JaxEngine(_cfg(speculate_k=4), tiny_model())
+    sched = eng._scheduler
+    st = SimpleNamespace(spec_ema=0.9, spec_probe=0)
+    assert sched._spec_ramp(st, 2) == 3           # accept streak: deepen
+    assert sched._spec_ramp(st, 4) == 4           # capped at k
+    st.spec_ema = 0.3
+    assert sched._spec_ramp(st, 3) == 2           # soft collapse: shallower
+    assert sched._spec_ramp(st, 1) == 1           # floored at 1
+    st.spec_ema = 0.1
+    assert sched._spec_ramp(st, 2) == 0           # hard collapse: off
+    # off rows re-probe at half depth every 8 idle steps, EMA reset
+    st = SimpleNamespace(spec_ema=0.05, spec_probe=0)
+    depths = [sched._spec_ramp(st, 0) for _ in range(8)]
+    assert depths[:7] == [0] * 7
+    assert depths[7] == max(1, sched.spec_k // 2)
+    assert st.spec_ema == 0.5 and st.spec_probe == 0
+    eng.shutdown()
+
+
+def test_draft_hint_is_advisory_for_greedy_outputs(monkeypatch):
+    """A draft hint may only change WHERE tokens come from, never which
+    tokens come out: greedy outputs byte-identical with and without."""
+    monkeypatch.setenv("LMRS_SPEC_TREE", "1")
+    mc = tiny_model()
+    plain = _requests(3)
+    want, _, _ = _run(_cfg(speculate_k=3), mc, plain)
+    hinted = _requests(3)
+    for r in hinted:
+        r.draft_hint = "the cat sat on the mat the cat sat on the mat"
+    got, m, _ = _run(_cfg(speculate_k=3), mc, hinted)
+    assert m["spec_tree_dispatches"] > 0
+    assert got == want
+
+
+def test_spec_tree_report_block_shape():
+    eng = JaxEngine(_cfg(speculate_k=3), tiny_model())
+    eng.generate_batch(_requests(3))
+    sched = eng._scheduler
+    m = sched.metrics
+    blk = sched.metrics_report()["spec_tree"]
+    assert blk["enabled"] is True
+    assert blk["dispatches"] == m["spec_tree_dispatches"] > 0
+    assert blk["width"] >= 1 and isinstance(blk["adaptive"], bool)
+    rows = m["spec_tree_rows"]
+    assert blk["mean_accept_depth"] == pytest.approx(
+        m["spec_accept_depth_sum"] / rows if rows else 0.0, abs=1e-3)
+    assert blk["accept_per_step"] == pytest.approx(
+        m["spec_accepted_tokens"] / rows if rows else 0.0, abs=1e-3)
+    eng.shutdown()
+    off = JaxEngine(_cfg(speculate_k=0), tiny_model())
+    off.generate_batch(_requests(2))
+    assert "spec_tree" not in off._scheduler.metrics_report()
+    off.shutdown()
+
+
+# ---------------------------------------------------------------- mock arm
+
+
+def test_mock_engine_spec_tree_block(monkeypatch):
+    """No-device parity: same gate composition, same block keys, and the
+    deterministic hint bump (full-depth acceptance on hinted requests)
+    that the live cross-refresh CI leans on."""
+    from lmrs_tpu.engine.mock import MockEngine
+
+    reqs = [GenerationRequest(prompt="alpha beta gamma " * 20,
+                              request_id=i) for i in range(3)]
+    eng = MockEngine(speculate_k=4)
+    assert eng.spec_tree
+    eng.generate_batch(reqs)
+    blk = eng.engine_metrics()["spec_tree"]
+    assert blk["enabled"] and blk["dispatches"] > 0
+    assert blk["accept_per_step"] == pytest.approx(2.0)  # k//2, unhinted
+    assert eng.draft_hints == []
+
+    hinted = [GenerationRequest(prompt="alpha beta gamma " * 20,
+                                request_id=10 + i,
+                                draft_hint="prior summary text")
+              for i in range(2)]
+    eng2 = MockEngine(speculate_k=4)
+    eng2.generate_batch(hinted)
+    blk2 = eng2.engine_metrics()["spec_tree"]
+    assert blk2["accept_per_step"] == pytest.approx(4.0)  # full depth
+    assert eng2.draft_hints == ["prior summary text"] * 2
+
+    monkeypatch.setenv("LMRS_SPEC_TREE", "0")
+    off = MockEngine(speculate_k=4)
+    assert not off.spec_tree
+    off.generate_batch(reqs)
+    assert "spec_tree" not in off.engine_metrics()

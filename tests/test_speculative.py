@@ -176,6 +176,29 @@ def test_spec_greedy_through_multi_kernel_matches_plain(monkeypatch):
     assert got == want
 
 
+def test_draft_lookup_match_near_buffer_end_regression():
+    """A match whose k-token source window runs past the unpadded buffer
+    end — the LIVE context, exactly the occurrence worth drafting from —
+    used to be dropped (or slid onto unrelated tokens by the dynamic-
+    slice clip).  The padded buffer keeps it, clipped to real history."""
+    hist = [7, 7, 5, 6, 9, 5, 6]
+    buf = jnp.asarray([hist])  # NO slack: L == hist_len
+    draft, n = draft_lookup(buf, jnp.asarray([len(hist)]), k=3)
+    assert int(n[0]) == 3
+    assert draft[0].tolist() == [9, 5, 6]
+
+
+def test_draft_lookup_never_matches_query_itself():
+    """The query n-gram's own occurrence (idx + n == hist_len) must not
+    count as a match — a self-match would draft the padding after the
+    history end."""
+    hist = [1, 2, 3, 4, 1, 2]
+    buf = jnp.asarray([hist + [0] * 4])
+    draft, n = draft_lookup(buf, jnp.asarray([len(hist)]), k=2)
+    assert int(n[0]) == 2
+    assert draft[0].tolist() == [3, 4]  # from pos 0, not the query at 4
+
+
 def test_draft_lookup_ngram3_rejects_bigram_collision():
     """n=3 must skip a position where only the last TWO tokens match — the
     byte-vocab collision class that capped trained-model acceptance at ~1
