@@ -245,6 +245,21 @@ class EngineConfig:
     # entry bigger than the whole budget skips the spill entirely
     host_kv_gb: float = field(
         default_factory=lambda: _env("LMRS_HOST_KV_GB", 1.0, float))
+    # Disk spill tier (engine/host_kv.DiskKVPool, ROADMAP item 4): host
+    # pool budget pressure demotes LRU entries to mmap'd spill files
+    # instead of dropping them; promotion reads disk→host→device on the
+    # prefetch path.  OPT-IN (writing GBs of KV to disk is a deployment
+    # decision); LMRS_KV_DISK=0 restores host-pressure-means-gone
+    # byte-for-byte.  Only meaningful with the host tier armed.
+    kv_disk: bool = field(
+        default_factory=lambda: _env("LMRS_KV_DISK", False, bool))
+    # disk pool budget in GiB (LRU subtree drops past it)
+    kv_disk_gb: float = field(
+        default_factory=lambda: _env("LMRS_KV_DISK_GB", 4.0, float))
+    # spill-file root directory ("" = system temp); each pool makes its
+    # own fresh subdirectory, so engines sharing the root never collide
+    kv_disk_dir: str = field(
+        default_factory=lambda: _env("LMRS_KV_DISK_DIR", ""))
     # engine-side tokenizer spec ("" = model default: byte for random-init
     # vocabs, the checkpoint's tokenizer for real ones).  Accepts the same
     # forms as data.tokenizer.get_tokenizer: "byte", a *.model SentencePiece
@@ -298,6 +313,10 @@ class EngineConfig:
             raise ValueError(f"host_kv_gb must be >= 0 "
                              f"(got {self.host_kv_gb}); use host_kv=False / "
                              "LMRS_HOST_KV=0 to disable the spill tier")
+        if self.kv_disk_gb < 0:
+            raise ValueError(f"kv_disk_gb must be >= 0 "
+                             f"(got {self.kv_disk_gb}); use kv_disk=False / "
+                             "LMRS_KV_DISK=0 to disable the disk tier")
         if self.request_deadline_s < 0:
             raise ValueError(f"request_deadline_s must be >= 0 "
                              f"(got {self.request_deadline_s}); 0 disables "

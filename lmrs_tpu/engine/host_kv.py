@@ -30,13 +30,51 @@ Design notes
 * An entry larger than the whole budget is refused (``fits`` is checked
   by the caller BEFORE capture, so an oversized node skips the device→host
   gather entirely and frees exactly as with the tier off).
+
+Disk tier (ROADMAP item 4)
+--------------------------
+:class:`DiskKVPool` is the THIRD tier: host-pool budget pressure demotes
+the LRU host entry's payload to an mmap'd spill file instead of dropping
+it (``LMRS_KV_DISK=1``, budget ``LMRS_KV_DISK_GB``, directory
+``LMRS_KV_DISK_DIR``).  The radix node stays in the tree; its ``spill``
+payload becomes a small *descriptor* dict (``{"disk": True, "path", ...,
+"crc"}``) and promotion reads the file back (disk→host memory) on the
+same prefetch path that already restores host entries to the device.
+Every file is content-tagged with a crc32 the read path verifies: a
+missing, torn, or corrupt file surfaces as :class:`DiskReadError` and the
+caller degrades to re-prefill — never silently-wrong KV, never a wedged
+admission (the ``kv.disk_read`` fault contract, docs/ROBUSTNESS.md).
+Recency stays the node's radix ``tick`` — ONE LRU clock across all three
+tiers.  Disk budget pressure drops LRU disk subtrees for real.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import tempfile
+import zlib
+
+import numpy as np
 
 logger = logging.getLogger("lmrs.host_kv")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Dtype from its string name, covering the ml_dtypes extensions
+    (bfloat16 et al.) numpy alone does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always present with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class DiskReadError(RuntimeError):
+    """A disk-tier payload could not be read back (missing, torn, or
+    corrupt spill file).  Callers degrade to re-prefill — the same
+    contract as a host entry dropped between match and prefetch."""
 
 
 class HostKVPool:
@@ -44,11 +82,14 @@ class HostKVPool:
     payload arrays live on the owning radix nodes).  All methods run on
     the scheduler thread — no locking, same contract as PrefixCache."""
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, disk: "DiskKVPool | None" = None):
         self.budget_bytes = max(0, int(budget_bytes))
         self.used_bytes = 0
+        # optional third tier: budget pressure demotes the LRU entry here
+        # instead of dropping it (PrefixCache._enforce_host_budget)
+        self.disk = disk
         # id(node) -> (node, nbytes).  Recency is the node's own radix
-        # ``tick`` (one LRU clock across both tiers — a prefetch-hit or
+        # ``tick`` (one LRU clock across all tiers — a prefetch-hit or
         # re-match bumps it exactly like a resident hit).
         self.entries: dict[int, tuple[object, int]] = {}
         # cumulative counters (PrefixCache.stats / metrics_report feed)
@@ -102,11 +143,157 @@ class HostKVPool:
         return best
 
     def stats(self) -> dict:
-        return {
+        out = {
             "host_pool_entries": len(self.entries),
             "host_pool_bytes": self.used_bytes,
             "host_pool_budget_bytes": self.budget_bytes,
             "spilled_pages_total": self.spilled_pages_total,
             "prefetched_pages_total": self.prefetched_pages_total,
             "host_dropped_pages_total": self.dropped_pages_total,
+        }
+        if self.disk is not None:
+            out.update(self.disk.stats())
+        return out
+
+
+class DiskKVPool:
+    """Bounded disk tier under the host pool: accounting + spill-file
+    I/O.  Like :class:`HostKVPool` the pool stores references to radix
+    nodes and never mutates the tree; unlike it, the node's payload is a
+    *descriptor* dict pointing at one spill file (raw k-bytes then
+    v-bytes, crc32 content tag).  Files land in a fresh per-pool
+    subdirectory of ``dir_path`` (system temp when empty), so concurrent
+    engines in one process never collide.  Single-threaded by the same
+    scheduler-thread contract as the host pool."""
+
+    def __init__(self, budget_bytes: int, dir_path: str = ""):
+        self.budget_bytes = max(0, int(budget_bytes))
+        if dir_path:
+            os.makedirs(dir_path, exist_ok=True)
+        self.dir = tempfile.mkdtemp(prefix="lmrs-kvd-",
+                                    dir=dir_path or None)
+        self.used_bytes = 0
+        # id(node) -> (node, nbytes); recency is the node's radix tick —
+        # the ONE LRU clock shared by all three tiers
+        self.entries: dict[int, tuple[object, int]] = {}
+        self._seq = 0
+        self.demoted_pages_total = 0
+        self.promoted_pages_total = 0
+        self.dropped_pages_total = 0
+        self.read_failures_total = 0
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    # ------------------------------------------------------------ accounting
+
+    def fits(self, nbytes: int) -> bool:
+        return 0 < nbytes <= self.budget_bytes
+
+    def add(self, node, nbytes: int, n_pages: int) -> None:
+        """Admit a demoted node (caller guarantees ``fits``); budget
+        enforcement is the caller's separate pass, exactly like the host
+        pool (victim subtree drops need the tree)."""
+        self.entries[id(node)] = (node, int(nbytes))
+        self.used_bytes += int(nbytes)
+        self.demoted_pages_total += n_pages
+
+    def remove(self, node, n_pages: int = 0, dropped: bool = False) -> None:
+        ent = self.entries.pop(id(node), None)
+        if ent is None:
+            return
+        self.used_bytes -= ent[1]
+        if dropped:
+            self.dropped_pages_total += n_pages
+
+    def note_promote(self, n_pages: int) -> None:
+        self.promoted_pages_total += n_pages
+
+    def over_budget(self) -> bool:
+        return self.used_bytes > self.budget_bytes
+
+    def victim(self, keep=None):
+        """LRU disk entry (min radix tick) outside ``keep``, or None —
+        same contract as HostKVPool.victim."""
+        best = None
+        for node, _nbytes in self.entries.values():
+            if keep and id(node) in keep:
+                continue
+            if best is None or node.tick < best.tick:
+                best = node
+        return best
+
+    # ------------------------------------------------------------- file I/O
+
+    def write(self, payload: dict) -> dict:
+        """Persist a host payload's k/v arrays as one spill file and
+        return the descriptor that replaces the node's in-memory payload.
+        Raises ``OSError`` on a failed write (disk full, bad dir) — the
+        caller degrades to dropping the entry."""
+        k, v = payload["k"], payload["v"]
+        kb = np.ascontiguousarray(k).tobytes()
+        vb = np.ascontiguousarray(v).tobytes()
+        crc = zlib.crc32(vb, zlib.crc32(kb))
+        self._seq += 1
+        path = os.path.join(self.dir, f"kv-{self._seq}.bin")
+        tmp = path + ".tmp"
+        # write-then-rename: a crash mid-write leaves a .tmp, never a
+        # half-file under the live name; the crc catches everything else
+        with open(tmp, "wb") as f:
+            f.write(kb)
+            f.write(vb)
+        os.replace(tmp, path)
+        return {"disk": True, "path": path, "nbytes": len(kb) + len(vb),
+                "k_shape": [int(s) for s in k.shape],
+                "v_shape": [int(s) for s in v.shape],
+                "k_dtype": str(k.dtype), "v_dtype": str(v.dtype),
+                "dtype": payload.get("dtype"), "crc": crc}
+
+    def read(self, desc: dict) -> dict:
+        """mmap a spill file back into a host payload (the returned k/v
+        arrays are copies — the file can drop immediately after).  Raises
+        :class:`DiskReadError` on a missing, short, torn, or corrupt
+        file; the caller counts the failure and re-prefills."""
+        path = desc["path"]
+        try:
+            mm = np.memmap(path, dtype=np.uint8, mode="r")
+        except (OSError, ValueError) as e:
+            raise DiskReadError(f"disk spill unreadable: {e}") from e
+        try:
+            if int(mm.shape[0]) != int(desc["nbytes"]):
+                raise DiskReadError(
+                    f"disk spill torn: {int(mm.shape[0])} bytes on disk, "
+                    f"descriptor says {desc['nbytes']}")
+            if zlib.crc32(mm) != desc["crc"]:
+                raise DiskReadError("disk spill corrupt (crc mismatch)")
+            kd = _np_dtype(desc["k_dtype"])
+            ks = tuple(int(s) for s in desc["k_shape"])
+            kn = int(np.prod(ks)) * kd.itemsize
+            k = np.frombuffer(mm[:kn], dtype=kd).reshape(ks).copy()
+            v = np.frombuffer(mm[kn:], dtype=_np_dtype(desc["v_dtype"])) \
+                .reshape(tuple(int(s) for s in desc["v_shape"])).copy()
+        except ValueError as e:
+            # descriptor/file disagreement the size+crc guards missed
+            raise DiskReadError(f"disk spill unparseable: {e}") from e
+        finally:
+            del mm
+        return {"k": k, "v": v, "dtype": desc.get("dtype")}
+
+    def free(self, desc: dict) -> None:
+        """Drop an entry's spill file (promotion or subtree drop); a
+        missing file is fine — free must be idempotent."""
+        try:
+            os.unlink(desc["path"])
+        except OSError:
+            pass
+
+    def stats(self) -> dict:
+        return {
+            "disk_pool_entries": len(self.entries),
+            "disk_pool_bytes": self.used_bytes,
+            "disk_pool_budget_bytes": self.budget_bytes,
+            "disk_demoted_pages_total": self.demoted_pages_total,
+            "disk_promoted_pages_total": self.promoted_pages_total,
+            "disk_dropped_pages_total": self.dropped_pages_total,
+            "disk_read_failures_total": self.read_failures_total,
         }

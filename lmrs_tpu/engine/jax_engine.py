@@ -313,6 +313,22 @@ class JaxEngine:
             return 0
         return self._scheduler.sweep_handoffs(now)
 
+    # ------------------------------------------------- KV-fabric migration
+    # (optional Engine surface, same getattr convention): page-SET
+    # export/import for cross-host preamble migration — the scheduler
+    # implements the real radix walk; the static scheduler has no prefix
+    # cache to export, so the hooks answer cold/unsupported there.
+
+    def kv_export(self, preamble: str) -> dict | None:
+        if self._scheduler is None:
+            return None
+        return self._scheduler.kv_export(preamble)
+
+    def kv_import(self, payload: dict) -> int:
+        if self._scheduler is None:
+            raise RuntimeError("static scheduler has no prefix cache")
+        return self._scheduler.kv_import(payload)
+
     def metrics_registry(self):
         """Optional Engine hook (same getattr convention as ``cancel``):
         the typed registry behind engine_metrics(), or None for the static

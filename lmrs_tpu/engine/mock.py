@@ -122,6 +122,9 @@ class MockEngine:
         self._spill_pages = 0               # guarded-by: _prefix_lock
         self._prefetch_pages = 0            # guarded-by: _prefix_lock
         self._host_dropped_pages = 0        # guarded-by: _prefix_lock
+        self._migrate_exports = 0           # guarded-by: _prefix_lock
+        self._migrate_imports = 0           # guarded-by: _prefix_lock
+        self._migrate_tokens = 0            # guarded-by: _prefix_lock
         self._mixed_lock = threading.Lock()
         self._mixed_dispatches = 0  # guarded-by: _mixed_lock
         self._mixed_piggybacked = 0  # guarded-by: _mixed_lock
@@ -621,6 +624,57 @@ class MockEngine:
                 })
         return out
 
+    # ------------------------------------------------- KV-fabric migration
+    # (optional Engine surface, same getattr convention as the handoff
+    # hooks): page-set export/import on the no-device arm.  The mock's
+    # "page set" is the emulated prefix entry itself — tokens plus a
+    # deterministic content tag — so a migrated preamble counts as a
+    # prefix HIT on the importing host (the chaos gate's fabric-token
+    # assertion) without any device bytes moving.
+
+    def kv_export(self, preamble: str) -> dict | None:
+        """Wire payload for one warm preamble, or None when the cache is
+        off / the preamble is cold (the server's 404 path).  Read-only:
+        the exporting cache keeps its entry (source stays warm until it
+        drains away naturally)."""
+        if not self.prefix_cache:
+            return None
+        faults.fire("migrate.export")
+        with self._prefix_lock:
+            ent = self._prefix.get(preamble)
+            if ent is None:
+                return None
+            self._migrate_exports += 1
+            return {"kind": "kv_pageset", "version": 1, "emu": True,
+                    "preamble": preamble, "tokens": ent["tokens"],
+                    "seed": self.seed}
+
+    def kv_import(self, payload: dict) -> int:
+        """Install a migrated page set as a warm resident prefix entry.
+        Geometry mismatch (a jax page-set payload, or a mock arm with a
+        different seed — different completion bytes) raises ValueError:
+        the server answers 409/4xx and the router falls back to cold
+        resume, never a silently-wrong cache hit."""
+        if not self.prefix_cache:
+            raise RuntimeError("prefix cache disabled")
+        if payload.get("kind") != "kv_pageset" or not payload.get("emu"):
+            raise ValueError("not an emulated kv_pageset payload")
+        if payload.get("seed", self.seed) != self.seed:
+            raise ValueError("mock seed mismatch: emulated KV bytes differ")
+        key = payload["preamble"]
+        tokens = int(payload["tokens"])
+        if not key or tokens <= 0:
+            raise ValueError("malformed kv_pageset payload")
+        faults.fire("migrate.import")
+        with self._prefix_lock:
+            self._prefix_tick += 1
+            self._prefix[key] = {"tokens": tokens, "tier": "resident",
+                                 "tick": self._prefix_tick}
+            self._migrate_imports += 1
+            self._migrate_tokens += tokens
+            self._enforce_emulated_budgets()
+        return tokens
+
     def _bill(self, req: GenerationRequest,
               res: GenerationResult) -> None:
         """Deterministic ledger entry for one finished mock request:
@@ -730,6 +784,15 @@ class MockEngine:
                     "spill_pages": self._spill_pages,
                     "prefetch_pages": self._prefetch_pages,
                     "dropped_pages_total": self._host_dropped_pages,
+                }
+            if self._migrate_exports or self._migrate_imports:
+                # same report-nothing-when-idle contract as the other
+                # blocks: with LMRS_KV_MIGRATE=0 no migration ever runs,
+                # so the block is absent and metrics stay byte-identical
+                out["kv_migrate"] = {
+                    "exports": self._migrate_exports,
+                    "imports": self._migrate_imports,
+                    "tokens_imported": self._migrate_tokens,
                 }
         # the cost block appears once work flowed (the same
         # report-nothing-when-idle contract as the mixed/prefix blocks).

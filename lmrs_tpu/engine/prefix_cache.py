@@ -60,13 +60,27 @@ real; capture failure (or the ``prefix.spill`` fault) degrades to
 today's evict-means-gone drop, byte-for-byte.  With no pool attached
 (``LMRS_HOST_KV=0``) nothing here changes behavior at all.
 
+Disk tier (engine/host_kv.DiskKVPool, ROADMAP item 4)
+-----------------------------------------------------
+With ``pool.disk`` attached (``LMRS_KV_DISK=1``), host-pool budget
+pressure DEMOTES the LRU host entry to an mmap'd spill file instead of
+dropping it: the node stays in the tree, its ``spill`` payload becomes a
+disk *descriptor* (``{"disk": True, ...}``), and a later match promotes
+it disk→host→device through the same ``prefetch_into`` path (the read
+happens at prefetch time; the ``kv.disk_read`` fault site fires before
+it).  A missing/torn/corrupt file — or the injected fault — drops the
+entry and degrades to re-prefill, never a wedged admission.  Recency is
+still the node's radix ``tick``: ONE LRU clock across device, host, and
+disk.  Disk budget pressure drops LRU disk subtrees for real.
+
 Threading: ALL methods run on the scheduler thread, between dispatches —
-the host pool inherits the same contract.
+the host pool and the disk pool inherit the same contract.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from lmrs_tpu.testing import faults
@@ -94,7 +108,17 @@ class _Node:
 
 
 def _payload_bytes(payload: dict) -> int:
+    if payload.get("disk"):
+        return int(payload["nbytes"])
     return int(payload["k"].nbytes) + int(payload["v"].nbytes)
+
+
+def _spill_pages(payload: dict) -> int:
+    """Payload pages of a spill entry, either tier (k is [L, n, kh, ps,
+    hd]; the disk descriptor records the shape)."""
+    if payload.get("disk"):
+        return int(payload["k_shape"][1])
+    return int(payload["k"].shape[1])
 
 
 class PrefixCache:
@@ -135,6 +159,14 @@ class PrefixCache:
         self.evicted_pages = 0
         self.inserted_pages = 0
 
+    @property
+    def disk(self):
+        """The disk tier under the host pool, or None (host_kv.DiskKVPool;
+        any pool-like test double without one reads as tier-off)."""
+        if self.pool is None:
+            return None
+        return getattr(self.pool, "disk", None)
+
     # ------------------------------------------------------------- matching
 
     def _touch(self, node: _Node) -> None:
@@ -149,6 +181,9 @@ class PrefixCache:
     def _note_pool(self) -> None:
         if self.pool is not None:
             self._metric("pool_bytes", "set", float(self.pool.used_bytes))
+            if self.disk is not None:
+                self._metric("disk_bytes", "set",
+                             float(self.disk.used_bytes))
 
     def match(self, ids: list[int]) -> tuple[list[int], int]:
         """Longest RESIDENT cached prefix of ``ids`` at page granularity.
@@ -260,16 +295,50 @@ class PrefixCache:
             node = child
         return out
 
-    def _split(self, node: _Node, k: int) -> _Node:
+    def _split(self, node: _Node, k: int) -> _Node | None:
         """Split ``node``'s edge after ``k`` tokens (a page multiple):
         the prefix becomes a new parent node; ``node`` keeps the suffix.
-        Returns the new prefix node.  Spilled nodes split their host
-        payload too (both halves stay spilled, bytes re-registered)."""
+        Returns the new prefix node.  Spilled nodes split their payload
+        too (both halves stay in the node's tier, bytes re-registered).
+        A DISK node's split must read the file back — on a torn/corrupt
+        file (or a failed re-write) the entry drops and the split returns
+        None: the caller treats it as a missing child (the entry was only
+        ever a cache)."""
         ps = self.page_size
         kp = k // ps
         upper = _Node(node.tokens[:k], node.pages[:kp], node.parent)
         upper.tick = node.tick
-        if node.spill is not None:
+        if node.spill is not None and node.spill.get("disk"):
+            disk = self.disk
+            try:
+                pay = self._disk_read(node.spill)
+            except Exception:  # noqa: BLE001 - degrade to entry drop
+                logger.warning("disk spill read failed during split; "
+                               "dropping entry", exc_info=True)
+                self._drop_subtree(node)
+                return None
+            halves = []
+            try:
+                for sl in (slice(None, kp), slice(kp, None)):
+                    halves.append(disk.write(
+                        {"k": pay["k"][:, sl].copy(),
+                         "v": pay["v"][:, sl].copy(),
+                         "dtype": pay.get("dtype")}))
+            except OSError:
+                logger.warning("disk spill write failed during split; "
+                               "dropping entry", exc_info=True)
+                for desc in halves:
+                    disk.free(desc)
+                self._drop_subtree(node)
+                return None
+            disk.remove(node)
+            disk.free(node.spill)
+            upper.spill, node.spill = halves
+            # a split is not a new demotion event: re-register bytes only
+            disk.add(upper, halves[0]["nbytes"], 0)
+            disk.add(node, halves[1]["nbytes"], 0)
+            self._note_pool()
+        elif node.spill is not None:
             pay = node.spill
             self.pool.remove(node)
             upper.spill = {"k": pay["k"][:, :kp].copy(),
@@ -337,6 +406,10 @@ class PrefixCache:
                 break
             if take < len(child.tokens):
                 child = self._split(child, take)
+                if child is None:
+                    # a disk-tier split degraded to an entry drop: the
+                    # remainder adopts as a fresh leaf below
+                    break
             if child.spill is not None:
                 # promote on the inserting sequence's own pages for this
                 # token span — identical content, freshly computed
@@ -379,14 +452,20 @@ class PrefixCache:
     def _promote(self, node: _Node, dest_pages: list[int]) -> int:
         """Flip a spilled node back to resident on ``dest_pages`` (the
         cache takes its own reference; the caller keeps its own).  The
-        host payload drops — the content is in HBM again."""
+        spill payload drops from its tier — host entries free their
+        arrays, disk entries their file — the content is in HBM again."""
         n = len(dest_pages)
         assert n == len(node.tokens) // self.page_size
+        desc = node.spill
         self.allocator.incref(list(dest_pages))
         node.pages = list(dest_pages)
         node.spill = None
         if self.pool is not None:
-            self.pool.remove(node)
+            if desc is not None and desc.get("disk"):
+                self.disk.free(desc)
+                self.disk.remove(node)
+            else:
+                self.pool.remove(node)
             self._note_pool()
         self.cached_pages += n
         self.inserted_pages += n
@@ -394,26 +473,74 @@ class PrefixCache:
 
     # ------------------------------------------------------------- prefetch
 
+    def _disk_read(self, desc: dict) -> dict:
+        """Read a disk descriptor back into a host payload, firing the
+        ``kv.disk_read`` fault site first and counting failures.  Raises
+        on a missing/torn/corrupt file (or the injected fault) — callers
+        degrade to re-prefill / entry drop."""
+        try:
+            faults.fire("kv.disk_read")
+            return self.disk.read(desc)
+        except Exception:
+            self.disk.read_failures_total += 1
+            self._metric("disk_read_fail", "inc")
+            raise
+
     def prefetch_into(self, node: _Node, dest_pages: list[int],
                       kv_cache, sync: bool = False) -> int:
         """Restore a spilled node's payload into freshly allocated device
         pages (``PagedKVCache.import_pages`` — async scatter unless
-        ``sync``) and promote the node to resident on them.  Raises if
-        the entry was dropped between match and prefetch (host budget
-        pressure) — the caller re-prefills that segment instead.  The
-        ``prefix.prefetch`` fault site is the CALLER's (scheduler), fired
-        before any mutation here."""
+        ``sync``) and promote the node to resident on them.  A DISK
+        entry reads its spill file back first (disk→host→device); a
+        torn/corrupt file drops the entry and raises — exactly the
+        degrade-to-re-prefill contract of an entry dropped between match
+        and prefetch, which also raises here.  The ``prefix.prefetch``
+        fault site is the CALLER's (scheduler), fired before any
+        mutation here; ``kv.disk_read`` fires inside the disk read."""
         payload = node.spill
         if payload is None:
             raise RuntimeError("spilled entry dropped before prefetch")
+        was_disk = bool(payload.get("disk"))
+        if was_disk:
+            try:
+                payload = self._disk_read(payload)
+            except Exception:
+                # a corrupt file would fail every future match too —
+                # drop the entry so the tree stops advertising it
+                self._drop_subtree(node)
+                raise
         kv_cache.import_pages(dest_pages, payload, sync=sync)
         n = self._promote(node, dest_pages)
         # promotion via prefetch is a tier hit, not an insert
         self.inserted_pages -= n
         if self.pool is not None:
-            self.pool.note_prefetch(n)
+            if was_disk:
+                self.disk.note_promote(n)
+                self._metric("disk_promoted", "inc", n)
+            else:
+                self.pool.note_prefetch(n)
         self._touch(node)
         return n
+
+    def spill_payload(self, node: _Node) -> dict | None:
+        """In-memory payload of a spilled node, either tier, WITHOUT
+        promoting it (cross-host migration export reads warm state but
+        leaves this host's cache untouched).  Disk entries read their
+        spill file back (``kv.disk_read`` contract); a torn/corrupt file
+        drops the entry and returns None — the caller's export simply
+        covers fewer tokens."""
+        payload = node.spill
+        if payload is None:
+            return None
+        if payload.get("disk"):
+            try:
+                return self._disk_read(payload)
+            except Exception:  # noqa: BLE001 - degrade to shorter export
+                logger.warning("disk spill read failed during export; "
+                               "dropping entry", exc_info=True)
+                self._drop_subtree(node)
+                return None
+        return payload
 
     # ------------------------------------------------------------- eviction
 
@@ -527,11 +654,15 @@ class PrefixCache:
                 self.cached_pages -= len(cur.pages)
                 self.evicted_pages += len(cur.pages)
             if cur.spill is not None:
-                if self.pool is not None:
-                    self.pool.remove(cur, n_pages=len(cur.tokens) // ps,
-                                     dropped=True)
-                    self._metric("spill_dropped", "inc",
-                                 len(cur.tokens) // ps)
+                npg = len(cur.tokens) // ps
+                if cur.spill.get("disk"):
+                    if self.disk is not None:
+                        self.disk.free(cur.spill)
+                        self.disk.remove(cur, n_pages=npg, dropped=True)
+                        self._metric("disk_dropped", "inc", npg)
+                elif self.pool is not None:
+                    self.pool.remove(cur, n_pages=npg, dropped=True)
+                    self._metric("spill_dropped", "inc", npg)
                 cur.spill = None
             cur.children = {}
             cur.parent = None
@@ -539,28 +670,67 @@ class PrefixCache:
         return freed
 
     def _enforce_host_budget(self, keep: set | None = None) -> None:
-        """Drop LRU spilled subtrees until the host pool fits its budget.
-        ``keep`` pins the current walk chain (insert/eviction path) —
-        kept nodes form one root-path, so a victim outside the set can
-        never contain one in its subtree."""
+        """Re-fit the spill tiers to their budgets.  With the disk tier
+        armed, host-pool pressure DEMOTES the LRU host entry to a spill
+        file (the node stays in the tree, one tier down); tier off, entry
+        over the whole disk budget, or a failed write drops the subtree
+        exactly as before.  Disk pressure then drops LRU disk subtrees
+        for real.  ``keep`` pins the current walk chain (insert/eviction
+        path) — kept nodes form one root-path, so a victim outside the
+        set can never contain one in its subtree."""
         if self.pool is None:
             return
+        disk = self.disk
         while self.pool.over_budget():
             victim = self.pool.victim(keep=keep)
             if victim is None:
                 break
+            if (disk is not None
+                    and disk.fits(_payload_bytes(victim.spill))
+                    and self._demote(victim)):
+                continue
             self._drop_subtree(victim)
+        if disk is not None:
+            while disk.over_budget():
+                victim = disk.victim(keep=keep)
+                if victim is None:
+                    break
+                self._drop_subtree(victim)
+
+    def _demote(self, node: _Node) -> bool:
+        """Move one host-tier entry down to the disk tier (host budget
+        pressure).  Returns False on a failed spill-file write — the
+        caller drops the subtree instead, exactly as with the tier off."""
+        disk = self.disk
+        try:
+            desc = disk.write(node.spill)
+        except OSError:
+            logger.warning("disk spill write failed; entry drops from "
+                           "the host tier uncached", exc_info=True)
+            return False
+        npg = len(node.tokens) // self.page_size
+        self.pool.remove(node)  # demotion, not a drop: pages move tiers
+        node.spill = desc
+        disk.add(node, desc["nbytes"], npg)
+        self._metric("disk_demoted", "inc", npg)
+        self._note_pool()
+        return True
 
     def clear(self) -> int:
-        """Drop every node no live sequence shares — HARD, across both
-        tiers (kill switch / pool recovery / tests): resident refcount-
+        """Drop every node no live sequence shares — HARD, across every
+        tier (kill switch / pool recovery / tests): resident refcount-
         zero nodes free their pages without spilling, and every spilled
-        entry drops from the host pool."""
+        entry drops from the host and disk pools (disk entries unlink
+        their spill files)."""
         freed = (self._evict_lru(self.cached_pages or 0, spill=False)
                  if self.cached_pages else 0)
         if self.pool is not None:
             for node, _nbytes in list(self.pool.entries.values()):
                 if id(node) in self.pool.entries:  # sibling drop may race
+                    self._drop_subtree(node)
+        if self.disk is not None:
+            for node, _nbytes in list(self.disk.entries.values()):
+                if id(node) in self.disk.entries:
                     self._drop_subtree(node)
         return freed
 
@@ -621,10 +791,10 @@ class PrefixCache:
                         f"{len(node.pages)} pages (page_size {ps})")
                 if node.spill is not None:
                     spilled_nodes.append(node)
-                    if node.spill["k"].shape[1] * ps != len(node.tokens):
+                    if _spill_pages(node.spill) * ps != len(node.tokens):
                         violations.append(
                             f"spilled node with {len(node.tokens)} tokens "
-                            f"carries {node.spill['k'].shape[1]} payload "
+                            f"carries {_spill_pages(node.spill)} payload "
                             "pages")
                 if not node.tokens:
                     violations.append("non-root node with empty edge label")
@@ -647,8 +817,10 @@ class PrefixCache:
             violations.append(
                 f"cached_pages counter {self.cached_pages} != {total} "
                 "pages found in the tree")
+        host_nodes = [n for n in spilled_nodes if not n.spill.get("disk")]
+        disk_nodes = [n for n in spilled_nodes if n.spill.get("disk")]
         if self.pool is not None:
-            tree_ids = {id(n) for n in spilled_nodes}
+            tree_ids = {id(n) for n in host_nodes}
             pool_ids = set(self.pool.entries)
             if tree_ids != pool_ids:
                 violations.append(
@@ -662,6 +834,30 @@ class PrefixCache:
         elif spilled_nodes:
             violations.append("spilled nodes exist with no host pool "
                               "attached")
+        disk = self.disk
+        if disk is not None:
+            tree_ids = {id(n) for n in disk_nodes}
+            pool_ids = set(disk.entries)
+            if tree_ids != pool_ids:
+                violations.append(
+                    f"disk-pool entries ({len(pool_ids)}) and disk-tier "
+                    f"tree nodes ({len(tree_ids)}) diverge")
+            used = sum(nbytes for _n, nbytes in disk.entries.values())
+            if used != disk.used_bytes:
+                violations.append(
+                    f"disk pool used_bytes {disk.used_bytes} != "
+                    f"{used} summed over entries")
+            for n in disk_nodes:
+                ent = disk.entries.get(id(n))
+                if ent is not None and ent[1] != _payload_bytes(n.spill):
+                    violations.append(
+                        "disk entry bytes diverge from its descriptor")
+                if not os.path.isfile(n.spill["path"]):
+                    violations.append(
+                        f"disk spill file missing: {n.spill['path']}")
+        elif disk_nodes:
+            violations.append("disk-tier nodes exist with no disk pool "
+                              "attached")
         return violations
 
     # -------------------------------------------------------------- reports
@@ -672,6 +868,13 @@ class PrefixCache:
             return 0
         return sum(len(node.tokens) // self.page_size
                    for node, _nbytes in self.pool.entries.values())
+
+    def disk_pages(self) -> int:
+        """Pages currently held by the disk tier (capacity view)."""
+        if self.disk is None:
+            return 0
+        return sum(len(node.tokens) // self.page_size
+                   for node, _nbytes in self.disk.entries.values())
 
     def stats(self) -> dict:
         """Structural counters (page footprint) for metrics_report()/bench
@@ -685,4 +888,6 @@ class PrefixCache:
         if self.pool is not None:
             out["spilled_pages"] = self.spilled_pages()
             out.update(self.pool.stats())
+            if self.disk is not None:
+                out["disk_pages"] = self.disk_pages()
         return out

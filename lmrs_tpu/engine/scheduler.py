@@ -455,14 +455,49 @@ class ContinuousScheduler:
         self._g_host_pool = g("lmrs_prefix_host_pool_bytes",
                               "bytes currently held by the host-RAM KV "
                               "spill pool", "bytes")
+        # disk spill tier (host_kv.DiskKVPool, ROADMAP item 4) — present
+        # even when the tier is off, same delta-ability convention
+        self._c_disk_demoted = c("lmrs_kv_disk_demoted_pages_total",
+                                 "spilled pages demoted host→disk under "
+                                 "host-pool budget pressure", "pages")
+        self._c_disk_promoted = c("lmrs_kv_disk_promoted_pages_total",
+                                  "disk-tier pages promoted back via the "
+                                  "prefetch path (disk→host→device)",
+                                  "pages")
+        self._c_disk_dropped = c("lmrs_kv_disk_dropped_pages_total",
+                                 "disk-tier pages dropped (disk budget "
+                                 "LRU / subtree drops)", "pages")
+        self._c_disk_read_fail = c("lmrs_kv_disk_read_failures_total",
+                                   "disk spill reads that failed "
+                                   "(missing/torn/corrupt file) and "
+                                   "degraded to re-prefill")
+        self._g_disk_bytes = g("lmrs_kv_disk_bytes",
+                               "bytes currently held by the disk spill "
+                               "pool", "bytes")
+        # cross-host KV migration (docs/SERVING.md KV fabric): page sets
+        # exported to / imported from sibling hosts through /v1/kv
+        self._c_migrate_exports = c("lmrs_kv_migrate_exports_total",
+                                    "warm page sets exported for "
+                                    "cross-host migration")
+        self._c_migrate_imports = c("lmrs_kv_migrate_imports_total",
+                                    "migrated page sets imported into "
+                                    "the prefix cache")
+        self._c_migrate_tokens = c("lmrs_kv_migrate_tokens_total",
+                                   "prompt tokens installed warm via "
+                                   "cross-host migration", "tokens")
         if self._pc_on:
             pool = None
             cb = None
             pb = 0
             if engine_cfg.host_kv and engine_cfg.host_kv_gb > 0:
-                from lmrs_tpu.engine.host_kv import HostKVPool
+                from lmrs_tpu.engine.host_kv import DiskKVPool, HostKVPool
 
-                pool = HostKVPool(int(engine_cfg.host_kv_gb * 2**30))
+                disk = None
+                if engine_cfg.kv_disk and engine_cfg.kv_disk_gb > 0:
+                    disk = DiskKVPool(int(engine_cfg.kv_disk_gb * 2**30),
+                                      engine_cfg.kv_disk_dir)
+                pool = HostKVPool(int(engine_cfg.host_kv_gb * 2**30),
+                                  disk=disk)
                 cb = self.cache.export_pages
                 pb = self.cache.page_payload_bytes()
             self._prefix_cache = PrefixCache(
@@ -472,7 +507,12 @@ class ContinuousScheduler:
                 metrics={"spill_pages": self._c_spill_pages,
                          "spill_dropped": self._c_spill_dropped,
                          "spill_capture_s": self._h_spill_capture,
-                         "pool_bytes": self._g_host_pool})
+                         "pool_bytes": self._g_host_pool,
+                         "disk_demoted": self._c_disk_demoted,
+                         "disk_promoted": self._c_disk_promoted,
+                         "disk_dropped": self._c_disk_dropped,
+                         "disk_read_fail": self._c_disk_read_fail,
+                         "disk_bytes": self._g_disk_bytes})
             self.cache.reclaim_cb = self._prefix_cache.evict
         # mixed-batch dispatch: real tokens (decode + piggybacked prefill
         # slice) over the step's token budget, and the prompt tokens whose
@@ -1038,6 +1078,11 @@ class ContinuousScheduler:
             out["pool_bytes"] = pc.pool.used_bytes
             out["pool_entries"] = len(pc.pool)
             out["dropped_pages_total"] = pc.pool.dropped_pages_total
+            if pc.disk is not None:
+                # disk-tier keys appear only when the tier is armed:
+                # LMRS_KV_DISK=0 keeps this block byte-identical
+                out["disk_pages_resident"] = pc.disk_pages()
+                out.update(pc.disk.stats())
         return out
 
     def reset_latency_stats(self) -> None:
@@ -2257,6 +2302,156 @@ class ContinuousScheduler:
         with self._pinned_lock:
             return {rid: len(r["seq"].pages)
                     for rid, r in self._pinned.items()}
+
+    # ------------------------------------------- cross-host KV migration
+
+    def kv_export(self, preamble: str) -> dict | None:
+        """Page-set export for cross-host KV migration (docs/SERVING.md
+        "KV fabric"): the warm radix state of one published preamble
+        hash — resident pages gathered device→host, spilled/disk
+        segments read from their tiers — framed as one wire payload a
+        sibling's ``kv_import`` installs.  This host's cache is left
+        untouched (migration COPIES warmth; the drained host's state
+        drops with the host).
+
+        Control-plane only: callable while no run is live (a draining
+        host has stopped serving; the router migrates between runs) —
+        returns None mid-run, for unknown/cold preambles, and with the
+        prefix cache off.  A torn disk entry truncates the set (fewer
+        migrated tokens, never a failed export); the ``migrate.export``
+        fault site fires before any capture work.
+
+        Holds the pin lock for the duration: a run flips ``_run_live``
+        under the same lock before its first allocation, so an export
+        can never overlap a starting dispatch loop (the allocator and
+        radix tree have no synchronization of their own)."""
+        with self._pinned_lock:
+            if self._run_live:
+                return None
+            return self._kv_export_locked(preamble)
+
+    def _kv_export_locked(self, preamble: str) -> dict | None:
+        if self._prefix_cache is None:
+            return None
+        ent = self._preambles.get(preamble)
+        if ent is None:
+            return None
+        faults.fire("migrate.export")
+        ids = list(ent["ids"])
+        ps = self.cfg.page_size
+        pages, matched, chain = self._prefix_cache.match_hier(ids)
+        k_parts: list[np.ndarray] = []
+        v_parts: list[np.ndarray] = []
+        tokens = 0
+        try:
+            if matched:
+                pay = self.cache.export_pages(pages)
+                k_parts.append(pay["k"])
+                v_parts.append(pay["v"])
+                tokens += matched
+        finally:
+            if matched:
+                self.cache.allocator.free(pages)
+        for node, n_tok in chain:
+            pay = self._prefix_cache.spill_payload(node)
+            if pay is None:
+                break
+            k_parts.append(np.asarray(pay["k"]))
+            v_parts.append(np.asarray(pay["v"]))
+            tokens += n_tok
+        if tokens == 0:
+            return None
+        k = (k_parts[0] if len(k_parts) == 1
+             else np.concatenate(k_parts, axis=1))
+        v = (v_parts[0] if len(v_parts) == 1
+             else np.concatenate(v_parts, axis=1))
+        kh, _ps, hd = (int(x) for x in self.cache.k.shape[1:])
+        self._c_migrate_exports.inc()
+        return {
+            "kind": "kv_pageset",
+            "version": 1,
+            "preamble": preamble,
+            "tokens": tokens,
+            "ids": [int(t) for t in ids[:tokens]],
+            "n_pages": tokens // ps,
+            "page_size": ps,
+            "n_layers": self.cache.n_layers,
+            "n_kv_heads": kh,
+            "head_dim": hd,
+            "dtype": str(self.cache.k.dtype),
+            "k": k,
+            "v": v,
+        }
+
+    def kv_import(self, payload: dict) -> int:
+        """Install a migrated page set into this engine's prefix cache:
+        allocate device pages, scatter the payload (sync — control
+        plane, not the hot path), insert under the payload's token ids,
+        and publish the preamble into the routed summary so follow-up
+        requests see it warm here.  Returns tokens now warm.
+
+        Rejection discipline mirrors ``import_sequence``: geometry/
+        dtype/framing mismatches raise ``ValueError`` (the router's
+        cold-migration fallback owns the retry), pool pressure raises
+        ``OutOfPages`` after a reclaim attempt, and a live run raises
+        ``RuntimeError`` (busy — the caller retries between runs).  The
+        ``migrate.import`` fault site fires before any mutation.
+
+        Like ``kv_export``, holds the pin lock for the duration so a
+        starting run can never overlap the scatter/insert."""
+        with self._pinned_lock:
+            if self._run_live:
+                raise RuntimeError("engine busy; kv import retries between "
+                                   "runs")
+            return self._kv_import_locked(payload)
+
+    def _kv_import_locked(self, payload: dict) -> int:
+        if self._prefix_cache is None:
+            raise ValueError("prefix cache off; nothing to import into")
+        faults.fire("migrate.import")
+        kh, ps, hd = (int(x) for x in self.cache.k.shape[1:])
+        want = {"page_size": self.cache.page_size,
+                "n_layers": self.cache.n_layers, "n_kv_heads": kh,
+                "head_dim": hd, "dtype": str(self.cache.k.dtype)}
+        for key, val in want.items():
+            got = payload.get(key)
+            if got != val:
+                raise ValueError(
+                    f"incompatible kv payload: {key}={got!r}, this pool "
+                    f"has {val!r}")
+        ids = [int(t) for t in payload.get("ids", ())]
+        n = int(payload.get("n_pages", 0) or 0)
+        tokens = int(payload.get("tokens", 0) or 0)
+        if n <= 0 or tokens != n * ps or len(ids) != tokens:
+            raise ValueError(
+                f"inconsistent kv payload framing: {n} pages / {tokens} "
+                f"tokens / {len(ids)} ids (page_size {ps})")
+        k = np.asarray(payload["k"])
+        v = np.asarray(payload["v"])
+        shape = (self.cache.n_layers, n, kh, ps, hd)
+        if k.shape != shape or v.shape != shape:
+            raise ValueError(
+                f"kv payload shape {k.shape} != expected {shape}")
+        if n > self.cache.allocator.free_count:
+            self._prefix_cache.evict(n - self.cache.allocator.free_count)
+        pages = self.cache.alloc_pages(n)
+        try:
+            self.cache.import_pages(
+                pages, {"k": k, "v": v, "dtype": payload["dtype"]},
+                sync=True)
+            self._prefix_cache.insert(ids, pages, max_tokens=tokens)
+        finally:
+            # the cache holds its own refs on adopted pages; ours drop
+            self.cache.allocator.free(pages)
+        key = payload.get("preamble")
+        if isinstance(key, str) and key:
+            self._preamble_tick += 1
+            self._preambles[key] = {"ids": tuple(ids),
+                                    "tick": self._preamble_tick}
+            self._summary_memo = None
+        self._c_migrate_imports.inc()
+        self._c_migrate_tokens.inc(tokens)
+        return tokens
 
     def _admit_import(self, b, queue, slots, results, fresh, kv_lens,
                       last_tok, active, temps, top_k, top_p) -> bool:

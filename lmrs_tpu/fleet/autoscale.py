@@ -152,7 +152,16 @@ class Autoscaler:
         #    either way the slot frees before any new decision
         for netloc, since in list(self._draining.items()):
             idle = self.router.host_idle(netloc)
-            if not idle and now - since < self.drain_timeout_s:
+            # a drain-triggered KV migration (router.migrations_pending,
+            # LMRS_KV_MIGRATE) holds the removal like in-flight legs do:
+            # force-removing mid-copy would tear warm pages off the pod
+            # while a sibling is still pulling them.  The drain timeout
+            # backstops a wedged migration exactly as it does a wedged
+            # leg — getattr keeps fake routers in tests working.
+            migrating = getattr(self.router, "migrations_pending",
+                                lambda _n: False)(netloc)
+            if ((not idle or migrating)
+                    and now - since < self.drain_timeout_s):
                 continue
             if self.router.remove_host(netloc, force=not idle):
                 self._draining.pop(netloc, None)

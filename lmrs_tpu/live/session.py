@@ -465,59 +465,94 @@ class SessionManager:
         fresh one re-journals header + segments."""
         recovered = 0
         for wal in sorted(self.dir.glob("*.wal")):
-            sid = wal.stem
-            with self._lock:
-                if sid in self._sessions:
-                    continue
-            try:
-                records, _meta = jl.replay(wal)
-                state = rebuild_live_state(records)
-                if state["header"] is None:
-                    logger.warning("session %s: journal has no header; "
-                                   "skipped", sid)
-                    continue
-                params = self._sanitize_params(
-                    state["header"].get("params") or {})
-                fp = self._fingerprint(params)
-                stale = state["header"].get("fingerprint") != fp
-                with self._lock:
-                    session = self._register(sid, params, fp)
-                    session.recovered = True
-                    session.created_t = state["header"].get(
-                        "created_t", session.created_t)
-                    header_trace = state["header"].get("trace_id")
-                    if isinstance(header_trace, str) and header_trace:
-                        session.trace_id = header_trace
-                    header_tenant = state["header"].get("tenant")
-                    session.tenant = (header_tenant
-                                      if isinstance(header_tenant, str)
-                                      and header_tenant
-                                      else f"session:{sid[:24]}")
-                    self._g_active.set(self._active_count())
-                self._rehydrate(session, state, wal, stale=stale)
-            except Exception as e:  # noqa: BLE001 - degrade per session
-                logger.warning("session %s: recovery failed: %s: %s",
-                               sid, type(e).__name__, e)
-                with self._lock:
-                    self._sessions.pop(sid, None)
-                    self._g_active.set(self._active_count())
-                continue
-            self._c_recovered.inc()
-            recovered += 1
-            tr = get_tracer()
-            if tr:
-                tr.instant("session_resume", pid=PID_PIPELINE,
-                           args={"session": sid,
-                                 "segments": session.n_segments,
-                                 "chunk_records": len(state["chunks"]),
-                                 "node_records": len(state["nodes"]),
-                                 "trace": session.trace_id})
-            logger.info(
-                "session %s: recovered (%d segment batch(es), %d chunk "
-                "record(s), %d reduce node(s)%s)", sid, session.append_seq,
-                len(state["chunks"]), len(state["nodes"]),
-                "; STALE fingerprint — summaries dropped" if stale else "")
+            if self._recover_wal(wal):
+                recovered += 1
         return recovered
+
+    def recover_one(self, session_id: str) -> LiveSession | None:
+        """On-demand single-journal recovery (the cross-host resume path,
+        docs/SERVING.md "KV fabric"): a sibling that inherited a drained/
+        killed host's traffic finds the session's journal in the SHARED
+        live directory and rehydrates just that session when its first
+        request arrives — startup-style recovery, at request time.
+        Returns the live session, or None when no journal exists here
+        (the 404 stands) or replay fails (degrade per session)."""
+        try:
+            sid = self._clean_sid(session_id)
+        except ValueError:  # garbage sid: the caller's 404 stands
+            return None
+        if sid is None or self._stopped:
+            return None
+        with self._lock:
+            existing = self._sessions.get(sid)
+            if existing is not None:
+                return None if existing.closed else existing
+        wal = self.dir / f"{sid}.wal"
+        if not wal.is_file():
+            return None
+        if self._recover_wal(wal):
+            return self.get(sid)
+        return None
+
+    def _recover_wal(self, wal: Path) -> bool:
+        """Rehydrate one journal file (shared body of recover() and
+        recover_one()).  False when the session already exists, the
+        journal is headerless, or replay fails — recovery degrades per
+        session, never raises."""
+        sid = wal.stem
+        with self._lock:
+            if sid in self._sessions:
+                return False
+        try:
+            records, _meta = jl.replay(wal)
+            state = rebuild_live_state(records)
+            if state["header"] is None:
+                logger.warning("session %s: journal has no header; "
+                               "skipped", sid)
+                return False
+            params = self._sanitize_params(
+                state["header"].get("params") or {})
+            fp = self._fingerprint(params)
+            stale = state["header"].get("fingerprint") != fp
+            with self._lock:
+                if sid in self._sessions:  # raced a concurrent recover
+                    return False
+                session = self._register(sid, params, fp)
+                session.recovered = True
+                session.created_t = state["header"].get(
+                    "created_t", session.created_t)
+                header_trace = state["header"].get("trace_id")
+                if isinstance(header_trace, str) and header_trace:
+                    session.trace_id = header_trace
+                header_tenant = state["header"].get("tenant")
+                session.tenant = (header_tenant
+                                  if isinstance(header_tenant, str)
+                                  and header_tenant
+                                  else f"session:{sid[:24]}")
+                self._g_active.set(self._active_count())
+            self._rehydrate(session, state, wal, stale=stale)
+        except Exception as e:  # noqa: BLE001 - degrade per session
+            logger.warning("session %s: recovery failed: %s: %s",
+                           sid, type(e).__name__, e)
+            with self._lock:
+                self._sessions.pop(sid, None)
+                self._g_active.set(self._active_count())
+            return False
+        self._c_recovered.inc()
+        tr = get_tracer()
+        if tr:
+            tr.instant("session_resume", pid=PID_PIPELINE,
+                       args={"session": sid,
+                             "segments": session.n_segments,
+                             "chunk_records": len(state["chunks"]),
+                             "node_records": len(state["nodes"]),
+                             "trace": session.trace_id})
+        logger.info(
+            "session %s: recovered (%d segment batch(es), %d chunk "
+            "record(s), %d reduce node(s)%s)", sid, session.append_seq,
+            len(state["chunks"]), len(state["nodes"]),
+            "; STALE fingerprint — summaries dropped" if stale else "")
+        return True
 
     def status_doc(self, session: LiveSession) -> dict:
         """The GET /v1/sessions/<id> response body."""

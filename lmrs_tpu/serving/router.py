@@ -437,6 +437,27 @@ class RouterEngine:
         # so whichever leg wins the text is identical.
         self._hedges = 0       # guarded-by: _stats_lock
         self._hedge_wins = 0   # guarded-by: _stats_lock
+        # Global KV fabric (docs/SERVING.md § KV migration): with
+        # LMRS_KV_MIGRATE armed the router MOVES warm KV page sets over
+        # the backends' /v1/kv wire — a draining host's hottest
+        # preambles (and its pinned sessions/jobs) migrate to a healthy
+        # sibling before the autoscaler reclaims the pod, and a wave
+        # whose preamble group spreads past its warm host prefetches
+        # the predicted prefix into the siblings about to serve the
+        # spread.  Disarmed, no /v1/kv call is ever made and every
+        # metric key below is omitted — byte parity with the
+        # pre-fabric router.
+        self.kv_migrate = env_bool("LMRS_KV_MIGRATE", True)
+        self._kv_lock = threading.Lock()
+        self._kv_migrating: set[str] = set()  # guarded-by: _kv_lock
+        # (target netloc, preamble key) -> last attempt clock: spread
+        # prefetches dedup within a summary TTL so a hot preamble does
+        # not re-export every wave; bounded like the other pin caches
+        self._kv_prefetched: dict[tuple[str, str], float] = {}  # guarded-by: _kv_lock
+        self._kv_prefetched_max = 256
+        self._kv_moves = 0       # guarded-by: _stats_lock
+        self._kv_prefetches = 0  # guarded-by: _stats_lock
+        self._kv_failures = 0    # guarded-by: _stats_lock
         from collections import deque
 
         self._lat_s = deque(maxlen=512)  # guarded-by: _stats_lock
@@ -526,29 +547,37 @@ class RouterEngine:
             now = self._clock()
             ages = {netloc: round(now - s["at"], 1)
                     for netloc, s in self._summaries.items()}
-        return {"hosts": len(self.hosts),
-                "healthy_hosts": sum(h.healthy for h in self.hosts),
-                "pools": {role: {"size": len(pool),
-                                 "healthy": sum(h.healthy for h in pool)}
-                          for role, pool in self.pools.items() if pool},
-                "handoff": {"handoffs": self._handoffs,
-                            "retries": self._handoff_retries,
-                            "fallbacks": self._handoff_fallbacks},
-                "hedge": {"hedges": self._hedges,
-                          "wins": self._hedge_wins},
-                "prefix_route": {"enabled": self.prefix_route,
-                                 "routed": self._prefix_routed,
-                                 "predicted": self._prefix_predicted,
-                                 "fallback": self._prefix_fallback,
-                                 "summary_age_s": ages},
-                "slo_route": {"enabled": self.slo_route,
-                              "penalized": self._slo_penalized,
-                              "states": {h.netloc: self._slo_penalty(h)
-                                         for h in self.hosts}},
-                "tenant_route": {"enabled": self.tenant_route,
-                                 "routed": self._tenant_routed,
-                                 "tenants": len(self._tenant_hosts)},
-                "per_host": per}
+        doc = {"hosts": len(self.hosts),
+               "healthy_hosts": sum(h.healthy for h in self.hosts),
+               "pools": {role: {"size": len(pool),
+                                "healthy": sum(h.healthy for h in pool)}
+                         for role, pool in self.pools.items() if pool},
+               "handoff": {"handoffs": self._handoffs,
+                           "retries": self._handoff_retries,
+                           "fallbacks": self._handoff_fallbacks},
+               "hedge": {"hedges": self._hedges,
+                         "wins": self._hedge_wins},
+               "prefix_route": {"enabled": self.prefix_route,
+                                "routed": self._prefix_routed,
+                                "predicted": self._prefix_predicted,
+                                "fallback": self._prefix_fallback,
+                                "summary_age_s": ages},
+               "slo_route": {"enabled": self.slo_route,
+                             "penalized": self._slo_penalized,
+                             "states": {h.netloc: self._slo_penalty(h)
+                                        for h in self.hosts}},
+               "tenant_route": {"enabled": self.tenant_route,
+                                "routed": self._tenant_routed,
+                                "tenants": len(self._tenant_hosts)},
+               "per_host": per}
+        if self.kv_migrate:
+            # key present only when armed: LMRS_KV_MIGRATE=0 keeps the
+            # aggregate byte-identical to the pre-fabric router
+            doc["kv_migrate"] = {"enabled": True,
+                                 "moves": self._kv_moves,
+                                 "prefetches": self._kv_prefetches,
+                                 "failures": self._kv_failures}
+        return doc
 
     def prometheus_metrics(self) -> str:
         """Fleet-wide Prometheus exposition: each backend's text-format
@@ -687,6 +716,21 @@ class RouterEngine:
                      "requests placed sticky on the tenant's last-served "
                      "host (LMRS_TENANT_ROUTE chargeback affinity)"
                      ).inc(self._tenant_routed)
+        if self.kv_migrate:
+            # emitted only when armed (LMRS_KV_MIGRATE=0 exposition
+            # parity — same rule as the engine_metrics block)
+            hreg.counter("lmrs_kv_migrate_moves_total",
+                         "KV page sets moved off draining hosts over "
+                         "the /v1/kv export/import wire"
+                         ).inc(self._kv_moves)
+            hreg.counter("lmrs_kv_migrate_prefetches_total",
+                         "predicted prefixes prefetched into spread "
+                         "siblings ahead of wave traffic"
+                         ).inc(self._kv_prefetches)
+            hreg.counter("lmrs_kv_migrate_failures_total",
+                         "KV migration legs (move or prefetch) that "
+                         "failed; the preamble re-prefills cold"
+                         ).inc(self._kv_failures)
         pages.append(hreg.render_prometheus())
         return merge_expositions(pages)
 
@@ -859,13 +903,38 @@ class RouterEngine:
         """Begin a graceful exit: the host leaves the dispatch order
         (``healthy`` goes False) but keeps its in-flight requests; the
         recovery probes skip it so nothing re-admits it.  Returns False
-        for an unknown netloc."""
+        for an unknown netloc.
+
+        Sticky affinity is purged HERE, not at remove: a draining host
+        must stop attracting placement immediately — stale tenant pins
+        and summary rows would keep steering warm traffic at a host on
+        its way out, and session/job pins would hold sticky clients
+        there until the pod dies under them.  The pinned ids are
+        collected before the purge so the KV migration (LMRS_KV_MIGRATE)
+        can re-pin them onto the sibling that inherits the warm pages;
+        disarmed, follow-up traffic just pays one fleet re-scan."""
         for h in self.hosts:
-            if h.netloc == netloc:
-                h.draining = True
-                logger.info("fleet: host %s draining (%d legs in flight)",
-                            netloc, h.inflight)
-                return True
+            if h.netloc != netloc:
+                continue
+            h.draining = True
+            with self._summary_lock:
+                self._summaries.pop(netloc, None)
+                self._summary_inflight.discard(netloc)
+            with self._stats_lock:
+                for t, n in list(self._tenant_hosts.items()):
+                    if n == netloc:
+                        del self._tenant_hosts[t]
+            with self._job_lock:
+                pinned = [j for j, n in self._job_hosts.items()
+                          if n == netloc]
+                for j in pinned:
+                    del self._job_hosts[j]
+            logger.info("fleet: host %s draining (%d legs in flight, "
+                        "%d pins released)", netloc, h.inflight,
+                        len(pinned))
+            if self.kv_migrate:
+                self._start_kv_migration(h, pinned)
+            return True
         return False
 
     def host_idle(self, netloc: str) -> bool:
@@ -901,10 +970,177 @@ class RouterEngine:
                 for t, n in list(self._tenant_hosts.items()):
                     if n == netloc:
                         del self._tenant_hosts[t]
+            # job/session pins too (a drain purges them already, but a
+            # FORCED remove — breaker-dead pod, no drain — must not
+            # leave sticky clients routed at a host that is gone)
+            with self._job_lock:
+                for j, n in list(self._job_hosts.items()):
+                    if n == netloc:
+                        del self._job_hosts[j]
+            with self._kv_lock:
+                for key in [k for k in self._kv_prefetched
+                            if k[0] == netloc]:
+                    del self._kv_prefetched[key]
             logger.info("fleet: host %s removed (%d hosts remain)",
                         netloc, len(self.hosts))
             return True
         return False
+
+    # ------------------------------------------------------ KV-fabric moves
+
+    def migrations_pending(self, netloc: str) -> bool:
+        """True while a drain-triggered KV migration off ``netloc`` is
+        still in flight — the autoscaler holds its force-remove until
+        this clears (or its drain timeout fires), so warm pages are not
+        torn off a pod mid-copy."""
+        with self._kv_lock:
+            return netloc in self._kv_migrating
+
+    def _start_kv_migration(self, src: _Host, pinned: list[str]) -> None:
+        """Queue the background migration of ``src``'s warm KV (one per
+        netloc at a time — a double drain call must not race two copies
+        of the same page sets)."""
+        with self._kv_lock:
+            if src.netloc in self._kv_migrating:
+                return
+            self._kv_migrating.add(src.netloc)
+        self._pool.submit(self._migrate_host_kv, src, pinned)
+
+    def _migrate_host_kv(self, src: _Host, pinned: list[str]) -> None:
+        """Move the draining host's hottest preambles to one healthy
+        sibling over the /v1/kv wire (pool thread, best-effort): export
+        mints a page-set ticket on ``src``, import makes the sibling
+        PULL the blob and ack it.  The drained host's sticky session/
+        job pins re-pin onto the sibling afterwards — its journals
+        replay anywhere (shared live-dir) or one fleet re-scan finds
+        them, and now the warm radix pages travel too.  Every failure
+        degrades to cold re-prefill on whatever host wins placement;
+        nothing here can wedge a drain."""
+        moved = 0
+        try:
+            dst = self._kv_sibling(src)
+            if dst is None:
+                logger.info("fleet: no healthy sibling for %s; KV stays "
+                            "(re-prefill on demand)", src.netloc)
+                return
+            rows = self._fetch_kv_rows(src)
+            rows.sort(key=lambda e: -(2 * int(e.get("resident_tokens") or 0)
+                                      + int(e.get("spilled_tokens") or 0)))
+            for ent in rows[:8]:
+                if self._kv_move(src, dst, str(ent["hash"])):
+                    moved += 1
+            with self._job_lock:
+                for jid in pinned:
+                    self._job_hosts[jid] = dst.netloc
+                while len(self._job_hosts) > self._job_hosts_max:
+                    self._job_hosts.pop(next(iter(self._job_hosts)))
+            logger.info("fleet: migrated %d KV page sets %s -> %s "
+                        "(%d pins re-homed)", moved, src.netloc,
+                        dst.netloc, len(pinned))
+        except Exception:  # noqa: BLE001 - migration is best-effort
+            logger.warning("fleet: KV migration off %s failed after %d "
+                           "moves", src.netloc, moved, exc_info=True)
+            self._count("_kv_failures")
+        finally:
+            with self._kv_lock:
+                self._kv_migrating.discard(src.netloc)
+
+    def _kv_sibling(self, src: _Host) -> _Host | None:
+        """Where the drained host's KV should land: the least-loaded
+        healthy host outside ``src`` (same optimism as dispatch — role
+        membership is policy, and a migrated preamble is useful wherever
+        follow-up traffic can be steered)."""
+        healthy = [h for h in self.hosts if h is not src and h.healthy]
+        if not healthy:
+            return None
+        return sorted(healthy, key=lambda h: (h.served, h.netloc))[0]
+
+    def _fetch_kv_rows(self, src: _Host) -> list[dict]:
+        """The draining host's CURRENT prefix summary, fetched directly
+        (the cached copy was purged at drain, and the refresh loop skips
+        unhealthy hosts).  An unreachable host returns no rows — there
+        is nothing to migrate off a pod that is already dark."""
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(src.netloc, timeout=5.0)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return []
+            doc = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - best-effort control plane
+            logger.debug("KV summary fetch failed for %s: %s: %s",
+                         src.netloc, type(e).__name__, e)
+            return []
+        finally:
+            if conn is not None:
+                conn.close()
+        return [ent for ent in (doc.get("prefix_summary") or ())
+                if isinstance(ent, dict) and ent.get("hash")]
+
+    def _kv_move(self, src: _Host, dst: _Host, preamble: str) -> bool:
+        """One page-set move: export on ``src`` (404 = cold or engine
+        busy — not an error, the preamble just re-prefills), then a
+        pull-import on ``dst`` (which fetches the blob and acks the
+        ticket; an unacked ticket is reclaimed by src's orphan sweep)."""
+        status, doc = self._job_call_safe(
+            src, "POST", "/v1/kv/export", {"preamble": preamble})
+        if status != 200 or not isinstance(doc, dict) \
+                or not doc.get("ticket"):
+            if status not in (404, 501):
+                self._count("_kv_failures")
+            return False
+        status, _ = self._job_call_safe(
+            dst, "POST", "/v1/kv/import",
+            {"ticket": doc["ticket"], "source": src.netloc})
+        if status == 200:
+            self._count("_kv_moves")
+            return True
+        self._count("_kv_failures")
+        return False
+
+    def _kv_prefetch_spread(self, warm: _Host, key: str,
+                            role: str) -> None:
+        """Predicted-prefix prefetch: a wave's preamble group is about
+        to SPREAD past its warm host (fair-share placement), so the
+        siblings that will serve the remainder pull the predicted
+        prefix from the warm host now instead of re-prefilling it.
+        Deduped per (target, preamble) within a summary TTL; queued on
+        the dispatch pool so placement never blocks on a copy."""
+        now = self._clock()
+        targets: list[_Host] = []
+        with self._kv_lock:
+            for h in self._role_pool(role):
+                if h is warm or not h.healthy:
+                    continue
+                k = (h.netloc, key)
+                if now - self._kv_prefetched.get(k, -1e9) \
+                        < self.summary_ttl_s:
+                    continue
+                self._kv_prefetched[k] = now
+                targets.append(h)
+            while len(self._kv_prefetched) > self._kv_prefetched_max:
+                self._kv_prefetched.pop(next(iter(self._kv_prefetched)))
+        for h in targets:
+            self._pool.submit(self._kv_prefetch_one, warm, h, key)
+
+    def _kv_prefetch_one(self, src: _Host, dst: _Host, key: str) -> None:
+        """One prefetch leg (pool thread): same export→pull-import flow
+        as a drain move, but failures stay silent — a prefetch that
+        does not land just leaves the sibling cold, which is exactly
+        where it started."""
+        status, doc = self._job_call_safe(
+            src, "POST", "/v1/kv/export", {"preamble": key})
+        if status != 200 or not isinstance(doc, dict) \
+                or not doc.get("ticket"):
+            return
+        status, _ = self._job_call_safe(
+            dst, "POST", "/v1/kv/import",
+            {"ticket": doc["ticket"], "source": src.netloc})
+        if status == 200:
+            self._count("_kv_prefetches")
+        else:
+            self._count("_kv_failures")
 
     # ------------------------------------------------------ trace stitching
 
@@ -1598,10 +1834,15 @@ class RouterEngine:
                                req.cache_prefix)
             if key is not None:
                 groups.setdefault(key, []).append(idx)
-        for members in groups.values():
+        for key, members in groups.items():
             prefer, predicted, eligible = self._prefix_target(
                 requests[members[0]], role)
             share = -(-len(members) // healthy_n)
+            if (self.kv_migrate and predicted and prefer is not None
+                    and share < len(members)):
+                # the group spreads past its warm host: move the
+                # predicted prefix to the siblings ahead of the traffic
+                self._kv_prefetch_spread(prefer, key, role)
             for k, idx in enumerate(members):
                 sticky = prefer if k < share else None
                 out[idx] = sticky

@@ -618,6 +618,19 @@ class EngineHTTPServer:
         self.handoff_ttl_s = handoff_ttl_s
         self.handoff = TicketRegistry()       # prefill side: live tickets
         self._imported = ImportLog()          # decode side: dedup
+        # Cross-host KV migration (docs/SERVING.md "KV fabric"): page-SET
+        # tickets over the same export→fetch→ack lifecycle as request
+        # handoff.  LMRS_KV_MIGRATE=0 disarms the whole surface — the
+        # /v1/kv endpoints answer 501 and no migration state is reported,
+        # so the wire stays byte-identical to the pre-fabric server.
+        from lmrs_tpu.utils.env import env_bool
+
+        self.kv_migrate = env_bool("LMRS_KV_MIGRATE", True)
+        self.kv_tickets = TicketRegistry()    # export side: live page sets
+        self._kv_imported = ImportLog()       # import side: dedup
+        self._kv_lock = threading.Lock()
+        # ticket -> encoded wire blob, pinned host-side until ack/expiry
+        self._kv_payloads: dict[str, bytes] = {}  # guarded-by: _kv_lock
         from lmrs_tpu.obs import MetricsRegistry
         self._handoff_reg = MetricsRegistry()
         hc, hh = self._handoff_reg.counter, self._handoff_reg.histogram
@@ -719,6 +732,8 @@ class EngineHTTPServer:
                     self._get_trace()
                 elif self.path.startswith("/v1/handoff/"):
                     self._get_handoff(self.path.split("/")[3])
+                elif self.path.startswith("/v1/kv/"):
+                    self._get_kv(self.path.split("/")[3])
                 elif (self.path == "/v1/jobs"
                         or self.path.startswith("/v1/jobs/")):
                     code, payload = outer._job_http("GET", self.path, None)
@@ -750,6 +765,10 @@ class EngineHTTPServer:
                         "http_requests": outer.batcher.requests_served,
                         "handoff": outer.handoff_stats(),
                     }
+                    if outer.kv_migrate:
+                        # key absent with LMRS_KV_MIGRATE=0: the kill
+                        # switch keeps this wire document byte-identical
+                        payload["kv_migrate"] = outer.kv_stats()
                     # the radix summary rides the JSON control plane too
                     # (operators' view; the router refreshes via /healthz)
                     summary = getattr(outer.engine, "prefix_summary", None)
@@ -1097,6 +1116,168 @@ class EngineHTTPServer:
                         payload.get("qos_class"))
                 return True
 
+            # ------------------------------------ KV-fabric migration wire
+            # (docs/SERVING.md "KV fabric"): the same pull-model
+            # export→fetch→ack lifecycle as request handoff, but the unit
+            # is a PREAMBLE PAGE SET, not an in-flight request.  All four
+            # routes answer 501 when LMRS_KV_MIGRATE=0 or the engine lacks
+            # the hooks — the kill switch hides the surface entirely.
+
+            def _kv_disarmed(self) -> bool:
+                if outer.kv_migrate:
+                    return False
+                self._send(501, {"error": {
+                    "message": "KV migration disabled (LMRS_KV_MIGRATE=0)",
+                    "type": "kv_migrate_error"}})
+                return True
+
+            def _post_kv_export(self, body: dict) -> None:
+                """Capture one warm preamble's page set and publish a
+                ticket for it.  404 when the preamble is cold/unknown here
+                (or the engine is mid-run — the caller retries); the blob
+                stays pinned server-side until ack or TTL expiry."""
+                if self._kv_disarmed():
+                    return
+                export = getattr(outer.engine, "kv_export", None)
+                if export is None:
+                    self._send(501, {"error": {
+                        "message": "this engine backend has no KV page-set "
+                                   "export", "type": "kv_migrate_error"}})
+                    return
+                preamble = body.get("preamble")
+                if not isinstance(preamble, str) or not preamble:
+                    self._send(400, {"error": {
+                        "message": "body needs a preamble hash string",
+                        "type": "kv_migrate_error"}})
+                    return
+                try:
+                    payload = export(preamble)
+                except Exception as e:  # noqa: BLE001 - marked error
+                    logger.exception("kv export failed")
+                    self._send(502, {"error": {
+                        "message": f"kv export failed: "
+                                   f"{type(e).__name__}: {e}",
+                        "type": "kv_migrate_error"}})
+                    return
+                if payload is None:
+                    self._send(404, {"error": {
+                        "message": f"preamble {preamble} is not warm here "
+                                   "(cold, unknown, or engine busy)",
+                        "type": "kv_migrate_error"}})
+                    return
+                data = encode_payload(payload)
+                ttl = outer.handoff_ttl_s
+                tid = outer.kv_tickets.create(preamble, time.time() + ttl)
+                with outer._kv_lock:
+                    outer._kv_payloads[tid] = data
+                self._send(200, {
+                    "object": "kv.ticket",
+                    "ticket": tid,
+                    "preamble": preamble,
+                    "tokens": int(payload.get("tokens", 0)),
+                    "bytes": len(data),
+                    "expires_in_s": ttl,
+                })
+
+            def _get_kv(self, ticket: str) -> None:
+                """Serve a pinned page-set blob to the pulling sibling.
+                Unknown / expired / consumed → 410 (at-most-once, same
+                contract as request-handoff tickets)."""
+                if self._kv_disarmed():
+                    return
+                rec = outer.kv_tickets.lookup(ticket)
+                with outer._kv_lock:
+                    data = outer._kv_payloads.get(ticket)
+                if rec is None or data is None:
+                    self._send(410, {"error": {
+                        "message": f"kv ticket {ticket} gone (expired, "
+                                   "consumed, or unknown)",
+                        "type": "kv_migrate_error"}})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _ack_kv(self, ticket: str) -> None:
+                """Consume a kv ticket exactly once and drop its pinned
+                blob.  Duplicate/late acks answer 410 and free nothing —
+                a LOST ack leaves the blob to the orphan sweep."""
+                if self._kv_disarmed():
+                    return
+                if outer.kv_tickets.consume(ticket) is None:
+                    self._send(410, {"error": {
+                        "message": f"kv ticket {ticket} not ackable "
+                                   "(expired, consumed, or unknown)",
+                        "type": "kv_migrate_error"}})
+                    return
+                with outer._kv_lock:
+                    outer._kv_payloads.pop(ticket, None)
+                self._send(200, {"status": "acked"})
+
+            def _post_kv_import(self, body: dict) -> None:
+                """Pull a page-set blob from its source host and install
+                it into this engine's prefix cache.  Duplicate tickets
+                are 409 idempotent (the source's pages free via the
+                orphan sweep even when the first import's ack was lost);
+                geometry mismatch is 409 too — the router falls back to
+                cold resume, never a wedged import."""
+                if self._kv_disarmed():
+                    return
+                imp = getattr(outer.engine, "kv_import", None)
+                if imp is None:
+                    self._send(501, {"error": {
+                        "message": "this engine backend has no KV page-set "
+                                   "import", "type": "kv_migrate_error"}})
+                    return
+                tid, source = body.get("ticket"), body.get("source")
+                if not tid or not source:
+                    self._send(400, {"error": {
+                        "message": "body needs ticket + source",
+                        "type": "kv_migrate_error"}})
+                    return
+                if outer._kv_imported.seen(tid):
+                    self._send(409, {"error": {
+                        "message": f"duplicate kv import of ticket {tid} "
+                                   "(already imported on this host)",
+                        "type": "kv_migrate_error"}})
+                    return
+                payload, err = outer._fetch_kv(tid, source)
+                if err is not None:
+                    self._send(err[0], err[1])
+                    return
+                try:
+                    tokens = imp(payload)
+                except RuntimeError as e:  # engine busy: retryable
+                    self._send(503, {"error": {
+                        "message": f"kv import deferred: {e}",
+                        "type": "kv_migrate_error"}})
+                    return
+                except ValueError as e:  # geometry/framing: permanent
+                    self._send(409, {"error": {
+                        "message": f"kv import rejected: {e}",
+                        "type": "kv_migrate_error"}})
+                    return
+                except Exception as e:  # noqa: BLE001 - marked error
+                    logger.exception("kv import failed")
+                    self._send(502, {"error": {
+                        "message": f"kv import failed: "
+                                   f"{type(e).__name__}: {e}",
+                        "type": "kv_migrate_error"}})
+                    return
+                if not outer._kv_imported.add(tid):
+                    # raced a concurrent duplicate of the same ticket:
+                    # the cache insert is idempotent (same ids, same
+                    # bytes), so answer 409 without undoing anything
+                    self._send(409, {"error": {
+                        "message": f"duplicate kv import of ticket {tid}",
+                        "type": "kv_migrate_error"}})
+                    return
+                outer._send_kv_ack(tid, source)
+                self._send(200, {"status": "imported",
+                                 "imported_tokens": tokens})
+
             def do_DELETE(self):
                 if self.path.startswith("/v1/jobs/"):
                     code, payload = outer._job_http("DELETE", self.path, None)
@@ -1113,9 +1294,19 @@ class EngineHTTPServer:
                         and self.path.endswith("/ack")):
                     self._ack_handoff(self.path.split("/")[3])
                     return
+                if (self.path.startswith("/v1/kv/")
+                        and self.path.endswith("/ack")):
+                    self._ack_kv(self.path.split("/")[3])
+                    return
                 body = self._read_json()
                 if body is None:
                     self._send(400, {"error": {"message": "invalid JSON body"}})
+                    return
+                if self.path == "/v1/kv/export":
+                    self._post_kv_export(body)
+                    return
+                if self.path == "/v1/kv/import":
+                    self._post_kv_import(body)
                     return
                 if self.path == "/v1/debug/profile":
                     self._post_profile(body)
@@ -1512,6 +1703,12 @@ class EngineHTTPServer:
             if not sid:
                 return 404, {"error": {"message": f"no route {path}",
                                        "type": "session_error"}}
+            if self.live.get(sid) is None:
+                # cross-host resume (docs/SERVING.md "KV fabric"): an
+                # unknown session may have a journal in the SHARED live
+                # directory, written by a drained/killed sibling —
+                # rehydrate it on demand before answering 404
+                self.live.recover_one(sid)
             if method == "POST" and sub == "segments":
                 return 200, self.live.append(sid, body.get("segments"),
                                              refresh=body.get("refresh"),
@@ -1650,11 +1847,66 @@ class EngineHTTPServer:
                        "orphan-swept at the ticket deadline", tid)
         return False
 
+    def _fetch_kv(self, tid: str, source: str):
+        """Pull a page-set blob from its source host.  Returns
+        ``(payload, None)`` or ``(None, (status, error_body))`` — every
+        failure is a MARKED error the caller (router) falls back from,
+        never an empty success.  Same transfer discipline as
+        ``_fetch_handoff`` (the ``handoff.transfer`` fault site is that
+        path's own; this one stays clean so a transfer-fault plan aimed
+        at request handoff cannot silently fail migrations too)."""
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(source, timeout=30.0)
+            conn.request("GET", f"/v1/kv/{tid}")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None, (502, {"error": {
+                    "message": f"kv payload fetch from {source} failed: "
+                               f"HTTP {resp.status}",
+                    "type": "kv_migrate_error"}})
+            payload = decode_payload(resp.read())
+        except Exception as e:  # noqa: BLE001 - marked failure
+            return None, (502, {"error": {
+                "message": f"kv transfer from {source} failed: "
+                           f"{type(e).__name__}: {e}",
+                "type": "kv_migrate_error"}})
+        finally:
+            if conn is not None:
+                conn.close()
+        return payload, None
+
+    def _send_kv_ack(self, tid: str, source: str) -> bool:
+        """Ack a kv import so the source drops its pinned blob.
+        Best-effort with one retry — a LOST ack leaves the blob to the
+        source's orphan sweep (the crash-safety backstop), and the dedup
+        log here keeps a re-delivered ticket from double-importing."""
+        for attempt in range(2):
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(source, timeout=5.0)
+                conn.request("POST", f"/v1/kv/{tid}/ack")
+                resp = conn.getresponse()
+                resp.read()
+                return resp.status == 200
+            except Exception as e:  # noqa: BLE001 - retried once
+                logger.warning("kv ack for %s failed (attempt %d): %s: %s",
+                               tid, attempt + 1, type(e).__name__, e)
+            finally:
+                if conn is not None:
+                    conn.close()
+            time.sleep(0.05 * (attempt + 1))
+        logger.warning("kv ack for %s lost; pinned blob will be "
+                       "orphan-swept at the ticket deadline", tid)
+        return False
+
     def sweep_handoffs(self, now: float | None = None) -> int:
         """One orphan-sweep pass (the background sweeper's body; callable
         directly with an explicit ``now`` from tests).  Expired un-acked
         tickets release their pinned pages as orphans; the engine-side
-        TTL sweep backstops pins whose ticket was never minted."""
+        TTL sweep backstops pins whose ticket was never minted.  KV
+        page-set tickets sweep on the same pass — their pinned state is
+        the encoded blob, dropped here whether acked or lost."""
         released = 0
         release = getattr(self.engine, "release_handoff", None)
         for tid, rid, consumed in self.handoff.sweep(now):
@@ -1662,6 +1914,13 @@ class EngineHTTPServer:
                 released += release(rid, orphaned=True)
                 logger.warning("handoff ticket %s expired un-acked; "
                                "pinned pages reclaimed", tid)
+        for tid, _preamble, consumed in self.kv_tickets.sweep(now):
+            with self._kv_lock:
+                dropped = self._kv_payloads.pop(tid, None)
+            if dropped is not None and not consumed:
+                released += 1
+                logger.warning("kv ticket %s expired un-acked; pinned "
+                               "blob dropped", tid)
         sweep = getattr(self.engine, "sweep_handoffs", None)
         if sweep is not None:
             released += sweep(now)
@@ -1674,6 +1933,12 @@ class EngineHTTPServer:
                 self.sweep_handoffs()
             except Exception:  # noqa: BLE001 - the sweeper must survive
                 logger.exception("handoff orphan sweep failed")
+
+    def kv_stats(self) -> dict:
+        """KV-migration ticket state for the JSON /metrics document."""
+        with self._kv_lock:
+            pinned_bytes = sum(len(b) for b in self._kv_payloads.values())
+        return {**self.kv_tickets.stats(), "pinned_bytes": pinned_bytes}
 
     def handoff_stats(self) -> dict:
         return {

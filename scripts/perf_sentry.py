@@ -1,9 +1,9 @@
 """perf_sentry — noise-aware perf-regression checker over the bench history.
 
 Every hardware round appends a ``BENCH_r*.json`` / ``BENCH8B_r*.json`` /
-``MULTICHIP_r*.json`` artifact to the repo root (and fairness A/B rounds
-append ``FAIRNESS_r*.json``, scripts/ab_fairness.py), but nothing READ
-them:
+``MULTICHIP_r*.json`` artifact to the repo root (and the A/B rounds
+append ``FAIRNESS_r*.json`` / ``MIGRATE_r*.json``, scripts/ab_fairness.py
+and scripts/ab_migrate.py), but nothing READ them:
 a regression slipped into a round would sit unnoticed until a human
 diffed the trajectory.  The sentry makes the history a gate:
 
@@ -66,6 +66,11 @@ TRACKED = {
     # n-gram scans crept back into the loop)
     "spec_tree.accept_per_step": "up",
     "anatomy.segments_ms.draft": "down",
+    # KV-fabric A/B rounds (MIGRATE_r*.json, scripts/ab_migrate.py): of
+    # the preamble tokens the resume host re-serves after a drain, the
+    # fraction that came off the fabric (migrated page sets) instead of
+    # cold re-prefill — a drop means migration stopped delivering
+    "migrate.tokens_from_fabric_ratio": "up",
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
@@ -207,7 +212,7 @@ def main(argv: list[str] | None = None) -> int:
     root = Path(args.dir)
     regressions: list[dict] = []
     families: dict[str, dict] = {}
-    for prefix in ("BENCH", "BENCH8B", "FAIRNESS"):
+    for prefix in ("BENCH", "BENCH8B", "FAIRNESS", "MIGRATE"):
         rounds = load_bench_rounds(root, prefix)
         if not rounds:
             continue

@@ -26,13 +26,14 @@ import os
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
 import pytest
 
 from lmrs_tpu.config import EngineConfig, ModelConfig
-from lmrs_tpu.engine.api import GenerationRequest
+from lmrs_tpu.engine.api import GenerationRequest, preamble_key
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.engine.kv_cache import OutOfPages, PagedKVCache
 from lmrs_tpu.serving.handoff import (ImportLog, TicketRegistry,
@@ -522,6 +523,244 @@ def test_two_process_fault_armed_transfer_falls_back(mock_topology):
     finally:
         colo.shutdown()
         disagg.shutdown()
+
+
+# ------------------------------------- cross-host KV migration (fabric)
+
+
+_MIG_SYS = "Respond with the summary content only."
+_MIG_PRE = ("You are summarizing one section of a much longer transcript. "
+            "Keep every fact, decision, name, and number. ")
+
+
+def _mig_request(rid: int, chunk: str = "Chunk A: milestone nine shipped."
+                 ) -> GenerationRequest:
+    return GenerationRequest(prompt=_MIG_PRE + chunk, request_id=rid,
+                             temperature=0.0, system_prompt=_MIG_SYS,
+                             cache_prefix=len(_MIG_PRE))
+
+
+def _http_json(method: str, url: str, body: dict | None = None,
+               timeout: float = 10.0) -> tuple[int, dict]:
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            raw = r.read()
+            return r.status, (json.loads(raw) if raw else {})
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, (json.loads(raw) if raw else {})
+
+
+@pytest.fixture(scope="module")
+def kv_pair():
+    """Two colocated-role mock workers, identical seed — the minimal
+    fabric for cross-host page-set migration."""
+    ports = [free_port() for _ in range(2)]
+    procs = [_spawn_worker(p, "both") for p in ports]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    try:
+        for u, p in zip(urls, procs):
+            _wait_healthy(u, p)
+        yield ports, urls
+    finally:
+        _teardown(procs)
+
+
+def test_two_process_kv_migration_token_identity(kv_pair):
+    """The migration wire end to end across two OS processes: warm a
+    preamble on A, export→pull-import→ack to B, duplicate import 409s,
+    the consumed ticket 410s, and B then serves the SAME greedy text
+    with the migrated entry counting as a warm prefix hit."""
+    ports, urls = kv_pair
+    a = RouterEngine([f"127.0.0.1:{ports[0]}"])
+    b = RouterEngine([f"127.0.0.1:{ports[1]}"])
+    try:
+        want = a.generate_batch([_mig_request(0)])[0]
+        assert want.error is None and want.text
+        key = preamble_key(_MIG_SYS, _mig_request(0).prompt,
+                           len(_MIG_PRE))
+        st, tdoc = _http_json("POST", urls[0] + "/v1/kv/export",
+                              {"preamble": key})
+        assert st == 200 and tdoc["object"] == "kv.ticket"
+        assert tdoc["tokens"] > 0 and tdoc["bytes"] > 0
+        src = f"127.0.0.1:{ports[0]}"
+        st, idoc = _http_json("POST", urls[1] + "/v1/kv/import",
+                              {"ticket": tdoc["ticket"], "source": src})
+        assert st == 200 and idoc["status"] == "imported"
+        assert idoc["imported_tokens"] == tdoc["tokens"]
+        # lost-ack replay: the duplicate import is rejected idempotently
+        st, _ = _http_json("POST", urls[1] + "/v1/kv/import",
+                           {"ticket": tdoc["ticket"], "source": src})
+        assert st == 409
+        # the acked ticket's blob is gone from the source
+        st, _ = _http_json("GET", urls[0] + f"/v1/kv/{tdoc['ticket']}")
+        assert st == 410
+        # B serves the preamble warm: identical text, fabric tokens up
+        got = b.generate_batch([_mig_request(1)])[0]
+        assert got.error is None and got.text == want.text
+        st, m = _http_json("GET", urls[1] + "/metrics")
+        assert st == 200
+        assert m["engine"]["kv_migrate"]["imports"] >= 1
+        assert m["engine"]["kv_migrate"]["tokens_imported"] >= tdoc["tokens"]
+        assert m["engine"]["prefix_cache"]["hits"] >= 1
+        assert "pinned_bytes" in m["kv_migrate"]  # ticket stats ride along
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_export_unknown_preamble_404s(kv_pair):
+    _ports, urls = kv_pair
+    st, doc = _http_json("POST", urls[0] + "/v1/kv/export",
+                         {"preamble": "never-seen-hash"})
+    assert st == 404
+    assert "not warm" in doc["error"]["message"]
+    st, _ = _http_json("POST", urls[0] + "/v1/kv/export", {})
+    assert st == 400
+
+
+def test_import_bad_ticket_and_unreachable_source(kv_pair):
+    """An import whose pull fails (dead source / unknown ticket) answers
+    an error and installs nothing — the importer must stay clean for the
+    cold-resume fallback."""
+    ports, urls = kv_pair
+    st, _ = _http_json("POST", urls[1] + "/v1/kv/import",
+                       {"ticket": "bogus-ticket",
+                        "source": f"127.0.0.1:{ports[0]}"})
+    assert st >= 400
+    st, _ = _http_json("POST", urls[1] + "/v1/kv/import",
+                       {"ticket": "t", "source": "127.0.0.1:1"})
+    assert st >= 400
+    st, _ = _http_json("POST", urls[1] + "/v1/kv/import", {})
+    assert st == 400
+
+
+def test_kv_ticket_expiry_orphan_sweeps_pinned_blob():
+    """A kv page-set ticket whose ack is LOST must not pin its blob
+    forever: the orphan sweep drops it at the ticket deadline (injected
+    clock), after which the fetch answers 410; an ACKED ticket frees its
+    blob immediately and sweeps silently."""
+    from lmrs_tpu.engine.mock import MockEngine
+    from lmrs_tpu.serving.server import EngineHTTPServer
+
+    srv = EngineHTTPServer(MockEngine(seed=0), port=0,
+                           batch_window_s=0.01, handoff_ttl_s=30.0)
+    srv.start_background()
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        assert srv.engine.generate_batch([_mig_request(0)])[0].error is None
+        key = preamble_key(_MIG_SYS, _mig_request(0).prompt, len(_MIG_PRE))
+        st, tdoc = _http_json("POST", url + "/v1/kv/export",
+                              {"preamble": key})
+        assert st == 200
+        assert srv.kv_stats()["pinned_bytes"] > 0
+        # inside the TTL window nothing is reclaimed
+        assert srv.sweep_handoffs(time.time()) == 0
+        assert srv.kv_stats()["pinned_bytes"] > 0
+        # past the deadline the un-acked blob is orphan-swept
+        assert srv.sweep_handoffs(time.time() + 60.0) >= 1
+        assert srv.kv_stats()["pinned_bytes"] == 0
+        st, _ = _http_json("GET", url + f"/v1/kv/{tdoc['ticket']}")
+        assert st == 410
+        # acked ticket: blob freed at ack, the sweep finds no orphan
+        st, t2 = _http_json("POST", url + "/v1/kv/export",
+                            {"preamble": key})
+        assert st == 200
+        st, _ = _http_json("POST", url + f"/v1/kv/{t2['ticket']}/ack")
+        assert st == 200
+        assert srv.kv_stats()["pinned_bytes"] == 0
+        assert srv.sweep_handoffs(time.time() + 120.0) == 0
+        st, _ = _http_json("POST", url + f"/v1/kv/{t2['ticket']}/ack")
+        assert st == 410  # duplicate ack: idempotent refusal
+    finally:
+        srv.shutdown()
+
+
+def test_router_drain_migrates_warm_kv_and_repins(kv_pair):
+    """Fleet-level drain: the router moves the draining host's warm
+    preambles to the sibling over the /v1/kv wire, purges its sticky
+    caches, re-pins, and follow-up traffic hits warm on the sibling."""
+    ports, urls = kv_pair
+    router = RouterEngine([f"127.0.0.1:{ports[0]}",
+                           f"127.0.0.1:{ports[1]}"])
+    try:
+        chunk = "Chunk D: the drain rehearsal minutes."
+        want = router.generate_batch([_mig_request(10, chunk)])[0]
+        assert want.error is None
+        # find which host the prefix landed on; drain exactly that one
+        key = preamble_key(_MIG_SYS, _mig_request(10, chunk).prompt,
+                           len(_MIG_PRE))
+        warm_idx = None
+        for i, u in enumerate(urls):
+            _st, m = _http_json("GET", u + "/metrics")
+            rows = {r["hash"] for r in m.get("prefix_summary") or ()}
+            if key in rows:
+                warm_idx = i
+                break
+        assert warm_idx is not None
+        warm, sib = ports[warm_idx], ports[1 - warm_idx]
+        assert router.drain_host(f"127.0.0.1:{warm}")
+        deadline = time.time() + 20.0
+        while (router.migrations_pending(f"127.0.0.1:{warm}")
+               and time.time() < deadline):
+            time.sleep(0.1)
+        assert not router.migrations_pending(f"127.0.0.1:{warm}")
+        assert router._kv_moves >= 1
+        _st, m = _http_json("GET", f"http://127.0.0.1:{sib}/metrics")
+        assert m["engine"]["kv_migrate"]["imports"] >= 1
+        # the drained host left every sticky structure
+        with router._job_lock:
+            assert f"127.0.0.1:{warm}" not in router._job_hosts.values()
+        # the same preamble now serves warm from the sibling (the
+        # drained host is out of the dispatch order), identical text
+        got = router.generate_batch([_mig_request(11, chunk)])[0]
+        assert got.error is None and got.text == want.text
+        em = router.engine_metrics()
+        assert em["kv_migrate"]["moves"] >= 1
+        prom = router.prometheus_metrics()
+        assert "lmrs_kv_migrate_moves_total" in prom
+    finally:
+        router.shutdown()
+
+
+def test_kv_migrate_kill_switch_parity(monkeypatch):
+    """LMRS_KV_MIGRATE=0 end to end: every /v1/kv route 501s, the
+    /metrics documents carry no kv_migrate key anywhere, and a drain
+    still purges sticky state without attempting a single move."""
+    port = free_port()
+    proc = _spawn_worker(port, "both",
+                         extra_env={"LMRS_KV_MIGRATE": "0"})
+    url = f"http://127.0.0.1:{port}"
+    monkeypatch.setenv("LMRS_KV_MIGRATE", "0")
+    router = RouterEngine([f"127.0.0.1:{port}"])
+    try:
+        _wait_healthy(url, proc)
+        assert not router.kv_migrate
+        res = router.generate_batch([_mig_request(0)])[0]
+        assert res.error is None
+        for call in (("POST", "/v1/kv/export", {"preamble": "x"}),
+                     ("POST", "/v1/kv/import", {"ticket": "t",
+                                                "source": "s"}),
+                     ("GET", "/v1/kv/t", None),
+                     ("POST", "/v1/kv/t/ack", None)):
+            st, doc = _http_json(call[0], url + call[1], call[2])
+            assert st == 501, call
+            assert "LMRS_KV_MIGRATE=0" in doc["error"]["message"]
+        _st, m = _http_json("GET", url + "/metrics")
+        assert "kv_migrate" not in m
+        assert "kv_migrate" not in m["engine"]
+        assert router.drain_host(f"127.0.0.1:{port}")
+        assert not router.migrations_pending(f"127.0.0.1:{port}")
+        assert router._kv_moves == 0 and router._kv_failures == 0
+        assert "kv_migrate" not in router.engine_metrics()
+        assert "lmrs_kv_migrate" not in router.prometheus_metrics()
+    finally:
+        router.shutdown()
+        _teardown([proc])
 
 
 def test_two_process_decode_pod_killed_mid_sequence(mock_topology):

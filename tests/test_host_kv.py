@@ -11,7 +11,7 @@ import pytest
 
 from lmrs_tpu.config import EngineConfig, ModelConfig
 from lmrs_tpu.engine.api import GenerationRequest
-from lmrs_tpu.engine.host_kv import HostKVPool
+from lmrs_tpu.engine.host_kv import DiskKVPool, HostKVPool
 from lmrs_tpu.engine.jax_engine import JaxEngine
 from lmrs_tpu.engine.kv_cache import PageAllocator, audit_allocator
 from lmrs_tpu.engine.prefix_cache import PrefixCache
@@ -265,6 +265,289 @@ def test_fuzzed_spill_prefetch_interleave(seed):
     c.clear()
     _audit_clean(a, c, [])
     assert a.free_count == a.num_pages - 1
+
+
+# ------------------------------------------------------------- disk tier
+
+
+def _cache3(tmp_path, num_pages: int = 64, host_pages: int = 1 << 20,
+            disk_pages: int = 1 << 20, **kw):
+    """Three-tier pure-host fixture: HBM tree + host pool + disk pool."""
+    a = PageAllocator(num_pages)
+    disk = DiskKVPool(disk_pages * PAGE_BYTES, str(tmp_path))
+    pool = HostKVPool(host_pages * PAGE_BYTES, disk=disk)
+    kv = _FakeKV()
+    c = PrefixCache(a, PS, spill_pool=pool, capture_cb=kv.capture,
+                    page_bytes=PAGE_BYTES, **kw)
+    return a, c, kv
+
+
+def test_disk_demote_promote_round_trip(tmp_path):
+    """Host pressure demotes to a content-tagged spill file; a later
+    match promotes disk→host→device with the ORIGINAL bytes."""
+    a, c, kv = _cache3(tmp_path)
+    ids = list(range(100, 113))
+    seq = a.alloc(4)
+    c.insert(ids, seq)
+    a.free(seq)
+    assert c.evict(10) == 3
+    assert c.spilled_pages() == 3 and c.disk_pages() == 0
+    # host squeeze: the entry moves DOWN a tier instead of dropping
+    c.pool.budget_bytes = 0
+    c._enforce_host_budget()
+    c.pool.budget_bytes = 1 << 30
+    assert c.spilled_pages() == 0 and c.disk_pages() == 3
+    disk = c.disk
+    assert disk.demoted_pages_total == 3
+    assert c.pool.dropped_pages_total == 0  # a demotion is not a loss
+    desc = next(iter(disk.entries.values()))[0].spill
+    assert desc["disk"] and desc["crc"]
+    _audit_clean(a, c, [])
+
+    _pages, _tok, chain = c.match_hier(ids)
+    assert len(chain) == 1 and chain[0][1] == 12
+    node, _n = chain[0]
+    dest = a.alloc(3)
+    assert c.prefetch_into(node, dest, kv) == 3
+    # content round-tripped THROUGH the file: k still tags the original
+    # device page ids the _FakeKV capture encoded
+    assert kv.imports[0][1]["k"][0, :, 0, 0, 0].tolist() == seq[:3]
+    assert disk.promoted_pages_total == 3
+    assert c.disk_pages() == 0 and c.cached_pages == 3
+    assert disk.used_bytes == 0
+    _audit_clean(a, c, [dest])
+    a.free(dest)
+    _audit_clean(a, c, [])
+
+
+def test_one_lru_clock_across_tiers(tmp_path):
+    """Budget pressure cascades host→disk→gone in ONE recency order:
+    the newest prefix stays on the host, the middle demotes to disk,
+    and the oldest falls off the end of the disk budget."""
+    a, c, _kv = _cache3(tmp_path, host_pages=2, disk_pages=2)
+    entries = []
+    for base in (10, 40, 70):  # three disjoint 2-page prefixes, in age order
+        ids = [base + i for i in range(9)]
+        pages = a.alloc(2)
+        c.insert(ids, pages)
+        a.free(pages)
+        entries.append(ids)
+    assert c.evict(100) == 6
+    assert c.spilled_pages() == 2 and c.disk_pages() == 2
+    assert c.disk.dropped_pages_total == 2  # the oldest fell off disk
+    _audit_clean(a, c, [])
+    assert c.match_hier(entries[0])[2] == []   # oldest: gone
+    assert c.match_hier(entries[1])[2] != []   # middle: survives (disk)
+    assert c.match_hier(entries[2])[2] != []   # newest: survives (host)
+    mid = c.match_hier(entries[1])[2][0][0]
+    new = c.match_hier(entries[2])[2][0][0]
+    assert mid.spill.get("disk") and not new.spill.get("disk")
+
+
+def test_torn_disk_file_degrades_to_reprefill(tmp_path):
+    """A truncated spill file fails the size/crc gate: the prefetch
+    raises (the scheduler re-prefills), the entry drops so the tree
+    stops advertising it, and the auditors stay clean."""
+    a, c, kv = _cache3(tmp_path)
+    ids = [7] * 9
+    pages = a.alloc(3)
+    c.insert(ids, pages)
+    a.free(pages)
+    c.evict(10)
+    c.pool.budget_bytes = 0
+    c._enforce_host_budget()
+    c.pool.budget_bytes = 1 << 30
+    node = c.match_hier(ids)[2][0][0]
+    with open(node.spill["path"], "r+b") as f:  # tear the file
+        f.truncate(3)
+    dest = a.alloc(2)
+    with pytest.raises(RuntimeError):
+        c.prefetch_into(node, dest, kv)
+    a.free(dest)
+    assert kv.imports == []  # nothing ever scattered to the device
+    assert c.disk.read_failures_total == 1
+    assert c.match_hier(ids) == ([], 0, [])
+    assert c.disk_pages() == 0 and c.disk.used_bytes == 0
+    _audit_clean(a, c, [])
+
+
+def test_corrupt_disk_file_fails_crc(tmp_path):
+    """Same size, different bytes: the crc content tag catches it."""
+    a, c, kv = _cache3(tmp_path)
+    ids = [5] * 9
+    pages = a.alloc(3)
+    c.insert(ids, pages)
+    a.free(pages)
+    c.evict(10)
+    c.pool.budget_bytes = 0
+    c._enforce_host_budget()
+    c.pool.budget_bytes = 1 << 30
+    node = c.match_hier(ids)[2][0][0]
+    with open(node.spill["path"], "r+b") as f:
+        f.seek(0)
+        f.write(b"\xff")
+    dest = a.alloc(2)
+    with pytest.raises(RuntimeError):
+        c.prefetch_into(node, dest, kv)
+    a.free(dest)
+    assert c.disk.read_failures_total == 1
+    assert c.match_hier(ids) == ([], 0, [])
+    _audit_clean(a, c, [])
+
+
+def test_disk_read_fault_site(tmp_path):
+    """The injected kv.disk_read fault degrades exactly like a torn
+    file: raise, drop, re-prefill — never a wedged admission."""
+    a, c, kv = _cache3(tmp_path)
+    ids = [3] * 9
+    pages = a.alloc(3)
+    c.insert(ids, pages)
+    a.free(pages)
+    c.evict(10)
+    c.pool.budget_bytes = 0
+    c._enforce_host_budget()
+    c.pool.budget_bytes = 1 << 30
+    node = c.match_hier(ids)[2][0][0]
+    dest = a.alloc(2)
+    with faults.injected(FaultPlan(faults=[
+            {"site": "kv.disk_read", "p": 1.0}])):
+        with pytest.raises(RuntimeError):
+            c.prefetch_into(node, dest, kv)
+    a.free(dest)
+    assert c.match_hier(ids) == ([], 0, [])
+    _audit_clean(a, c, [])
+
+
+def test_spill_payload_reads_either_tier_without_promoting(tmp_path):
+    """Migration export reads warm state in place: a host entry returns
+    its payload, a disk entry reads its file back — neither promotes;
+    a torn disk file returns None and drops the entry."""
+    a, c, _kv = _cache3(tmp_path, host_pages=2)
+    host_ids = [9] * 9
+    disk_ids = [4] * 9
+    for ids in (disk_ids, host_ids):  # disk_ids older -> demotes first
+        pages = a.alloc(3)
+        c.insert(ids, pages)
+        a.free(pages)
+    c.evict(100)  # 4 spilled pages vs 2-page host budget: LRU demotes
+    assert c.spilled_pages() == 2 and c.disk_pages() == 2
+    hn = c.match_hier(host_ids)[2][0][0]
+    dn = c.match_hier(disk_ids)[2][0][0]
+    assert not hn.spill.get("disk") and dn.spill.get("disk")
+    for node in (hn, dn):
+        pay = c.spill_payload(node)
+        assert pay is not None and "k" in pay and not pay.get("disk")
+    # reading promoted nothing: both entries still live in their tiers
+    assert c.spilled_pages() == 2 and c.disk_pages() == 2
+    with open(dn.spill["path"], "r+b") as f:
+        f.truncate(1)
+    assert c.spill_payload(dn) is None
+    assert c.match_hier(disk_ids)[2] == []
+    _audit_clean(a, c, [])
+
+
+def test_clear_drops_disk_tier_and_files(tmp_path):
+    a, c, _kv = _cache3(tmp_path, host_pages=2)
+    for base in (10, 40):
+        ids = [base + i for i in range(9)]
+        pages = a.alloc(2)
+        c.insert(ids, pages)
+        a.free(pages)
+    c.evict(100)
+    assert c.disk_pages() > 0
+    paths = [node.spill["path"]
+             for node, _nb in c.disk.entries.values()]
+    c.clear()
+    assert c.disk_pages() == 0 and c.disk.used_bytes == 0
+    assert c.spilled_pages() == 0
+    assert all(not __import__("os").path.exists(p) for p in paths)
+    _audit_clean(a, c, [])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzzed_three_tier_interleave(seed, tmp_path):
+    """Random insert/close/evict/prefetch/host-squeeze/disk-squeeze
+    interleave across ALL THREE tiers, auditors clean after every op
+    (the radix auditor cross-checks both pools' accounting and the
+    spill files' existence)."""
+    rng = np.random.default_rng(seed)
+    a, c, kv = _cache3(tmp_path, num_pages=48, host_pages=6, disk_pages=6)
+    live: list[list[int]] = []
+    prefixes = [[int(b) + i for i in range(int(rng.integers(5, 14)))]
+                for b in (10, 40, 70, 100)]
+    for _step in range(150):
+        op = rng.integers(0, 6)
+        if op == 0 and a.free_count >= 6:
+            ids = list(prefixes[int(rng.integers(0, len(prefixes)))]) + [
+                int(t) for t in rng.integers(200, 250, 4)]
+            pages = a.alloc(-(-len(ids) // PS))
+            c.insert(ids, pages)
+            live.append(pages)
+        elif op == 1 and live:
+            a.free(live.pop(int(rng.integers(0, len(live)))))
+        elif op == 2:
+            c.evict(int(rng.integers(1, 6)))
+        elif op == 3:  # match + prefetch (either spilled tier)
+            ids = list(prefixes[int(rng.integers(0, len(prefixes)))]) + [99]
+            pages, _tok, chain = c.match_hier(ids)
+            got = list(pages)
+            for node, n_tok in chain:
+                need = n_tok // PS
+                if a.free_count < need:
+                    break
+                dest = a.alloc(need)
+                try:
+                    c.prefetch_into(node, dest, kv)
+                except RuntimeError:
+                    a.free(dest)
+                    break
+                got += dest
+            if got:
+                live.append(got)
+        elif op == 4:  # host squeeze: demotions cascade to disk
+            c.pool.budget_bytes = int(rng.integers(0, 6)) * PAGE_BYTES
+            c._enforce_host_budget()
+            c.pool.budget_bytes = 6 * PAGE_BYTES
+        else:  # disk squeeze: LRU disk subtrees drop for real
+            c.disk.budget_bytes = int(rng.integers(0, 6)) * PAGE_BYTES
+            c._enforce_host_budget()
+            c.disk.budget_bytes = 6 * PAGE_BYTES
+        _audit_clean(a, c, live)
+    for pages in live:
+        a.free(pages)
+    c.clear()
+    _audit_clean(a, c, [])
+    assert a.free_count == a.num_pages - 1
+    assert c.disk.used_bytes == 0
+
+
+def test_disk_tier_identity_and_scheduler_accounting(tmp_path):
+    """Engine-level: a host budget too small for the workload, disk tier
+    on vs off — greedy outputs token-identical, the armed arm lands
+    entries on disk and reports them, auditors clean."""
+    budget_pages = 2
+    probe = _engine()
+    page_b = probe._scheduler.cache.page_payload_bytes()
+    probe.shutdown()
+    kw = dict(host_kv_gb=budget_pages * page_b / 2**30)
+    on = _engine(kv_disk=True, kv_disk_dir=str(tmp_path), **kw)
+    sched = on._scheduler
+    assert sched._prefix_cache.disk is not None
+    first_on, second_on, _pf1, _pf2 = _evict_rerun(on)
+    rep = sched.metrics_report()
+    assert rep["host_kv"]["disk_demoted_pages_total"] > 0
+    assert sched.audit() == []
+    on.shutdown()
+
+    off = _engine(**kw)  # LMRS_KV_DISK default: OFF (opt-in tier)
+    assert off._scheduler._prefix_cache.disk is None
+    first_off, second_off, _p1, _p2 = _evict_rerun(off)
+    assert "disk_demoted_pages_total" not in \
+        off._scheduler.metrics_report()["host_kv"]
+    off.shutdown()
+
+    assert first_on == first_off, "disk tier changed greedy outputs"
+    assert second_on == second_off, "disk promote diverged from re-prefill"
 
 
 # ------------------------------------------------- scheduler integration

@@ -503,11 +503,14 @@ def test_router_tenant_affinity_lru_and_slo_gating():
         router._note_tenant_host(req, router.hosts[0])
         assert router._tenant_pref(req, "full") is router.hosts[0]
         assert router._tenant_routed == 1
-        # a draining warm host yields no opinion (falls back to load
-        # ordering) rather than routing into the drain
+        # draining PURGES the tenant pin (ISSUE 20: stale affinity must
+        # not keep steering warm traffic at a host on its way out) — a
+        # re-added host earns stickiness back on its next serve
         router.drain_host("h1:1")
         assert router._tenant_pref(req, "full") is None
         router.add_host("h1:1")
+        assert router._tenant_pref(req, "full") is None
+        router._note_tenant_host(req, router.hosts[0])
         assert router._tenant_pref(req, "full") is router.hosts[0]
         # anonymous requests never stick
         assert router._tenant_pref(_req(1), "full") is None
@@ -525,6 +528,80 @@ def test_router_tenant_affinity_lru_and_slo_gating():
         # kill switch: no stickiness, no recording
         router.tenant_route = False
         assert router._tenant_pref(req, "full") is None
+    finally:
+        router.shutdown()
+
+
+def test_router_drain_purges_sticky_caches(monkeypatch):
+    """Drain must scrub EVERY sticky structure pointing at the draining
+    host — tenant pins, job/session pins, summary rows — or stale
+    affinity keeps steering warm traffic at a pod on its way out."""
+    from lmrs_tpu.serving.router import RouterEngine
+
+    monkeypatch.setenv("LMRS_KV_MIGRATE", "0")
+    router = RouterEngine(["h1:1", "h2:2"], timeout_s=1.0)
+    try:
+        router._note_tenant_host(_req(0, "acme"), router.hosts[0])
+        router._pin_job("job-1", "h1:1")
+        router._pin_job("sess-1", "h1:1")
+        router._pin_job("keep", "h2:2")
+        with router._summary_lock:
+            router._summaries["h1:1"] = {"t": 0.0, "map": {}}
+        assert router.drain_host("h1:1")
+        with router._stats_lock:
+            assert "acme" not in router._tenant_hosts
+        with router._job_lock:
+            assert router._job_hosts == {"keep": "h2:2"}
+        with router._summary_lock:
+            assert "h1:1" not in router._summaries
+        # kill switch: the purge happens, but no migration ever launches
+        assert not router.migrations_pending("h1:1")
+        assert router._kv_moves == 0 and router._kv_failures == 0
+    finally:
+        router.shutdown()
+
+
+def test_router_drain_migration_repins_to_sibling():
+    """Armed drain against unreachable hosts: zero page sets move (a
+    dark pod has nothing to export), but the drained host's sticky pins
+    still re-home onto the healthy sibling and the migration never
+    wedges the drain."""
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1", "h2:2"], timeout_s=1.0)
+    try:
+        assert router.kv_migrate
+        router._pin_job("sess-1", "h1:1")
+        assert router.drain_host("h1:1")
+        deadline = time.time() + 15.0
+        while (router.migrations_pending("h1:1")
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert not router.migrations_pending("h1:1")
+        assert router._kv_moves == 0
+        with router._job_lock:
+            assert router._job_hosts.get("sess-1") == "h2:2"
+    finally:
+        router.shutdown()
+
+
+def test_router_forced_remove_purges_pins_and_prefetch_marks():
+    """A FORCED remove (breaker-dead pod, no drain) must not leave job
+    pins or prefetch dedup marks aimed at a host that is gone."""
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1", "h2:2"], timeout_s=1.0)
+    try:
+        router._pin_job("j", "h2:2")
+        with router._kv_lock:
+            router._kv_prefetched[("h2:2", "k")] = 0.0
+            router._kv_prefetched[("h1:1", "k")] = 0.0
+        assert router.remove_host("h2:2", force=True)
+        with router._job_lock:
+            assert "j" not in router._job_hosts
+        with router._kv_lock:
+            assert ("h2:2", "k") not in router._kv_prefetched
+            assert ("h1:1", "k") in router._kv_prefetched
     finally:
         router.shutdown()
 
@@ -586,6 +663,12 @@ def test_autoscaler_drains_then_removes_idle_spawned_host():
         assert s["actions"] == ["draining:up0:9001"]
         assert next(h for h in router.hosts
                     if h.netloc == "up0:9001").draining
+        # the drain kicked a background KV migration (unreachable hosts:
+        # it finishes empty); the advance tick holds until it clears
+        deadline = time.time() + 15.0
+        while (router.migrations_pending("up0:9001")
+               and time.time() < deadline):
+            time.sleep(0.02)
         clk.t += 1
         s = a.tick()
         assert s["actions"] == ["removed:up0:9001"]
@@ -595,6 +678,35 @@ def test_autoscaler_drains_then_removes_idle_spawned_host():
         # at min_hosts nothing further shrinks
         clk.t += 1
         assert a.tick()["actions"] == []
+        assert len(router.hosts) == 1
+    finally:
+        router.shutdown()
+
+
+def test_autoscaler_holds_removal_while_kv_migrates():
+    """An idle drained host is NOT removed while its KV migration is in
+    flight (pages must not be torn off a pod mid-copy); the drain
+    timeout still backstops a wedged migration."""
+    from lmrs_tpu.serving.router import RouterEngine
+
+    router = RouterEngine(["h1:1"], timeout_s=1.0)
+    try:
+        router._slo_penalty = lambda h: 1
+        clk = _Clock()
+        a = Autoscaler(router, lambda: "up0:9001", clock=clk,
+                       enabled=True, interval_s=1.0, min_hosts=1,
+                       max_hosts=2, cooldown_ticks=1, drain_timeout_s=4.0)
+        a.tick()
+        router._slo_penalty = lambda h: 0
+        clk.t += 1
+        assert a.tick()["actions"] == ["draining:up0:9001"]
+        router.migrations_pending = lambda n: n == "up0:9001"  # wedged copy
+        clk.t += 1
+        s = a.tick()  # idle, but mid-migration: the drain holds
+        assert not any(x.startswith("removed") for x in s["actions"])
+        assert len(router.hosts) == 2
+        clk.t += 5  # past drain_timeout_s: the backstop removes anyway
+        assert a.tick()["actions"] == ["removed:up0:9001"]
         assert len(router.hosts) == 1
     finally:
         router.shutdown()
